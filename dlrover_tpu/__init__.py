@@ -1,0 +1,15 @@
+"""dlrover_tpu — a TPU-native elastic distributed training framework.
+
+Capabilities modeled on DLRover (Ant Group's automatic distributed deep
+learning system), re-designed for TPU hardware: a per-job master that owns
+rendezvous, node lifecycle, dynamic data sharding and auto-scaling; a
+per-host elastic agent that supervises training processes and flushes
+in-memory "flash checkpoints" on failure; trainer-side checkpoint engines
+that stage sharded train state into host shared memory; and an acceleration
+layer composing DP/FSDP/TP/PP/SP/EP strategies via ``jax.sharding`` over a
+device mesh instead of torch process groups.
+
+Reference capability map: see ``SURVEY.md`` at the repo root.
+"""
+
+__version__ = "0.1.0"

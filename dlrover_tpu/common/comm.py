@@ -1,0 +1,281 @@
+"""Cross-process shared objects over unix-domain sockets.
+
+Capability parity with the reference's ``common/multi_process.py``
+(``SharedLock``/``SharedQueue``/``SharedDict`` built on ``LocalSocketComm``):
+the *owner* process (normally the elastic agent) runs a tiny threaded server
+per object; trainer processes are clients. The wire format is a 4-byte
+big-endian length prefix followed by a pickled ``(method, args, kwargs)``
+request and a pickled ``(ok, payload)`` response.
+
+These primitives deliberately survive trainer crashes: state lives in the
+agent process, so a respawned trainer reconnects and sees the same lock/
+queue/dict.
+"""
+
+import os
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from dlrover_tpu.common.constants import CommResource
+from dlrover_tpu.common.log import logger
+
+_LEN = struct.Struct(">I")
+
+
+def _sock_path(job: str, kind: str, name: str) -> str:
+    d = CommResource.SOCKET_DIR_FMT.format(job=job)
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"{kind}_{name}.sock")
+
+
+def _send(sock: socket.socket, obj: Any):
+    data = pickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(header)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(n)
+        if not chunk:
+            raise ConnectionError("socket closed mid-message")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class LocalSocketComm:
+    """Base for a named shared object: server in the owner, clients elsewhere."""
+
+    KIND = "obj"
+
+    def __init__(self, name: str, create: bool = False, job: str = ""):
+        self.name = name
+        self._job = job or os.getenv("DLROVER_TPU_JOB_NAME", "local-job")
+        self._path = _sock_path(self._job, self.KIND, name)
+        self._server_sock: Optional[socket.socket] = None
+        self._stopped = False
+        if create:
+            self._start_server()
+
+    # ----- server side -----
+    def _start_server(self):
+        if os.path.exists(self._path):
+            os.unlink(self._path)
+        self._server_sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._server_sock.bind(self._path)
+        self._server_sock.listen(128)
+        t = threading.Thread(
+            target=self._serve, name=f"{self.KIND}-{self.name}", daemon=True
+        )
+        t.start()
+
+    def _serve(self):
+        while not self._stopped:
+            try:
+                conn, _ = self._server_sock.accept()
+            except OSError:
+                break
+            threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _handle_conn(self, conn: socket.socket):
+        with conn:
+            while True:
+                try:
+                    method, args, kwargs = _recv(conn)
+                except (ConnectionError, EOFError, OSError):
+                    return
+                try:
+                    result = getattr(self, "_srv_" + method)(*args, **kwargs)
+                    reply = (True, result)
+                except Exception as e:  # surface remote errors to the client
+                    reply = (False, repr(e))
+                try:
+                    _send(conn, reply)
+                except OSError:
+                    return
+
+    def close(self):
+        self._stopped = True
+        if self._server_sock is not None:
+            try:
+                self._server_sock.close()
+            except OSError:
+                pass
+            try:
+                os.unlink(self._path)
+            except FileNotFoundError:
+                pass
+
+    # ----- client side -----
+    def _call(self, method: str, *args, timeout: float = 60.0, **kwargs):
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+                    s.settimeout(max(0.1, deadline - time.monotonic()))
+                    s.connect(self._path)
+                    _send(s, (method, args, kwargs))
+                    ok, payload = _recv(s)
+                if ok:
+                    return payload
+                raise RuntimeError(f"remote {self.KIND}.{method} failed: {payload}")
+            except (FileNotFoundError, ConnectionError, socket.timeout) as e:
+                last_err = e
+                time.sleep(0.05)
+        raise TimeoutError(
+            f"{self.KIND} '{self.name}' unreachable at {self._path}: {last_err}"
+        )
+
+
+class SharedLock(LocalSocketComm):
+    """A lock owned by the agent; any process on the host can acquire it.
+
+    The flash-checkpoint protocol uses it for dirty-write detection: the
+    saver refuses to persist a shard whose lock is held by a writer.
+    """
+
+    KIND = "lock"
+
+    def __init__(self, name: str, create: bool = False, job: str = ""):
+        self._lock = threading.Lock() if create else None
+        super().__init__(name, create, job)
+
+    def _srv_acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking and timeout >= 0:
+            return self._lock.acquire(timeout=timeout)
+        return self._lock.acquire(blocking=blocking)
+
+    def _srv_release(self):
+        try:
+            self._lock.release()
+            return True
+        except RuntimeError:
+            return False
+
+    def _srv_locked(self):
+        return self._lock.locked()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        call_timeout = 60.0 if timeout < 0 else timeout + 60.0
+        return self._call(
+            "acquire", blocking, timeout, timeout=call_timeout
+        )
+
+    def release(self) -> bool:
+        return self._call("release")
+
+    def locked(self) -> bool:
+        return self._call("locked")
+
+
+class SharedQueue(LocalSocketComm):
+    """A queue owned by the agent (e.g. the checkpoint event queue)."""
+
+    KIND = "queue"
+
+    def __init__(self, name: str, create: bool = False, maxsize: int = 0, job: str = ""):
+        self._queue: Optional[queue.Queue] = (
+            queue.Queue(maxsize) if create else None
+        )
+        super().__init__(name, create, job)
+
+    def _srv_put(self, item, block=True, timeout=None):
+        self._queue.put(item, block=block, timeout=timeout)
+
+    def _srv_get(self, block=True, timeout=None):
+        return self._queue.get(block=block, timeout=timeout)
+
+    def _srv_qsize(self):
+        return self._queue.qsize()
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None):
+        self._call("put", item, block, timeout, timeout=(timeout or 60.0) + 60.0)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        try:
+            return self._call(
+                "get", block, timeout, timeout=(timeout or 3600.0) + 5.0
+            )
+        except RuntimeError as e:
+            if "Empty" in str(e):
+                raise queue.Empty from e
+            raise
+
+    def qsize(self) -> int:
+        return self._call("qsize")
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+
+class SharedDict(LocalSocketComm):
+    """A dict owned by the agent (e.g. checkpoint tensor metadata)."""
+
+    KIND = "dict"
+
+    def __init__(self, name: str, create: bool = False, job: str = ""):
+        self._dict: Optional[Dict] = {} if create else None
+        self._dict_lock = threading.Lock() if create else None
+        super().__init__(name, create, job)
+
+    def _srv_set(self, key, value):
+        with self._dict_lock:
+            self._dict[key] = value
+
+    def _srv_get(self, key, default=None):
+        with self._dict_lock:
+            return self._dict.get(key, default)
+
+    def _srv_update(self, other: Dict):
+        with self._dict_lock:
+            self._dict.update(other)
+
+    def _srv_pop(self, key, default=None):
+        with self._dict_lock:
+            return self._dict.pop(key, default)
+
+    def _srv_copy(self):
+        with self._dict_lock:
+            return dict(self._dict)
+
+    def set(self, key, value):
+        self._call("set", key, value)
+
+    def get(self, key, default=None):
+        return self._call("get", key, default)
+
+    def update(self, other: Dict):
+        self._call("update", other)
+
+    def pop(self, key, default=None):
+        return self._call("pop", key, default)
+
+    def copy(self) -> Dict:
+        return self._call("copy")
+
+
+def clear_job_sockets(job: str):
+    """Remove all socket files of a job (test/bootstrap hygiene)."""
+    d = CommResource.SOCKET_DIR_FMT.format(job=job)
+    if not os.path.isdir(d):
+        return
+    for f in os.listdir(d):
+        try:
+            os.unlink(os.path.join(d, f))
+        except OSError as e:
+            logger.warning("failed removing socket %s: %s", f, e)

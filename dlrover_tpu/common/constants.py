@@ -1,0 +1,144 @@
+"""Shared enums and the env-var contract.
+
+Capability parity with the reference's ``dlrover/python/common/constants.py``
+(NodeType/NodeStatus/RendezvousName/ConfigPath/CheckpointConstant), re-keyed
+for a TPU deployment: roles are TPU hosts (one agent per host of a pod
+slice), not PS/worker GPU pods.
+"""
+
+import os
+
+
+class NodeType:
+    """Roles a node can play in a job."""
+
+    MASTER = "master"
+    WORKER = "worker"
+    # TF PS-style roles kept for the PS-elasticity subsystem.
+    PS = "ps"
+    CHIEF = "chief"
+    EVALUATOR = "evaluator"
+
+
+class NodeStatus:
+    INITIAL = "initial"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    DELETED = "deleted"
+    BREAKDOWN = "breakdown"
+    UNKNOWN = "unknown"
+
+
+class NodeEventType:
+    ADDED = "added"
+    MODIFIED = "modified"
+    DELETED = "deleted"
+
+
+class NodeExitReason:
+    KILLED = "killed"
+    OOM = "oom"
+    FATAL_ERROR = "fatal-error"
+    HARDWARE_ERROR = "hardware-error"
+    PREEMPTED = "preempted"
+    SUCCEEDED = "succeeded"
+    UNKNOWN = "unknown"
+
+
+class JobStage:
+    INIT = "init"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    STOPPING = "stopping"
+
+
+class RendezvousName:
+    """Named rendezvous rounds managed by the master.
+
+    Mirrors the reference's two rendezvous managers
+    (``rdzv_manager.py``: elastic-training and network-check); the check
+    round here exercises the ICI mesh rather than NCCL.
+    """
+
+    TRAINING = "elastic-training"
+    DEVICE_CHECK = "device-check"
+
+
+class TrainingExceptionLevel:
+    RDZV_ERROR = "rdzv_error"
+    PROCESS_ERROR = "process_error"
+    NODE_ERROR = "node_error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+class PlatformType:
+    LOCAL = "local"
+    KUBERNETES = "k8s"
+    RAY = "ray"
+
+
+class ConfigPath:
+    """Host-local runtime file contract between agent and trainers."""
+
+    ROOT = os.getenv("DLROVER_TPU_RUNTIME_DIR", "/tmp/dlrover_tpu")
+    ENV_RUNTIME_METRICS = "DLROVER_TPU_RUNTIME_METRICS_PATH"
+    RUNTIME_METRICS = os.path.join(ROOT, "runtime_metrics.json")
+    ENV_PARAL_CONFIG = "DLROVER_TPU_PARAL_CONFIG_PATH"
+    PARAL_CONFIG = os.path.join(ROOT, "auto_paral_config.json")
+
+
+class CheckpointConstant:
+    """Flash-checkpoint file layout.
+
+    Same two-phase commit contract as the reference saver
+    (``ckpt_saver.py``: per-shard done files + a tracker file naming the
+    last complete step).
+    """
+
+    TRACKER_FILE = "latest_checkpointed_iteration.txt"
+    STEP_DIR_PREFIX = "checkpoint-"
+    SHARD_FILE_PREFIX = "shard_"
+    DONE_FILE_PREFIX = "done_"
+    METADATA_FILE = "metadata.json"
+    SAVE_TIMEOUT_SEC = 600
+
+
+class NodeEnv:
+    """Environment variables the launcher/agent sets for every process."""
+
+    JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+    NODE_ID = "DLROVER_TPU_NODE_ID"
+    NODE_RANK = "DLROVER_TPU_NODE_RANK"
+    NODE_NUM = "DLROVER_TPU_NODE_NUM"
+    # Worker-process contract (consumed by jax.distributed.initialize).
+    COORDINATOR_ADDR = "DLROVER_TPU_COORDINATOR_ADDR"
+    PROCESS_ID = "DLROVER_TPU_PROCESS_ID"
+    NUM_PROCESSES = "DLROVER_TPU_NUM_PROCESSES"
+    LOCAL_RANK = "DLROVER_TPU_LOCAL_RANK"
+    LOCAL_WORLD_SIZE = "DLROVER_TPU_LOCAL_WORLD_SIZE"
+    RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
+    # Fault-injection knobs for tests (reference: MOCK_ERR_RANK).
+    MOCK_ERR_RANK = "DLROVER_TPU_MOCK_ERR_RANK"
+    MOCK_STRAGGLER_RANK = "DLROVER_TPU_MOCK_STRAGGLER_RANK"
+
+
+class CommResource:
+    """Unix-socket namespace for on-host shared objects."""
+
+    SOCKET_DIR_FMT = os.path.join(
+        os.getenv("DLROVER_TPU_SOCK_DIR", "/tmp/dlrover_tpu/sock"), "{job}"
+    )
+
+
+class DefaultPort:
+    MASTER = 0  # 0 = pick a free port
+    COORDINATOR = 51217
+
+
+GB = 1024 * 1024 * 1024
+MB = 1024 * 1024

@@ -1,0 +1,48 @@
+"""Helpers to read the NodeEnv contract (parity: reference ``common/env_utils.py``)."""
+
+import os
+
+from dlrover_tpu.common.constants import NodeEnv
+
+
+def _get_int(name: str, default: int = 0) -> int:
+    try:
+        return int(os.getenv(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def get_node_id() -> int:
+    return _get_int(NodeEnv.NODE_ID, 0)
+
+
+def get_node_rank() -> int:
+    return _get_int(NodeEnv.NODE_RANK, get_node_id())
+
+
+def get_node_num() -> int:
+    return _get_int(NodeEnv.NODE_NUM, 1)
+
+
+def get_process_id() -> int:
+    return _get_int(NodeEnv.PROCESS_ID, 0)
+
+
+def get_num_processes() -> int:
+    return _get_int(NodeEnv.NUM_PROCESSES, 1)
+
+
+def get_local_rank() -> int:
+    return _get_int(NodeEnv.LOCAL_RANK, 0)
+
+
+def get_local_world_size() -> int:
+    return _get_int(NodeEnv.LOCAL_WORLD_SIZE, 1)
+
+
+def get_job_name() -> str:
+    return os.getenv(NodeEnv.JOB_NAME, "local-job")
+
+
+def get_master_addr() -> str:
+    return os.getenv(NodeEnv.MASTER_ADDR, "")

@@ -1,0 +1,266 @@
+"""Typed control-plane messages.
+
+Capability parity with the reference's ``common/grpc.py`` (~40 pickled
+dataclasses dispatched by ``servicer.py`` on message class). Every message
+carries ``node_id``/``node_type`` implicitly via the envelope below.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class BaseRequest:
+    node_id: int = 0
+    node_type: str = "worker"
+
+
+# ---------------- rendezvous ----------------
+
+
+@dataclass
+class JoinRendezvous(BaseRequest):
+    rdzv_name: str = ""
+    node_rank: int = 0
+    local_world_size: int = 1
+    round: int = 0
+
+
+@dataclass
+class CommWorldRequest(BaseRequest):
+    rdzv_name: str = ""
+    round: int = 0
+
+
+@dataclass
+class CommWorld:
+    rdzv_name: str = ""
+    round: int = -1
+    group: int = 0
+    # node_rank -> local world size (process count on the node)
+    world: Dict[int, int] = field(default_factory=dict)
+
+
+@dataclass
+class WaitingNodeNumRequest(BaseRequest):
+    rdzv_name: str = ""
+
+
+@dataclass
+class RendezvousParams(BaseRequest):
+    min_nodes: int = 1
+    max_nodes: int = 1
+    waiting_timeout: float = 30.0
+    node_unit: int = 1
+
+
+# ---------------- device check / diagnosis ----------------
+
+
+@dataclass
+class DeviceCheckResult(BaseRequest):
+    node_rank: int = 0
+    normal: bool = True
+    elapsed_time: float = 0.0
+    round: int = 0
+
+
+@dataclass
+class FaultNodesRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class StragglersRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class DiagnosisResult:
+    nodes: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+# ---------------- kv store ----------------
+
+
+@dataclass
+class KVStoreSet(BaseRequest):
+    key: str = ""
+    value: bytes = b""
+
+
+@dataclass
+class KVStoreGet(BaseRequest):
+    key: str = ""
+
+
+@dataclass
+class KVStoreAdd(BaseRequest):
+    key: str = ""
+    amount: int = 1
+
+
+@dataclass
+class KVStoreMultiGet(BaseRequest):
+    keys: Tuple[str, ...] = ()
+
+
+# ---------------- dynamic data sharding ----------------
+
+
+@dataclass
+class DatasetShardParams(BaseRequest):
+    dataset_name: str = ""
+    dataset_size: int = 0
+    shard_size: int = 0
+    num_epochs: int = 1
+    shuffle: bool = False
+    storage_type: str = "table"
+    num_minibatches_per_shard: int = 0
+
+
+@dataclass
+class TaskRequest(BaseRequest):
+    dataset_name: str = ""
+
+
+@dataclass
+class ShardTask:
+    task_id: int = -1
+    task_type: str = "training"
+    dataset_name: str = ""
+    shard_name: str = ""
+    start: int = 0
+    end: int = 0
+    record_indices: Optional[List[int]] = None
+
+    @property
+    def exists(self) -> bool:
+        return self.task_id >= 0
+
+
+@dataclass
+class TaskReport(BaseRequest):
+    dataset_name: str = ""
+    task_id: int = -1
+    success: bool = True
+
+
+@dataclass
+class ShardCheckpointRequest(BaseRequest):
+    dataset_name: str = ""
+
+
+@dataclass
+class ShardCheckpoint:
+    content: str = ""
+
+
+@dataclass
+class DatasetEpochRequest(BaseRequest):
+    dataset_name: str = ""
+
+
+# ---------------- metrics / monitoring ----------------
+
+
+@dataclass
+class GlobalStep(BaseRequest):
+    step: int = 0
+    timestamp: float = 0.0
+
+
+@dataclass
+class NodeResourceStats(BaseRequest):
+    cpu_percent: float = 0.0
+    used_memory_mb: int = 0
+    device_stats: List[Dict] = field(default_factory=list)
+
+
+@dataclass
+class ModelInfo(BaseRequest):
+    params_count: int = 0
+    flops_per_step: float = 0.0
+    batch_size: int = 0
+    seq_len: int = 0
+    extra: Dict = field(default_factory=dict)
+
+
+@dataclass
+class NodeFailure(BaseRequest):
+    error_data: str = ""
+    level: str = "process_error"
+    restart_count: int = 0
+
+
+@dataclass
+class NodeHeartbeat(BaseRequest):
+    timestamp: float = 0.0
+
+
+# ---------------- sync service ----------------
+
+
+@dataclass
+class SyncJoin(BaseRequest):
+    sync_name: str = ""
+    worker_rank: int = 0
+
+
+@dataclass
+class SyncFinish(BaseRequest):
+    sync_name: str = ""
+
+
+@dataclass
+class SyncBarrierRequest(BaseRequest):
+    sync_name: str = ""
+    notify: bool = False
+
+
+# ---------------- runtime-tunable parallel config ----------------
+
+
+@dataclass
+class ParallelConfigRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class ParallelConfig:
+    dataloader: Dict = field(default_factory=dict)
+    mesh: Dict = field(default_factory=dict)
+    version: int = 0
+
+
+# ---------------- job / node lifecycle ----------------
+
+
+@dataclass
+class NodeStatusReport(BaseRequest):
+    status: str = ""
+    exit_reason: str = ""
+
+
+@dataclass
+class ClusterVersionRequest(BaseRequest):
+    version_type: str = "local"
+
+
+@dataclass
+class ClusterVersion(BaseRequest):
+    version_type: str = "local"
+    version: int = 0
+
+
+@dataclass
+class JobExitRequest(BaseRequest):
+    success: bool = True
+    reason: str = ""
+
+
+@dataclass
+class Response:
+    success: bool = True
+    reason: str = ""

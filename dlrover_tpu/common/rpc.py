@@ -1,0 +1,141 @@
+"""Master↔agent control-plane transport.
+
+The reference exposes exactly two generic RPCs (``report``/``get``) carrying
+pickled dataclasses over gRPC (``elastic_training.proto``, ``servicer.py``).
+We keep that design — a tiny generic transport plus typed dataclass messages
+(:mod:`dlrover_tpu.common.messages`) — but implement the transport as a
+threaded TCP server with length-prefixed pickles, so no protoc codegen is
+needed and the protocol stays one file.
+
+Security note: the control plane is job-internal (pods of one job / one
+host), same trust model as the reference's pickled-gRPC protocol.
+"""
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+
+_LEN = struct.Struct(">I")
+
+
+def _send(sock: socket.socket, obj: Any):
+    data = pickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(n)
+        if not chunk:
+            raise ConnectionError("socket closed mid-message")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def find_free_port(host: str = "") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class RpcServer:
+    """Threaded request/response server: ``handler(request) -> response``."""
+
+    def __init__(self, port: int, handler: Callable[[Any], Any], host: str = "0.0.0.0"):
+        self._handler = handler
+
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                while True:
+                    try:
+                        request = _recv(sock)
+                    except (ConnectionError, EOFError, OSError):
+                        return
+                    try:
+                        response = (True, outer._handler(request))
+                    except Exception as e:
+                        logger.exception("rpc handler error for %r", type(request))
+                        response = (False, repr(e))
+                    try:
+                        _send(sock, response)
+                    except OSError:
+                        return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="rpc-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RpcClient:
+    """Persistent-connection client with automatic reconnect."""
+
+    def __init__(self, addr: str, timeout: float = 60.0):
+        host, port = addr.rsplit(":", 1)
+        self._addr: Tuple[str, int] = (host, int(port))
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        s = socket.create_connection(self._addr, timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+
+    def call(self, request: Any, timeout: Optional[float] = None) -> Any:
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    self._sock.settimeout(timeout or self._timeout)
+                    _send(self._sock, request)
+                    ok, payload = _recv(self._sock)
+                    break
+                except (ConnectionError, OSError, EOFError):
+                    self._close_locked()
+                    if attempt:
+                        raise
+        if not ok:
+            raise RuntimeError(f"master rejected {type(request).__name__}: {payload}")
+        return payload
+
+    def _close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self):
+        with self._lock:
+            self._close_locked()

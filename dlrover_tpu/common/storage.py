@@ -1,0 +1,103 @@
+"""Checkpoint storage abstraction (parity: reference ``common/storage.py``).
+
+``CheckpointStorage`` is the ABC the async saver persists through;
+``PosixDiskStorage`` is the default (local disk / NFS / GCS-fuse mounts).
+``safe_rename`` + ``commit`` implement the atomic two-phase publish used by
+flash checkpoint.
+"""
+
+import os
+import shutil
+from abc import ABC, abstractmethod
+from typing import Optional
+
+
+class CheckpointStorage(ABC):
+    @abstractmethod
+    def write(self, content, path: str):
+        ...
+
+    @abstractmethod
+    def write_bytes(self, data: bytes, path: str):
+        ...
+
+    @abstractmethod
+    def read(self, path: str, mode: str = "r"):
+        ...
+
+    @abstractmethod
+    def read_bytes(self, path: str) -> bytes:
+        ...
+
+    @abstractmethod
+    def safe_rename(self, src: str, dst: str):
+        ...
+
+    @abstractmethod
+    def safe_makedirs(self, path: str):
+        ...
+
+    @abstractmethod
+    def safe_remove(self, path: str):
+        ...
+
+    @abstractmethod
+    def exists(self, path: str) -> bool:
+        ...
+
+    @abstractmethod
+    def listdir(self, path: str):
+        ...
+
+    def commit(self, step: int, success: bool):
+        """Hook called after a full step's shards are persisted."""
+
+
+class PosixDiskStorage(CheckpointStorage):
+    def write(self, content, path: str):
+        mode = "wb" if isinstance(content, (bytes, bytearray, memoryview)) else "w"
+        tmp = path + ".tmp"
+        with open(tmp, mode) as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def write_bytes(self, data: bytes, path: str):
+        self.write(data, path)
+
+    def read(self, path: str, mode: str = "r"):
+        if not os.path.exists(path):
+            return None
+        with open(path, mode) as f:
+            return f.read()
+
+    def read_bytes(self, path: str) -> Optional[bytes]:
+        return self.read(path, "rb")
+
+    def safe_rename(self, src: str, dst: str):
+        os.replace(src, dst)
+
+    def safe_makedirs(self, path: str):
+        os.makedirs(path, exist_ok=True)
+
+    def safe_remove(self, path: str):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str):
+        if not os.path.isdir(path):
+            return []
+        return sorted(os.listdir(path))
+
+
+def get_checkpoint_storage(storage: Optional[CheckpointStorage] = None):
+    return storage or PosixDiskStorage()

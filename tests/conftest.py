@@ -1,0 +1,27 @@
+"""Test harness: force an 8-device virtual CPU platform before jax imports.
+
+Mirrors the reference's strategy of testing multi-node logic without
+multi-node hardware (SURVEY.md §4): collectives and shardings run on a
+virtual 8-device CPU mesh; control-plane tests use an in-process master.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import uuid
+
+import pytest
+
+
+@pytest.fixture
+def job_name(monkeypatch):
+    """A unique job namespace so socket/shm names never collide."""
+    name = f"test-{uuid.uuid4().hex[:8]}"
+    monkeypatch.setenv("DLROVER_TPU_JOB_NAME", name)
+    return name

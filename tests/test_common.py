@@ -1,0 +1,115 @@
+"""Unit tests for the common substrate: shm, shared objects, storage, rpc."""
+
+import multiprocessing as mp
+import queue
+import uuid
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import messages
+from dlrover_tpu.common.comm import SharedDict, SharedLock, SharedQueue
+from dlrover_tpu.common.rpc import RpcClient, RpcServer, find_free_port
+from dlrover_tpu.common.shared_memory import SharedMemory
+from dlrover_tpu.common.storage import PosixDiskStorage
+
+
+def _shm_child(n):
+    s = SharedMemory(n, create=True, size=256)
+    s.buf[:4] = b"abcd"
+    # die without cleanup
+
+
+class TestSharedMemory:
+    def test_create_attach_persist(self):
+        name = f"shm-{uuid.uuid4().hex[:8]}"
+        shm = SharedMemory(name, create=True, size=1024)
+        arr = np.frombuffer(shm.buf, dtype=np.float32)
+        arr[:10] = np.arange(10, dtype=np.float32)
+        shm.close()  # closing must NOT unlink
+
+        assert SharedMemory.exists(name)
+        shm2 = SharedMemory(name)
+        arr2 = np.frombuffer(shm2.buf, dtype=np.float32)
+        np.testing.assert_array_equal(arr2[:10], np.arange(10, dtype=np.float32))
+        shm2.unlink()
+        assert not SharedMemory.exists(name)
+
+    def test_survives_child_death(self):
+        name = f"shm-{uuid.uuid4().hex[:8]}"
+        p = mp.get_context("spawn").Process(target=_shm_child, args=(name,))
+        p.start()
+        p.join()
+        assert SharedMemory.exists(name)
+        s = SharedMemory(name)
+        assert bytes(s.buf[:4]) == b"abcd"
+        s.unlink()
+
+
+class TestSharedObjects:
+    def test_lock(self, job_name):
+        lock = SharedLock("l1", create=True)
+        client = SharedLock("l1")
+        assert client.acquire()
+        assert lock.locked()
+        assert not client.acquire(blocking=False)
+        assert client.release()
+        assert not lock.locked()
+        lock.close()
+
+    def test_queue(self, job_name):
+        q = SharedQueue("q1", create=True)
+        client = SharedQueue("q1")
+        client.put({"step": 7})
+        assert q.qsize() == 1
+        assert client.get(timeout=5) == {"step": 7}
+        with pytest.raises(queue.Empty):
+            client.get(block=False)
+        q.close()
+
+    def test_dict(self, job_name):
+        d = SharedDict("d1", create=True)
+        client = SharedDict("d1")
+        client.set("a", 1)
+        client.update({"b": [1, 2]})
+        assert client.get("a") == 1
+        assert client.copy() == {"a": 1, "b": [1, 2]}
+        assert client.pop("a") == 1
+        assert client.get("a") is None
+        d.close()
+
+
+class TestStorage:
+    def test_roundtrip_and_atomic_rename(self, tmp_path):
+        st = PosixDiskStorage()
+        p = str(tmp_path / "x.bin")
+        st.write_bytes(b"hello", p)
+        assert st.read_bytes(p) == b"hello"
+        st.safe_rename(p, str(tmp_path / "y.bin"))
+        assert not st.exists(p)
+        assert st.read(str(tmp_path / "y.bin"), "rb") == b"hello"
+        st.safe_makedirs(str(tmp_path / "d" / "e"))
+        assert st.listdir(str(tmp_path / "d")) == ["e"]
+        st.safe_remove(str(tmp_path / "d"))
+        assert not st.exists(str(tmp_path / "d"))
+
+
+class TestRpc:
+    def test_request_response_and_error(self):
+        def handler(req):
+            if isinstance(req, messages.KVStoreGet):
+                return messages.KVStoreSet(key=req.key, value=b"v")
+            raise ValueError("unknown message")
+
+        server = RpcServer(0, handler)
+        server.start()
+        client = RpcClient(f"127.0.0.1:{server.port}")
+        resp = client.call(messages.KVStoreGet(key="k"))
+        assert resp.value == b"v"
+        with pytest.raises(RuntimeError):
+            client.call(messages.JobExitRequest())
+        client.close()
+        server.stop()
+
+    def test_find_free_port(self):
+        assert find_free_port() > 0
