@@ -1,0 +1,229 @@
+"""Typed client for the master control plane.
+
+Parity: reference ``elastic_agent/master_client.py`` — the singleton used by
+both the agent and trainer processes for rendezvous, tasks, kv-store,
+metrics, failures and sync barriers.
+"""
+
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RpcClient
+
+
+class MasterClient:
+    _instance: Optional["MasterClient"] = None
+
+    def __init__(self, master_addr: str, node_id: int = 0,
+                 node_type: str = "worker"):
+        self._client = RpcClient(master_addr)
+        self._node_id = node_id
+        self._node_type = node_type
+        self.master_addr = master_addr
+
+    # ---------------- singleton wiring ----------------
+    @classmethod
+    def singleton_instance(cls) -> "MasterClient":
+        if cls._instance is None:
+            addr = os.getenv(NodeEnv.MASTER_ADDR, "")
+            if not addr:
+                raise RuntimeError(
+                    f"{NodeEnv.MASTER_ADDR} is not set; no master to talk to"
+                )
+            node_id = int(os.getenv(NodeEnv.NODE_ID, 0))
+            cls._instance = cls(addr, node_id)
+        return cls._instance
+
+    @classmethod
+    def reset(cls):
+        cls._instance = None
+
+    def _fill(self, req: m.BaseRequest) -> m.BaseRequest:
+        req.node_id = self._node_id
+        req.node_type = self._node_type
+        return req
+
+    def _call(self, req, timeout: Optional[float] = None):
+        return self._client.call(self._fill(req), timeout=timeout)
+
+    # ---------------- rendezvous ----------------
+    def join_rendezvous(self, rdzv_name: str, node_rank: int,
+                        local_world_size: int = 1) -> int:
+        return self._call(
+            m.JoinRendezvous(
+                rdzv_name=rdzv_name,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+            )
+        )
+
+    def get_comm_world(self, rdzv_name: str) -> Tuple[int, int, Dict[int, int]]:
+        resp: m.CommWorld = self._call(m.CommWorldRequest(rdzv_name=rdzv_name))
+        return resp.round, resp.group, resp.world
+
+    def num_nodes_waiting(self, rdzv_name: str) -> int:
+        return self._call(m.WaitingNodeNumRequest(rdzv_name=rdzv_name))
+
+    def report_rdzv_params(self, min_nodes: int, max_nodes: int,
+                           waiting_timeout: float, node_unit: int):
+        return self._call(
+            m.RendezvousParams(
+                min_nodes=min_nodes,
+                max_nodes=max_nodes,
+                waiting_timeout=waiting_timeout,
+                node_unit=node_unit,
+            )
+        )
+
+    # ---------------- device check ----------------
+    def report_check_result(self, node_rank: int, normal: bool, elapsed: float):
+        return self._call(
+            m.DeviceCheckResult(
+                node_rank=node_rank, normal=normal, elapsed_time=elapsed
+            )
+        )
+
+    def get_fault_nodes(self):
+        resp: m.DiagnosisResult = self._call(m.FaultNodesRequest())
+        return resp.nodes, resp.done
+
+    def get_stragglers(self):
+        resp: m.DiagnosisResult = self._call(m.StragglersRequest())
+        return resp.nodes, resp.done
+
+    # ---------------- kv store ----------------
+    def kv_store_set(self, key: str, value: bytes):
+        return self._call(m.KVStoreSet(key=key, value=value))
+
+    def kv_store_get(self, key: str) -> Optional[bytes]:
+        return self._call(m.KVStoreGet(key=key))
+
+    def kv_store_add(self, key: str, amount: int = 1) -> int:
+        return self._call(m.KVStoreAdd(key=key, amount=amount))
+
+    def kv_store_multi_get(self, keys) -> Dict[str, Optional[bytes]]:
+        return self._call(m.KVStoreMultiGet(keys=tuple(keys)))
+
+    def kv_store_wait(self, keys, timeout: float = 300.0) -> Dict[str, bytes]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            values = self.kv_store_multi_get(keys)
+            if all(v is not None for v in values.values()):
+                return values
+            time.sleep(0.1)
+        raise TimeoutError(f"kv keys {keys} not all set within {timeout}s")
+
+    # ---------------- data sharding ----------------
+    def report_dataset_shard_params(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        storage_type: str = "table",
+    ):
+        return self._call(
+            m.DatasetShardParams(
+                dataset_name=dataset_name,
+                dataset_size=dataset_size,
+                shard_size=shard_size,
+                num_epochs=num_epochs,
+                shuffle=shuffle,
+                storage_type=storage_type,
+            )
+        )
+
+    def get_task(self, dataset_name: str) -> m.ShardTask:
+        return self._call(m.TaskRequest(dataset_name=dataset_name))
+
+    def report_task(self, dataset_name: str, task_id: int, success: bool = True):
+        return self._call(
+            m.TaskReport(dataset_name=dataset_name, task_id=task_id,
+                         success=success)
+        )
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        resp: m.ShardCheckpoint = self._call(
+            m.ShardCheckpointRequest(dataset_name=dataset_name)
+        )
+        return resp.content
+
+    def get_dataset_epoch(self, dataset_name: str) -> int:
+        return self._call(m.DatasetEpochRequest(dataset_name=dataset_name))
+
+    # ---------------- metrics / lifecycle ----------------
+    def report_global_step(self, step: int, timestamp: float = 0.0):
+        return self._call(m.GlobalStep(step=step, timestamp=timestamp or time.time()))
+
+    def report_resource_stats(self, cpu_percent: float, used_memory_mb: int,
+                              device_stats=None):
+        return self._call(
+            m.NodeResourceStats(
+                cpu_percent=cpu_percent,
+                used_memory_mb=used_memory_mb,
+                device_stats=device_stats or [],
+            )
+        )
+
+    def report_model_info(self, params_count: int, flops_per_step: float,
+                          batch_size: int = 0, seq_len: int = 0, extra=None):
+        return self._call(
+            m.ModelInfo(
+                params_count=params_count,
+                flops_per_step=flops_per_step,
+                batch_size=batch_size,
+                seq_len=seq_len,
+                extra=extra or {},
+            )
+        )
+
+    def report_failure(self, error_data: str, level: str = "process_error",
+                       restart_count: int = 0):
+        try:
+            return self._call(
+                m.NodeFailure(
+                    error_data=error_data, level=level,
+                    restart_count=restart_count,
+                )
+            )
+        except Exception as e:
+            logger.warning("failed reporting failure to master: %s", e)
+
+    def report_heartbeat(self):
+        return self._call(m.NodeHeartbeat(timestamp=time.time()))
+
+    def report_node_status(self, status: str, exit_reason: str = ""):
+        return self._call(
+            m.NodeStatusReport(status=status, exit_reason=exit_reason)
+        )
+
+    # ---------------- sync ----------------
+    def join_sync(self, sync_name: str, worker_rank: int = 0) -> bool:
+        return self._call(m.SyncJoin(sync_name=sync_name, worker_rank=worker_rank))
+
+    def sync_finished(self, sync_name: str) -> bool:
+        return self._call(m.SyncFinish(sync_name=sync_name))
+
+    def barrier(self, sync_name: str, notify: bool = False) -> bool:
+        return self._call(m.SyncBarrierRequest(sync_name=sync_name, notify=notify))
+
+    # ---------------- config / exit ----------------
+    def get_paral_config(self) -> m.ParallelConfig:
+        return self._call(m.ParallelConfigRequest())
+
+    def report_job_exit(self, success: bool, reason: str = ""):
+        return self._call(m.JobExitRequest(success=success, reason=reason))
+
+    def close(self):
+        self._client.close()
+
+
+def build_master_client(master_addr: str = "", node_id: int = 0) -> MasterClient:
+    if master_addr:
+        return MasterClient(master_addr, node_id)
+    return MasterClient.singleton_instance()

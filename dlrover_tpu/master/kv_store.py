@@ -1,0 +1,42 @@
+"""Master-hosted key-value store.
+
+Used as the rendezvous/bootstrap store by agents and trainers (parity:
+reference ``master/elastic_training/kv_store_service.py`` +
+``elastic_agent/torch/master_kv_store.py``).
+"""
+
+import threading
+from typing import Dict, Optional, Tuple
+
+
+class KVStoreService:
+    def __init__(self):
+        self._store: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value: bytes):
+        with self._lock:
+            self._store[key] = value
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._store.get(key)
+
+    def add(self, key: str, amount: int) -> int:
+        with self._lock:
+            current = int(self._store.get(key, b"0"))
+            current += amount
+            self._store[key] = str(current).encode()
+            return current
+
+    def multi_get(self, keys: Tuple[str, ...]):
+        with self._lock:
+            return {k: self._store.get(k) for k in keys}
+
+    def delete(self, key: str):
+        with self._lock:
+            self._store.pop(key, None)
+
+    def clear(self):
+        with self._lock:
+            self._store.clear()

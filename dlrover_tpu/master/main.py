@@ -1,0 +1,44 @@
+"""``python -m dlrover_tpu.master.main`` — boot a job master.
+
+Parity: reference ``master/main.py`` + ``args.py``.
+"""
+
+import argparse
+import sys
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.master import JobMaster
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser("dlrover_tpu master")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--node_num", type=int, default=1)
+    parser.add_argument("--job_name", type=str, default="local-job")
+    parser.add_argument(
+        "--platform", type=str, default="local", choices=["local", "k8s", "ray"]
+    )
+    parser.add_argument("--port_file", type=str, default="",
+                        help="write the bound port to this file once serving")
+    return parser.parse_args(argv)
+
+
+def run(args) -> int:
+    master = JobMaster(
+        port=args.port, node_num=args.node_num, job_name=args.job_name
+    )
+    master.prepare()
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(master.port))
+    return master.run()
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    logger.info("starting master with %s", args)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,107 @@
+"""Job master composition and run loop.
+
+Parity: reference ``master/dist_master.py`` + ``local_master.py`` — composes
+the job manager, task manager, both rendezvous managers, speed monitor,
+sync service and the RPC servicer; ``run()`` watches exit conditions
+(all workers done, fatal node failure, no-task-manager-progress).
+"""
+
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.constants import JobStage, RendezvousName
+from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.node_manager import JobManager, LocalJobManager
+from dlrover_tpu.master.rendezvous import (
+    DeviceCheckRendezvousManager,
+    ElasticTrainingRendezvousManager,
+)
+from dlrover_tpu.master.servicer import MasterServicer, create_master_service
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.sync_service import SyncService
+
+
+class JobMaster:
+    def __init__(
+        self,
+        port: int = 0,
+        node_num: int = 1,
+        job_name: str = "local-job",
+        job_manager: Optional[JobManager] = None,
+    ):
+        ctx = get_context()
+        self.job_name = job_name
+        self.speed_monitor = SpeedMonitor(hang_seconds=ctx.hang_detection_seconds)
+        self.job_manager = job_manager or LocalJobManager(node_num=node_num)
+        self.task_manager = TaskManager(self.speed_monitor)
+        self.rdzv_managers = {
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(
+                RendezvousName.TRAINING
+            ),
+            RendezvousName.DEVICE_CHECK: DeviceCheckRendezvousManager(
+                RendezvousName.DEVICE_CHECK
+            ),
+        }
+        for mgr in self.rdzv_managers.values():
+            mgr.update_rdzv_params(
+                node_num, node_num, ctx.rdzv_waiting_timeout, 1
+            )
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService(self.job_manager)
+        self.servicer = MasterServicer(
+            rdzv_managers=self.rdzv_managers,
+            kv_store=self.kv_store,
+            task_manager=self.task_manager,
+            job_manager=self.job_manager,
+            speed_monitor=self.speed_monitor,
+            sync_service=self.sync_service,
+        )
+        self._server = create_master_service(port, self.servicer)
+        self.port = self._server.port
+        self.stage = JobStage.INIT
+        self._stopped = threading.Event()
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def prepare(self):
+        self._server.start()
+        self.stage = JobStage.RUNNING
+        logger.info("master %s serving on port %s", self.job_name, self.port)
+
+    def run(self, poll_interval: float = 1.0) -> int:
+        """Block until the job finishes; returns an exit code."""
+        try:
+            while not self._stopped.is_set():
+                time.sleep(poll_interval)
+                exit_req = self.servicer.job_exit_request()
+                if exit_req is not None:
+                    self.stage = (
+                        JobStage.SUCCEEDED if exit_req.success else JobStage.FAILED
+                    )
+                    break
+                if self.job_manager.all_workers_exited():
+                    self.stage = (
+                        JobStage.SUCCEEDED
+                        if self.job_manager.all_workers_succeeded()
+                        else JobStage.FAILED
+                    )
+                    break
+        finally:
+            self.stop()
+        logger.info("master exiting with stage %s", self.stage)
+        return 0 if self.stage == JobStage.SUCCEEDED else 1
+
+    def stop(self):
+        self._stopped.set()
+        self._server.stop()
+
+
+# Aliases matching the reference composition names.
+LocalJobMaster = JobMaster
+DistributedJobMaster = JobMaster

@@ -1,0 +1,47 @@
+"""Classify reported process/node errors (parity: reference ``monitor/error_monitor.py``)."""
+
+from typing import List, Tuple
+
+from dlrover_tpu.common.constants import NodeExitReason, TrainingExceptionLevel
+from dlrover_tpu.common.log import logger
+
+_OOM_MARKERS = ("out of memory", "oom", "resource_exhausted", "hbm")
+_HARDWARE_MARKERS = (
+    "tpu halted",
+    "device unavailable",
+    "data loss",
+    "uncorrectable ecc",
+    "ici",
+    "deadline exceeded: failed to connect",
+)
+
+
+class ErrorMonitor:
+    def __init__(self):
+        self._errors: List[Tuple[int, str, str]] = []
+
+    def process_error(
+        self, node_id: int, restart_count: int, error_data: str, level: str
+    ) -> bool:
+        """Record the error; return True when it is node-fatal (relaunch node)."""
+        self._errors.append((node_id, level, error_data))
+        reason = self.classify(error_data)
+        logger.info(
+            "node %s reported %s error (restart %s): %s -> %s",
+            node_id, level, restart_count, error_data[:200], reason,
+        )
+        if level == TrainingExceptionLevel.NODE_ERROR:
+            return True
+        return reason in (NodeExitReason.OOM, NodeExitReason.HARDWARE_ERROR)
+
+    @staticmethod
+    def classify(error_data: str) -> str:
+        text = error_data.lower()
+        if any(m in text for m in _OOM_MARKERS):
+            return NodeExitReason.OOM
+        if any(m in text for m in _HARDWARE_MARKERS):
+            return NodeExitReason.HARDWARE_ERROR
+        return NodeExitReason.FATAL_ERROR
+
+    def errors(self):
+        return list(self._errors)

@@ -1,0 +1,205 @@
+"""Node lifecycle management on the master.
+
+Parity: reference ``master/node/dist_job_manager.py`` + ``local_job_manager.py``
+— the master tracks one :class:`~dlrover_tpu.common.node.Node` per agent,
+consumes status reports/heartbeats/failures, decides relaunch vs abort via
+the status flow, and (on a scheduler-backed platform) drives a scaler with
+``ScalePlan``s. The local platform has no scheduler, so relaunch decisions
+only feed rendezvous membership; the agent's own process supervision does
+the respawning.
+"""
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
+from dlrover_tpu.master.status_flow import get_node_state_flow, should_relaunch
+
+
+@dataclass
+class ScalePlan:
+    """A requested change to the node set (parity: ScalePlan CRD)."""
+
+    node_group_resources: Dict[str, NodeGroupResource] = field(default_factory=dict)
+    launch_nodes: List[Node] = field(default_factory=list)
+    remove_nodes: List[Node] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return not (
+            self.node_group_resources or self.launch_nodes or self.remove_nodes
+        )
+
+
+class Scaler:
+    """Platform backend that realizes a ScalePlan (k8s/GKE later)."""
+
+    def scale(self, plan: ScalePlan):
+        raise NotImplementedError
+
+
+class NoopScaler(Scaler):
+    def scale(self, plan: ScalePlan):
+        if not plan.empty():
+            logger.info("noop scaler ignoring plan %s", plan)
+
+
+@dataclass
+class NodeEvent:
+    event_type: str
+    node: Node
+
+
+class JobManager:
+    """Tracks job nodes and reacts to their lifecycle events."""
+
+    def __init__(
+        self,
+        node_num: int = 1,
+        max_relaunch_count: int = 3,
+        scaler: Optional[Scaler] = None,
+        error_monitor: Optional[ErrorMonitor] = None,
+        heartbeat_timeout: float = 120.0,
+    ):
+        self._lock = threading.Lock()
+        self._nodes: Dict[int, Node] = {}
+        self._node_num = node_num
+        self._max_relaunch_count = max_relaunch_count
+        self._scaler = scaler or NoopScaler()
+        self._error_monitor = error_monitor or ErrorMonitor()
+        self._heartbeat_timeout = heartbeat_timeout
+        self._stopped = False
+        self._event_callbacks = []
+        for i in range(node_num):
+            node = Node(
+                NodeType.WORKER, i, max_relaunch_count=max_relaunch_count
+            )
+            self._nodes[i] = node
+
+    # ---------------- queries ----------------
+    def get_node(self, node_id: int) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def all_nodes(self) -> List[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def alive_worker_ranks(self) -> List[int]:
+        with self._lock:
+            return [
+                n.rank_index
+                for n in self._nodes.values()
+                if n.status in (NodeStatus.RUNNING, NodeStatus.PENDING,
+                                NodeStatus.INITIAL)
+            ]
+
+    def all_workers_exited(self) -> bool:
+        with self._lock:
+            return bool(self._nodes) and all(
+                n.exited() for n in self._nodes.values()
+            )
+
+    def all_workers_succeeded(self) -> bool:
+        with self._lock:
+            return bool(self._nodes) and all(
+                n.status == NodeStatus.SUCCEEDED for n in self._nodes.values()
+            )
+
+    def any_node_failed_fatally(self) -> bool:
+        with self._lock:
+            return any(
+                n.status == NodeStatus.FAILED and not n.relaunchable
+                for n in self._nodes.values()
+            )
+
+    # ---------------- event intake ----------------
+    def add_event_callback(self, callback):
+        self._event_callbacks.append(callback)
+
+    def update_node_status(self, node_id: int, status: str, exit_reason: str = ""):
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                node = Node(NodeType.WORKER, node_id,
+                            max_relaunch_count=self._max_relaunch_count)
+                self._nodes[node_id] = node
+            old_status = node.status
+            flow = get_node_state_flow(old_status, status)
+            node.update_status(status)
+            if exit_reason:
+                node.exit_reason = exit_reason
+            relaunch = False
+            if flow.should_relaunch:
+                relaunch = should_relaunch(node, flow, self._max_relaunch_count)
+                if relaunch:
+                    node.inc_relaunch_count()
+            event = NodeEvent(NodeEventType.MODIFIED, node)
+        for cb in self._event_callbacks:
+            try:
+                cb(event)
+            except Exception:
+                logger.exception("node event callback failed")
+        if relaunch:
+            self._relaunch_node(node)
+        return relaunch
+
+    def report_heartbeat(self, node_id: int, timestamp: float):
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node:
+                node.heartbeat_time = timestamp or time.time()
+                if node.status in (NodeStatus.INITIAL, NodeStatus.PENDING):
+                    node.update_status(NodeStatus.RUNNING)
+
+    def process_error(
+        self, node_id: int, restart_count: int, error_data: str, level: str
+    ) -> bool:
+        relaunch_node = self._error_monitor.process_error(
+            node_id, restart_count, error_data, level
+        )
+        if relaunch_node:
+            reason = self._error_monitor.classify(error_data)
+            self.update_node_status(node_id, NodeStatus.FAILED, reason)
+        return relaunch_node
+
+    def _relaunch_node(self, node: Node):
+        logger.info("relaunching node %s (count %s)", node.id, node.relaunch_count)
+        plan = ScalePlan(launch_nodes=[node.get_relaunch_node()],
+                         remove_nodes=[node])
+        self._scaler.scale(plan)
+        with self._lock:
+            fresh = node.get_relaunch_node()
+            fresh.update_status(NodeStatus.PENDING)
+            self._nodes[node.id] = fresh
+
+    # ---------------- hang detection ----------------
+    def find_dead_nodes(self) -> List[int]:
+        """Nodes whose heartbeat went stale."""
+        now = time.time()
+        dead = []
+        with self._lock:
+            for node in self._nodes.values():
+                if (
+                    node.status == NodeStatus.RUNNING
+                    and node.heartbeat_time > 0
+                    and now - node.heartbeat_time > self._heartbeat_timeout
+                ):
+                    dead.append(node.id)
+        return dead
+
+    def stop(self):
+        self._stopped = True
+
+
+class LocalJobManager(JobManager):
+    """Single-host deployment: the agent supervises processes itself."""

@@ -1,0 +1,250 @@
+"""Rendezvous managers: elastic-training membership + device-check diagnosis.
+
+Capability parity with the reference's
+``master/elastic_training/rdzv_manager.py``:
+
+- ``RendezvousManager`` — waiting-node admission with min/max nodes,
+  ``node_unit`` granularity and a last-call timeout; a frozen *round* is the
+  communication world handed to every agent.
+- ``ElasticTrainingRendezvousManager`` — one global group per round.
+- ``DeviceCheckRendezvousManager`` — the 2-round paired-group diagnosis that
+  localizes fault nodes, plus the elapsed-time median×N straggler rule.
+
+TPU specifics: a "node" is one TPU host of a pod slice; the check exercise
+runs JAX collectives over ICI instead of NCCL allgathers, but the master
+side is transport-agnostic — it only sees join/report RPCs.
+"""
+
+import statistics
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional, Set, Tuple
+
+from dlrover_tpu.common.log import logger
+
+
+class RendezvousManager(ABC):
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._min_nodes = 1
+        self._max_nodes = 1
+        self._node_unit = 1
+        self._waiting_timeout = 30.0
+        self._lastcall_timeout = 3.0
+        # node_rank -> local world size, for nodes asking to join.
+        self._waiting_nodes: Dict[int, int] = {}
+        # The frozen world of the current round.
+        self._rdzv_nodes: Dict[int, int] = {}
+        self._rdzv_round = 0
+        self._lastcall_time = 0.0
+        self._alive_nodes: Set[int] = set()
+        self._start_rdzv_time = 0.0
+
+    # ---------------- configuration ----------------
+    def update_rdzv_params(
+        self,
+        min_nodes: int,
+        max_nodes: int,
+        waiting_timeout: float = 30.0,
+        node_unit: int = 1,
+    ):
+        with self._lock:
+            self._min_nodes = min_nodes
+            self._max_nodes = max_nodes
+            self._waiting_timeout = waiting_timeout
+            self._node_unit = max(1, node_unit)
+
+    # ---------------- membership ----------------
+    def add_alive_node(self, node_rank: int):
+        with self._lock:
+            self._alive_nodes.add(node_rank)
+
+    def remove_alive_node(self, node_rank: int):
+        with self._lock:
+            self._alive_nodes.discard(node_rank)
+            if node_rank in self._waiting_nodes:
+                del self._waiting_nodes[node_rank]
+            if node_rank in self._rdzv_nodes:
+                # A member of the active world died: the next join starts a
+                # fresh round and agents observe num_nodes_waiting > 0.
+                logger.info(
+                    "rdzv %s: node %s left active world of round %s",
+                    self.name, node_rank, self._rdzv_round,
+                )
+
+    def join_rendezvous(
+        self, node_rank: int, local_world_size: int = 1
+    ) -> int:
+        """Register intent to join; returns the round being formed."""
+        with self._lock:
+            if node_rank in self._rdzv_nodes and node_rank not in self._waiting_nodes:
+                # Rejoin after restart: previous world is stale.
+                self._rdzv_nodes = {}
+            if not self._waiting_nodes:
+                self._start_rdzv_time = time.monotonic()
+            self._waiting_nodes[node_rank] = local_world_size
+            self._alive_nodes.add(node_rank)
+            self._lastcall_time = time.monotonic()
+            return self._rdzv_round
+
+    def _freeze_ready(self) -> bool:
+        """Called with the lock held: can the waiting set become a round?"""
+        count = len(self._waiting_nodes)
+        if count < max(self._min_nodes, 1):
+            return False
+        if count >= self._max_nodes:
+            return True
+        waited = time.monotonic() - self._start_rdzv_time
+        lastcall = time.monotonic() - self._lastcall_time
+        if waited >= self._waiting_timeout:
+            return True
+        return lastcall >= self._lastcall_timeout and count >= self._min_nodes
+
+    def _freeze_round(self):
+        """Admit a node_unit-aligned subset of the waiting set as the world."""
+        count = len(self._waiting_nodes)
+        admitted = (count // self._node_unit) * self._node_unit
+        if admitted <= 0:
+            return
+        ranks = sorted(self._waiting_nodes)[:admitted]
+        self._rdzv_nodes = {r: self._waiting_nodes[r] for r in ranks}
+        for r in ranks:
+            del self._waiting_nodes[r]
+        self._rdzv_round += 1
+        logger.info(
+            "rdzv %s: froze round %s with nodes %s",
+            self.name, self._rdzv_round, sorted(self._rdzv_nodes),
+        )
+
+    def num_nodes_waiting(self) -> int:
+        """Agents poll this to detect membership changes (>0 => restart)."""
+        with self._lock:
+            return len(self._waiting_nodes)
+
+    @abstractmethod
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, int]]:
+        """Returns (round, group, {node_rank: local_world_size}).
+
+        An empty world means "keep polling" — the round is still forming.
+        """
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    """One global communication world per round."""
+
+    def get_comm_world(self, node_rank: int):
+        with self._lock:
+            if node_rank in self._rdzv_nodes:
+                return self._rdzv_round, 0, dict(self._rdzv_nodes)
+            if self._freeze_ready():
+                self._freeze_round()
+                if node_rank in self._rdzv_nodes:
+                    return self._rdzv_round, 0, dict(self._rdzv_nodes)
+            return self._rdzv_round, 0, {}
+
+
+class DeviceCheckRendezvousManager(RendezvousManager):
+    """Paired-group check rounds for fault/straggler localization.
+
+    Round r=0: nodes are paired sequentially ``(0,1)(2,3)...``; each pair
+    runs an allgather+matmul exercise. A failed pair makes both members
+    suspects. Round r=1: suspects are re-paired with known-good nodes. A
+    node that fails both rounds is the fault node; with only one round of
+    data the diagnosis is not ``done``.
+    """
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._node_status: Dict[int, Dict[int, bool]] = {}  # round -> rank -> ok
+        self._node_times: Dict[int, Dict[int, float]] = {}  # round -> rank -> sec
+        self._check_round = 0
+        self._straggler_ratio = 2.0
+
+    def join_rendezvous(self, node_rank: int, local_world_size: int = 1) -> int:
+        with self._lock:
+            if not self._waiting_nodes:
+                self._start_rdzv_time = time.monotonic()
+            self._waiting_nodes[node_rank] = local_world_size
+            self._alive_nodes.add(node_rank)
+            self._lastcall_time = time.monotonic()
+            return self._rdzv_round
+
+    def get_comm_world(self, node_rank: int):
+        with self._lock:
+            if not self._rdzv_nodes and self._freeze_ready():
+                self._freeze_round()
+                self._check_round += 1
+            if node_rank in self._rdzv_nodes:
+                groups = self._build_groups()
+                for group_idx, members in enumerate(groups):
+                    if node_rank in members:
+                        world = {r: self._rdzv_nodes[r] for r in members}
+                        return self._rdzv_round, group_idx, world
+            return self._rdzv_round, 0, {}
+
+    def _build_groups(self) -> List[List[int]]:
+        """Pair nodes; in later check rounds, shift pairing so a suspect
+        lands with a node that succeeded in the previous round."""
+        ranks = sorted(self._rdzv_nodes)
+        round_idx = self._check_round
+        if round_idx > 1 and len(ranks) > 2:
+            # Rotate by one so every node gets a different partner than in
+            # the previous round (reference: re-pair suspects with good).
+            ranks = ranks[1:] + ranks[:1]
+        groups = []
+        for i in range(0, len(ranks) - 1, 2):
+            groups.append([ranks[i], ranks[i + 1]])
+        if len(ranks) % 2:
+            if groups:
+                groups[-1].append(ranks[-1])
+            else:
+                groups.append([ranks[-1]])
+        return groups
+
+    def report_check_result(self, node_rank: int, normal: bool, elapsed: float):
+        with self._lock:
+            r = self._check_round
+            self._node_status.setdefault(r, {})[node_rank] = normal
+            self._node_times.setdefault(r, {})[node_rank] = elapsed
+            # The reported world is consumed; allow the next check round to
+            # freeze once every member reported.
+            if set(self._node_status[r]) >= set(self._rdzv_nodes):
+                self._rdzv_nodes = {}
+
+    def check_fault_node(self) -> Tuple[List[int], bool]:
+        """Returns (fault node ranks, diagnosis finished)."""
+        with self._lock:
+            rounds = sorted(self._node_status)
+            if not rounds:
+                return [], False
+            last = rounds[-1]
+            current = self._node_status[last]
+            suspects = {r for r, ok in current.items() if not ok}
+            if not suspects:
+                return [], True
+            if len(rounds) < 2:
+                return sorted(suspects), False
+            prev = self._node_status[rounds[-2]]
+            confirmed = [r for r in suspects if not prev.get(r, True)]
+            return sorted(confirmed), True
+
+    def check_straggler(self) -> Tuple[List[int], bool]:
+        """Elapsed-time median×ratio rule (reference rdzv_manager.py:492)."""
+        with self._lock:
+            rounds = sorted(self._node_times)
+            if not rounds:
+                return [], False
+            times = self._node_times[rounds[-1]]
+            if len(times) < 2:
+                return [], True
+            median = statistics.median(times.values())
+            if median <= 0:
+                return [], True
+            stragglers = [
+                r for r, t in times.items() if t > median * self._straggler_ratio
+            ]
+            return sorted(stragglers), True

@@ -1,0 +1,240 @@
+"""The master's single RPC endpoint.
+
+Parity: reference ``master/servicer.py`` — one generic endpoint dispatching
+on message class: rendezvous joins/worlds, device-check reports and
+diagnosis queries, kv-store, dynamic data sharding, metrics, sync barriers,
+failures, and the runtime-tunable parallel config.
+"""
+
+import time
+from typing import Any, Dict
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RpcServer
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        rdzv_managers: Dict[str, Any],
+        kv_store,
+        task_manager,
+        job_manager,
+        speed_monitor,
+        sync_service,
+        metric_collector=None,
+    ):
+        self._rdzv_managers = rdzv_managers
+        self._kv_store = kv_store
+        self._task_manager = task_manager
+        self._job_manager = job_manager
+        self._speed_monitor = speed_monitor
+        self._sync_service = sync_service
+        self._metric_collector = metric_collector
+        self._paral_config = m.ParallelConfig()
+        self._job_exit = None
+        self._start_time = time.time()
+
+    # The transport handler.
+    def handle(self, request: Any) -> Any:
+        handler = self._HANDLERS.get(type(request))
+        if handler is None:
+            raise ValueError(f"unknown control message {type(request).__name__}")
+        return handler(self, request)
+
+    # ---------------- rendezvous ----------------
+    def _join_rendezvous(self, req: m.JoinRendezvous):
+        mgr = self._rdzv_managers[req.rdzv_name]
+        round_ = mgr.join_rendezvous(req.node_rank, req.local_world_size)
+        if req.rdzv_name == RendezvousName.TRAINING and self._job_manager:
+            self._job_manager.report_heartbeat(req.node_id, time.time())
+        return round_
+
+    def _get_comm_world(self, req: m.CommWorldRequest):
+        mgr = self._rdzv_managers[req.rdzv_name]
+        round_, group, world = mgr.get_comm_world(req.node_id)
+        return m.CommWorld(
+            rdzv_name=req.rdzv_name, round=round_, group=group, world=world
+        )
+
+    def _num_nodes_waiting(self, req: m.WaitingNodeNumRequest):
+        return self._rdzv_managers[req.rdzv_name].num_nodes_waiting()
+
+    def _update_rdzv_params(self, req: m.RendezvousParams):
+        for mgr in self._rdzv_managers.values():
+            mgr.update_rdzv_params(
+                req.min_nodes, req.max_nodes, req.waiting_timeout, req.node_unit
+            )
+        return m.Response()
+
+    # ---------------- device check ----------------
+    def _report_check_result(self, req: m.DeviceCheckResult):
+        mgr = self._rdzv_managers[RendezvousName.DEVICE_CHECK]
+        mgr.report_check_result(req.node_rank, req.normal, req.elapsed_time)
+        return m.Response()
+
+    def _get_fault_nodes(self, req: m.FaultNodesRequest):
+        mgr = self._rdzv_managers[RendezvousName.DEVICE_CHECK]
+        nodes, done = mgr.check_fault_node()
+        return m.DiagnosisResult(nodes=nodes, done=done)
+
+    def _get_stragglers(self, req: m.StragglersRequest):
+        mgr = self._rdzv_managers[RendezvousName.DEVICE_CHECK]
+        nodes, done = mgr.check_straggler()
+        return m.DiagnosisResult(nodes=nodes, done=done)
+
+    # ---------------- kv store ----------------
+    def _kv_set(self, req: m.KVStoreSet):
+        self._kv_store.set(req.key, req.value)
+        return m.Response()
+
+    def _kv_get(self, req: m.KVStoreGet):
+        return self._kv_store.get(req.key)
+
+    def _kv_add(self, req: m.KVStoreAdd):
+        return self._kv_store.add(req.key, req.amount)
+
+    def _kv_multi_get(self, req: m.KVStoreMultiGet):
+        return self._kv_store.multi_get(req.keys)
+
+    # ---------------- data sharding ----------------
+    def _new_dataset(self, req: m.DatasetShardParams):
+        self._task_manager.new_dataset(
+            req.dataset_name,
+            req.dataset_size,
+            req.shard_size,
+            req.num_epochs,
+            req.shuffle,
+            req.storage_type,
+        )
+        return m.Response()
+
+    def _get_task(self, req: m.TaskRequest):
+        return self._task_manager.get_task(req.node_id, req.dataset_name)
+
+    def _report_task(self, req: m.TaskReport):
+        ok = self._task_manager.report_task(
+            req.dataset_name, req.task_id, req.success
+        )
+        return m.Response(success=ok)
+
+    def _get_shard_checkpoint(self, req: m.ShardCheckpointRequest):
+        return m.ShardCheckpoint(content=self._task_manager.checkpoint())
+
+    def _get_dataset_epoch(self, req: m.DatasetEpochRequest):
+        return self._task_manager.get_epoch(req.dataset_name)
+
+    # ---------------- metrics ----------------
+    def _report_step(self, req: m.GlobalStep):
+        self._speed_monitor.collect_global_step(
+            req.step, req.timestamp or time.time(), req.node_id
+        )
+        return m.Response()
+
+    def _report_resource(self, req: m.NodeResourceStats):
+        node = self._job_manager.get_node(req.node_id) if self._job_manager else None
+        if node is not None:
+            node.used_resource.cpu = req.cpu_percent
+            node.used_resource.memory_mb = req.used_memory_mb
+        if self._metric_collector:
+            self._metric_collector.collect_node_resource(req)
+        return m.Response()
+
+    def _report_model_info(self, req: m.ModelInfo):
+        if self._metric_collector:
+            self._metric_collector.collect_model_info(req)
+        return m.Response()
+
+    def _report_failure(self, req: m.NodeFailure):
+        if self._job_manager:
+            self._job_manager.process_error(
+                req.node_id, req.restart_count, req.error_data, req.level
+            )
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(req.node_id)
+        if self._task_manager:
+            self._task_manager.recover_worker_tasks(req.node_id)
+        return m.Response()
+
+    def _report_heartbeat(self, req: m.NodeHeartbeat):
+        if self._job_manager:
+            self._job_manager.report_heartbeat(req.node_id, req.timestamp)
+        return m.Response()
+
+    def _report_node_status(self, req: m.NodeStatusReport):
+        if self._job_manager:
+            self._job_manager.update_node_status(
+                req.node_id, req.status, req.exit_reason
+            )
+        if self._task_manager and req.status in ("failed", "deleted"):
+            self._task_manager.recover_worker_tasks(req.node_id)
+        return m.Response()
+
+    # ---------------- sync ----------------
+    def _sync_join(self, req: m.SyncJoin):
+        return self._sync_service.join_sync(req.sync_name, req.worker_rank)
+
+    def _sync_finished(self, req: m.SyncFinish):
+        return self._sync_service.sync_finished(req.sync_name)
+
+    def _sync_barrier(self, req: m.SyncBarrierRequest):
+        if req.notify:
+            return self._sync_service.notify_barrier(req.sync_name)
+        return self._sync_service.barrier_reached(req.sync_name)
+
+    # ---------------- parallel config ----------------
+    def _get_paral_config(self, req: m.ParallelConfigRequest):
+        return self._paral_config
+
+    def set_paral_config(self, config: m.ParallelConfig):
+        config.version = self._paral_config.version + 1
+        self._paral_config = config
+
+    # ---------------- job exit ----------------
+    def _handle_job_exit(self, req: m.JobExitRequest):
+        self._job_exit = req
+        logger.info("job exit requested: success=%s reason=%s",
+                    req.success, req.reason)
+        return m.Response()
+
+    def job_exit_request(self):
+        return self._job_exit
+
+    _HANDLERS = {}
+
+
+MasterServicer._HANDLERS = {
+    m.JoinRendezvous: MasterServicer._join_rendezvous,
+    m.CommWorldRequest: MasterServicer._get_comm_world,
+    m.WaitingNodeNumRequest: MasterServicer._num_nodes_waiting,
+    m.RendezvousParams: MasterServicer._update_rdzv_params,
+    m.DeviceCheckResult: MasterServicer._report_check_result,
+    m.FaultNodesRequest: MasterServicer._get_fault_nodes,
+    m.StragglersRequest: MasterServicer._get_stragglers,
+    m.KVStoreSet: MasterServicer._kv_set,
+    m.KVStoreGet: MasterServicer._kv_get,
+    m.KVStoreAdd: MasterServicer._kv_add,
+    m.KVStoreMultiGet: MasterServicer._kv_multi_get,
+    m.DatasetShardParams: MasterServicer._new_dataset,
+    m.TaskRequest: MasterServicer._get_task,
+    m.TaskReport: MasterServicer._report_task,
+    m.ShardCheckpointRequest: MasterServicer._get_shard_checkpoint,
+    m.DatasetEpochRequest: MasterServicer._get_dataset_epoch,
+    m.GlobalStep: MasterServicer._report_step,
+    m.NodeResourceStats: MasterServicer._report_resource,
+    m.ModelInfo: MasterServicer._report_model_info,
+    m.NodeFailure: MasterServicer._report_failure,
+    m.NodeHeartbeat: MasterServicer._report_heartbeat,
+    m.NodeStatusReport: MasterServicer._report_node_status,
+    m.SyncJoin: MasterServicer._sync_join,
+    m.SyncFinish: MasterServicer._sync_finished,
+    m.SyncBarrierRequest: MasterServicer._sync_barrier,
+    m.ParallelConfigRequest: MasterServicer._get_paral_config,
+    m.JobExitRequest: MasterServicer._handle_job_exit,
+}
+
+
+def create_master_service(port: int, servicer: MasterServicer) -> RpcServer:
+    return RpcServer(port, servicer.handle)

@@ -1,0 +1,171 @@
+"""Dataset splitters for dynamic data sharding.
+
+Parity: reference ``master/shard/dataset_splitter.py`` — a ``Shard`` is a
+record range [start, end) (optionally with explicit per-sample indices); a
+splitter produces the shards of each epoch, supports shuffling, and is
+checkpointable so a restarted job resumes mid-epoch.
+"""
+
+import json
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from dlrover_tpu.common.log import logger
+
+
+@dataclass
+class Shard:
+    name: str
+    start: int
+    end: int
+    record_indices: Optional[List[int]] = None
+
+    @property
+    def size(self) -> int:
+        return self.end - self.start
+
+
+class DatasetSplitter:
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int = 1):
+        self.dataset_name = dataset_name
+        self.dataset_size = dataset_size
+        self.shard_size = shard_size
+        self.num_epochs = num_epochs
+        self.epoch = 0
+
+    def create_shards(self) -> List[Shard]:
+        raise NotImplementedError
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self.num_epochs
+
+    def checkpoint(self) -> dict:
+        return {
+            "dataset_name": self.dataset_name,
+            "dataset_size": self.dataset_size,
+            "shard_size": self.shard_size,
+            "num_epochs": self.num_epochs,
+            "epoch": self.epoch,
+        }
+
+    def restore(self, state: dict):
+        self.epoch = state.get("epoch", 0)
+
+
+class TableDatasetSplitter(DatasetSplitter):
+    """Range shards over a table-like dataset (row ranges)."""
+
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int = 1, shuffle: bool = False):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self.shuffle = shuffle
+
+    def create_shards(self) -> List[Shard]:
+        shards = []
+        num = (self.dataset_size + self.shard_size - 1) // self.shard_size
+        for i in range(num):
+            start = i * self.shard_size
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(
+                Shard(name=f"{self.dataset_name}-e{self.epoch}-s{i}",
+                      start=start, end=end)
+            )
+        if self.shuffle:
+            random.shuffle(shards)
+        self.epoch += 1
+        logger.info(
+            "dataset %s: epoch %s -> %s shards", self.dataset_name, self.epoch, num
+        )
+        return shards
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Shards carrying explicit (optionally shuffled) sample indices."""
+
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int = 1, shuffle: bool = False):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self.shuffle = shuffle
+
+    def create_shards(self) -> List[Shard]:
+        indices = list(range(self.dataset_size))
+        if self.shuffle:
+            random.shuffle(indices)
+        shards = []
+        for i in range(0, self.dataset_size, self.shard_size):
+            chunk = indices[i : i + self.shard_size]
+            shards.append(
+                Shard(
+                    name=f"{self.dataset_name}-e{self.epoch}-s{i // self.shard_size}",
+                    start=i,
+                    end=i + len(chunk),
+                    record_indices=chunk,
+                )
+            )
+        self.epoch += 1
+        return shards
+
+
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Open-ended stream: shards are generated as offsets advance.
+
+    Parity: reference ``dataset_splitter.py:359`` — dataset_size < 0 means
+    unbounded; the splitter hands out fixed-size ranges from a moving
+    offset and checkpoints the offset.
+    """
+
+    def __init__(self, dataset_name: str, shard_size: int,
+                 dataset_size: int = -1, fetch_batch: int = 16):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs=1)
+        self._offset = 0
+        self._fetch_batch = fetch_batch
+
+    def create_shards(self) -> List[Shard]:
+        shards = []
+        for _ in range(self._fetch_batch):
+            if 0 <= self.dataset_size <= self._offset:
+                break
+            end = self._offset + self.shard_size
+            if self.dataset_size >= 0:
+                end = min(end, self.dataset_size)
+            shards.append(
+                Shard(
+                    name=f"{self.dataset_name}-o{self._offset}",
+                    start=self._offset,
+                    end=end,
+                )
+            )
+            self._offset = end
+        if 0 <= self.dataset_size <= self._offset:
+            self.epoch = 1  # exhausted
+        return shards
+
+    def checkpoint(self) -> dict:
+        state = super().checkpoint()
+        state["offset"] = self._offset
+        return state
+
+    def restore(self, state: dict):
+        super().restore(state)
+        self._offset = state.get("offset", 0)
+
+
+def create_dataset_splitter(
+    dataset_name: str,
+    dataset_size: int,
+    shard_size: int,
+    num_epochs: int = 1,
+    shuffle: bool = False,
+    storage_type: str = "table",
+) -> DatasetSplitter:
+    if storage_type == "text":
+        return TextDatasetSplitter(
+            dataset_name, dataset_size, shard_size, num_epochs, shuffle
+        )
+    if storage_type == "stream":
+        return StreamingDatasetSplitter(dataset_name, shard_size, dataset_size)
+    return TableDatasetSplitter(
+        dataset_name, dataset_size, shard_size, num_epochs, shuffle
+    )

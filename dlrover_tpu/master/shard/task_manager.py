@@ -1,0 +1,204 @@
+"""Dispatch dataset shards as tasks; recover tasks of failed workers.
+
+Parity: reference ``master/shard/task_manager.py`` + ``batch_dataset_manager.py``
+— todo/doing bookkeeping per dataset, worker-failure task recovery
+(``task_manager.py:165``), epoch advancement, and shard checkpoints so a
+restarted master resumes mid-epoch.
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.messages import ShardTask
+from dlrover_tpu.master.shard.splitter import (
+    DatasetSplitter,
+    Shard,
+    create_dataset_splitter,
+)
+
+
+@dataclass
+class DoingTask:
+    task: ShardTask
+    worker_id: int
+    start_time: float
+
+
+class DatasetManager:
+    """Todo/doing queues for one dataset."""
+
+    def __init__(self, splitter: DatasetSplitter):
+        self.splitter = splitter
+        self.todo: Deque[ShardTask] = deque()
+        self.doing: Dict[int, DoingTask] = {}
+        self._task_id = 0
+        self._completed_tasks = 0
+
+    def _refill(self):
+        if self.todo or self.splitter.epoch_finished():
+            return
+        for shard in self.splitter.create_shards():
+            self.todo.append(self._new_task(shard))
+
+    def _new_task(self, shard: Shard) -> ShardTask:
+        task = ShardTask(
+            task_id=self._task_id,
+            dataset_name=self.splitter.dataset_name,
+            shard_name=shard.name,
+            start=shard.start,
+            end=shard.end,
+            record_indices=shard.record_indices,
+        )
+        self._task_id += 1
+        return task
+
+    def get_task(self, worker_id: int) -> ShardTask:
+        self._refill()
+        if not self.todo:
+            return ShardTask()  # no task: dataset exhausted for now
+        task = self.todo.popleft()
+        self.doing[task.task_id] = DoingTask(task, worker_id, time.time())
+        return task
+
+    def report_task(self, task_id: int, success: bool) -> bool:
+        doing = self.doing.pop(task_id, None)
+        if doing is None:
+            return False
+        if success:
+            self._completed_tasks += 1
+        else:
+            self.todo.appendleft(doing.task)
+        return True
+
+    def recover_worker_tasks(self, worker_id: int) -> int:
+        """Return a failed worker's in-flight shards to the todo queue."""
+        stale = [tid for tid, d in self.doing.items() if d.worker_id == worker_id]
+        for tid in stale:
+            self.todo.appendleft(self.doing.pop(tid).task)
+        return len(stale)
+
+    def completed(self) -> bool:
+        return (
+            self.splitter.epoch_finished()
+            and not self.todo
+            and not self.doing
+        )
+
+    @property
+    def epoch(self) -> int:
+        return self.splitter.epoch
+
+    def checkpoint(self) -> dict:
+        return {
+            "splitter": self.splitter.checkpoint(),
+            "todo": [
+                {"start": t.start, "end": t.end, "shard_name": t.shard_name}
+                for t in self.todo
+            ]
+            + [
+                {"start": d.task.start, "end": d.task.end,
+                 "shard_name": d.task.shard_name}
+                for d in self.doing.values()
+            ],
+        }
+
+    def restore(self, state: dict):
+        self.splitter.restore(state.get("splitter", {}))
+        self.todo.clear()
+        self.doing.clear()
+        for item in state.get("todo", []):
+            shard = Shard(
+                name=item.get("shard_name", ""),
+                start=item["start"],
+                end=item["end"],
+            )
+            self.todo.append(self._new_task(shard))
+
+
+class TaskManager:
+    """All datasets of a job + the worker-failure recovery hook."""
+
+    def __init__(self, speed_monitor=None):
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, DatasetManager] = {}
+        self._speed_monitor = speed_monitor
+        self._worker_last_task: Dict[int, float] = {}
+
+    def new_dataset(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        storage_type: str = "table",
+    ):
+        with self._lock:
+            if dataset_name in self._datasets:
+                return
+            splitter = create_dataset_splitter(
+                dataset_name, dataset_size, shard_size, num_epochs, shuffle,
+                storage_type,
+            )
+            self._datasets[dataset_name] = DatasetManager(splitter)
+            logger.info("registered dataset %s (size=%s shard=%s epochs=%s)",
+                        dataset_name, dataset_size, shard_size, num_epochs)
+
+    def has_dataset(self, dataset_name: str) -> bool:
+        with self._lock:
+            return dataset_name in self._datasets
+
+    def get_task(self, worker_id: int, dataset_name: str) -> ShardTask:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return ShardTask()
+            self._worker_last_task[worker_id] = time.time()
+            return ds.get_task(worker_id)
+
+    def report_task(self, dataset_name: str, task_id: int, success: bool) -> bool:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            return ds.report_task(task_id, success) if ds else False
+
+    def recover_worker_tasks(self, worker_id: int):
+        with self._lock:
+            for name, ds in self._datasets.items():
+                n = ds.recover_worker_tasks(worker_id)
+                if n:
+                    logger.info(
+                        "recovered %s tasks of worker %s on dataset %s",
+                        n, worker_id, name,
+                    )
+
+    def finished(self) -> bool:
+        with self._lock:
+            return bool(self._datasets) and all(
+                ds.completed() for ds in self._datasets.values()
+            )
+
+    def get_epoch(self, dataset_name: str) -> int:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            return ds.epoch if ds else 0
+
+    def checkpoint(self) -> str:
+        with self._lock:
+            return json.dumps(
+                {name: ds.checkpoint() for name, ds in self._datasets.items()}
+            )
+
+    def restore(self, content: str):
+        if not content:
+            return
+        state = json.loads(content)
+        with self._lock:
+            for name, ds_state in state.items():
+                ds = self._datasets.get(name)
+                if ds:
+                    ds.restore(ds_state)

@@ -1,0 +1,201 @@
+"""Master control-plane tests against an in-process master (SURVEY.md §4.1/4.3)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeStatus, RendezvousName
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.rendezvous import (
+    DeviceCheckRendezvousManager,
+    ElasticTrainingRendezvousManager,
+)
+
+
+@pytest.fixture
+def master():
+    master = JobMaster(port=0, node_num=2, job_name="test-job")
+    master.prepare()
+    yield master
+    master.stop()
+
+
+@pytest.fixture
+def client(master):
+    c = MasterClient(master.addr, node_id=0)
+    yield c
+    c.close()
+
+
+class TestRendezvousManager:
+    def test_training_rdzv_freeze_on_max(self):
+        mgr = ElasticTrainingRendezvousManager("t")
+        mgr.update_rdzv_params(2, 2, waiting_timeout=10)
+        assert mgr.join_rendezvous(0, 4) == 0
+        _, _, world = mgr.get_comm_world(0)
+        assert world == {}  # only one of two nodes waiting
+        mgr.join_rendezvous(1, 4)
+        round_, group, world = mgr.get_comm_world(0)
+        assert round_ == 1 and world == {0: 4, 1: 4}
+        # Second node sees the same frozen world.
+        _, _, world1 = mgr.get_comm_world(1)
+        assert world1 == world
+        assert mgr.num_nodes_waiting() == 0
+
+    def test_training_rdzv_min_nodes_lastcall(self):
+        mgr = ElasticTrainingRendezvousManager("t")
+        mgr.update_rdzv_params(1, 4, waiting_timeout=0.2)
+        mgr._lastcall_timeout = 0.1
+        mgr.join_rendezvous(0, 8)
+        time.sleep(0.25)
+        round_, _, world = mgr.get_comm_world(0)
+        assert world == {0: 8}
+
+    def test_node_unit_alignment(self):
+        mgr = ElasticTrainingRendezvousManager("t")
+        mgr.update_rdzv_params(1, 4, waiting_timeout=0.1, node_unit=2)
+        for r in range(3):
+            mgr.join_rendezvous(r, 1)
+        time.sleep(0.15)
+        _, _, world = mgr.get_comm_world(0)
+        # 3 waiting, unit 2 -> only 2 admitted.
+        assert sorted(world) == [0, 1]
+        assert mgr.num_nodes_waiting() == 1
+
+    def test_membership_change_on_death(self):
+        mgr = ElasticTrainingRendezvousManager("t")
+        mgr.update_rdzv_params(2, 2, waiting_timeout=5)
+        mgr.join_rendezvous(0, 1)
+        mgr.join_rendezvous(1, 1)
+        mgr.get_comm_world(0)
+        mgr.remove_alive_node(1)
+        # Node 1 respawns and rejoins -> waiting count observable by agents.
+        mgr.join_rendezvous(1, 1)
+        assert mgr.num_nodes_waiting() > 0
+
+
+class TestDeviceCheckManager:
+    def _form(self, mgr, n):
+        mgr.update_rdzv_params(n, n, waiting_timeout=5)
+        for r in range(n):
+            mgr.join_rendezvous(r, 1)
+
+    def test_pair_groups_and_fault_localization(self):
+        mgr = DeviceCheckRendezvousManager("check")
+        self._form(mgr, 4)
+        groups = {}
+        for r in range(4):
+            _, g, world = mgr.get_comm_world(r)
+            assert world, f"node {r} must be in a group"
+            groups.setdefault(g, set()).update(world)
+        assert sorted(len(v) for v in groups.values()) == [2, 2]
+
+        # Round 1: node 3's pair fails -> suspects {2, 3}, not done.
+        for r in range(4):
+            ok = r not in (2, 3)
+            mgr.report_check_result(r, ok, elapsed=1.0)
+        fault, done = mgr.check_fault_node()
+        assert set(fault) == {2, 3} and not done
+
+        # Round 2: re-pair; only node 3 fails again -> confirmed fault.
+        self._form(mgr, 4)
+        for r in range(4):
+            _, g, world = mgr.get_comm_world(r)
+            assert world
+        for r in range(4):
+            mgr.report_check_result(r, r != 3, elapsed=1.0)
+        fault, done = mgr.check_fault_node()
+        assert fault == [3] and done
+
+    def test_straggler_median_rule(self):
+        mgr = DeviceCheckRendezvousManager("check")
+        self._form(mgr, 4)
+        for r in range(4):
+            mgr.get_comm_world(r)
+        times = {0: 1.0, 1: 1.1, 2: 0.9, 3: 5.0}
+        for r, t in times.items():
+            mgr.report_check_result(r, True, elapsed=t)
+        stragglers, done = mgr.check_straggler()
+        assert stragglers == [3] and done
+
+
+class TestMasterEndToEnd:
+    def test_kv_store(self, client):
+        client.kv_store_set("a", b"1")
+        assert client.kv_store_get("a") == b"1"
+        assert client.kv_store_get("missing") is None
+        assert client.kv_store_add("ctr", 2) == 2
+        assert client.kv_store_add("ctr", 3) == 5
+        got = client.kv_store_multi_get(["a", "ctr"])
+        assert got == {"a": b"1", "ctr": b"5"}
+
+    def test_rendezvous_rpc(self, master):
+        c0 = MasterClient(master.addr, node_id=0)
+        c1 = MasterClient(master.addr, node_id=1)
+        c0.report_rdzv_params(2, 2, 10.0, 1)
+        c0.join_rendezvous(RendezvousName.TRAINING, 0, 4)
+        c1.join_rendezvous(RendezvousName.TRAINING, 1, 4)
+        round_, group, world = c0.get_comm_world(RendezvousName.TRAINING)
+        assert world == {0: 4, 1: 4}
+        c0.close(), c1.close()
+
+    def test_dynamic_sharding_with_worker_failure(self, master):
+        c0 = MasterClient(master.addr, node_id=0)
+        c1 = MasterClient(master.addr, node_id=1)
+        c0.report_dataset_shard_params("ds", dataset_size=100, shard_size=10,
+                                       num_epochs=1)
+        t0 = c0.get_task("ds")
+        t1 = c1.get_task("ds")
+        assert t0.exists and t1.exists and t0.start != t1.start
+        c0.report_task("ds", t0.task_id, success=True)
+        # Worker 1 dies with its task in flight.
+        c1.report_failure("worker died", level="node_error")
+        # Its shard must come back; drain everything.
+        seen = {(t0.start, t0.end)}
+        while True:
+            t = c0.get_task("ds")
+            if not t.exists:
+                break
+            seen.add((t.start, t.end))
+            c0.report_task("ds", t.task_id, success=True)
+        assert (t1.start, t1.end) in seen
+        assert len(seen) == 10
+        c0.close(), c1.close()
+
+    def test_metrics_sync_and_status(self, master, client):
+        client.report_global_step(10)
+        assert master.speed_monitor.global_step == 10
+        client.report_heartbeat()
+        assert client.join_sync("warmup", 0) in (True, False)
+        client.barrier("b1", notify=True)
+        assert client.barrier("b1") is True
+        client.report_node_status(NodeStatus.RUNNING)
+        node = master.job_manager.get_node(0)
+        assert node.status == NodeStatus.RUNNING
+
+    def test_job_exit(self, master, client):
+        client.report_job_exit(success=True, reason="done")
+        assert master.run(poll_interval=0.05) == 0
+
+
+class TestShardCheckpoint:
+    def test_checkpoint_restore_roundtrip(self, master, client):
+        client.report_dataset_shard_params("ds2", dataset_size=40, shard_size=10)
+        t = client.get_task("ds2")
+        assert t.exists
+        content = client.get_shard_checkpoint("ds2")
+        assert "ds2" in content
+        # Restore into a fresh task manager: the in-flight shard is back.
+        from dlrover_tpu.master.shard.task_manager import TaskManager
+        tm = TaskManager()
+        tm.new_dataset("ds2", 40, 10)
+        tm.restore(content)
+        starts = set()
+        while True:
+            task = tm.get_task(0, "ds2")
+            if not task.exists:
+                break
+            starts.add(task.start)
+            tm.report_task("ds2", task.task_id, True)
+        assert t.start in starts
