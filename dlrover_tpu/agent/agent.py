@@ -1,0 +1,324 @@
+"""The per-host elastic agent.
+
+Capability parity with the reference's ``elastic_agent/torch/training.py``:
+
+- ``ElasticLaunchConfig`` — launch knobs (min/max nodes, procs per node,
+  device check, restarts, straggler policy).
+- ``MasterRendezvousHandler`` — rendezvous *through the master* (join RPC +
+  comm-world polling), not through a c10d store.
+- ``ElasticTrainingAgent`` — spawns one training process per local worker,
+  assigns global ranks from the frozen world, monitors processes, reports
+  failures, flushes the shm flash-checkpoint on death, and restarts workers
+  on failure or membership change.
+
+TPU specifics: workers are JAX processes; the agent hands each one
+``DLROVER_TPU_COORDINATOR_ADDR`` / ``PROCESS_ID`` / ``NUM_PROCESSES`` so the
+trainer's :func:`dlrover_tpu.train.init_training` can call
+``jax.distributed.initialize``. The JAX runtime cannot change world size
+in-process, so every recovery is a worker restart + flash-checkpoint
+restore — the same model the reference uses for NCCL.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    NodeStatus,
+    RendezvousName,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import find_free_port
+
+
+@dataclass
+class ElasticLaunchConfig:
+    min_nodes: int = 1
+    max_nodes: int = 1
+    nproc_per_node: int = 1
+    node_rank: int = 0
+    job_name: str = "local-job"
+    rdzv_timeout: float = 600.0
+    waiting_timeout: float = 30.0
+    monitor_interval: float = 1.0
+    max_restarts: int = 3
+    network_check: bool = False
+    exclude_straggler: bool = False
+    node_unit: int = 1
+    log_dir: str = ""
+    # Extra env vars for every worker.
+    worker_env: Dict[str, str] = field(default_factory=dict)
+
+
+class RendezvousOutcome:
+    def __init__(self, round_: int, world: Dict[int, int], node_rank: int,
+                 coordinator_addr: str):
+        self.round = round_
+        self.world = world  # node_rank -> local_world_size
+        self.node_rank = node_rank
+        self.coordinator_addr = coordinator_addr
+        ranks = sorted(world)
+        self.node_index = ranks.index(node_rank)
+        self.num_nodes = len(ranks)
+        self.world_size = sum(world.values())
+        self.rank_offset = sum(world[r] for r in ranks[: self.node_index])
+
+
+class MasterRendezvousHandler:
+    """Rendezvous via master RPCs (parity: training.py:137)."""
+
+    def __init__(self, client: MasterClient, rdzv_name: str, node_rank: int,
+                 local_world_size: int, timeout: float = 600.0):
+        self._client = client
+        self._name = rdzv_name
+        self._node_rank = node_rank
+        self._local_world_size = local_world_size
+        self._timeout = timeout
+
+    def next_rendezvous(self) -> RendezvousOutcome:
+        self._client.join_rendezvous(
+            self._name, self._node_rank, self._local_world_size
+        )
+        deadline = time.monotonic() + self._timeout
+        while time.monotonic() < deadline:
+            round_, _, world = self._client.get_comm_world(self._name)
+            if world and self._node_rank in world:
+                coordinator = self._setup_coordinator(round_, world)
+                return RendezvousOutcome(
+                    round_, world, self._node_rank, coordinator
+                )
+            if world and self._node_rank not in world:
+                # Frozen without us (node_unit clipping): rejoin next round.
+                self._client.join_rendezvous(
+                    self._name, self._node_rank, self._local_world_size
+                )
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"rendezvous {self._name} did not complete within {self._timeout}s"
+        )
+
+    def _setup_coordinator(self, round_: int, world: Dict[int, int]) -> str:
+        """The lowest node rank hosts the JAX coordinator; its address is
+        published through the master kv-store, keyed by round."""
+        key = f"coordinator/{self._name}/{round_}"
+        first = sorted(world)[0]
+        if self._node_rank == first:
+            host = os.getenv("DLROVER_TPU_HOST_IP", "127.0.0.1")
+            addr = f"{host}:{find_free_port()}"
+            self._client.kv_store_set(key, addr.encode())
+            return addr
+        return self._client.kv_store_wait([key], timeout=60.0)[key].decode()
+
+
+class WorkerSpec:
+    def __init__(self, entrypoint: str, args: List[str]):
+        self.entrypoint = entrypoint
+        self.args = args
+
+
+class ElasticTrainingAgent:
+    """Spawn/supervise local training processes (parity: training.py:318)."""
+
+    def __init__(self, config: ElasticLaunchConfig, spec: WorkerSpec,
+                 client: Optional[MasterClient] = None):
+        self._config = config
+        self._spec = spec
+        self._client = client or MasterClient.singleton_instance()
+        self._workers: List[subprocess.Popen] = []
+        self._restart_count = 0
+        self._ckpt_saver = None  # wired by start_saver()
+        self._stopped = False
+
+    # ---------------- checkpoint saver hook ----------------
+    def start_saver(self):
+        """Start the async flash-checkpoint saver thread in this process."""
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+        AsyncCheckpointSaver.start_async_saving_ckpt()
+
+    def _save_shm_to_storage(self):
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+        saver = AsyncCheckpointSaver.get_ckpt_saver()
+        if saver is not None:
+            try:
+                saver.save_shm_to_storage()
+            except Exception:
+                logger.exception("flash-checkpoint crash flush failed")
+
+    # ---------------- run loop ----------------
+    def run(self) -> int:
+        self._client.report_rdzv_params(
+            self._config.min_nodes,
+            self._config.max_nodes,
+            self._config.waiting_timeout,
+            self._config.node_unit,
+        )
+        if self._config.network_check:
+            from dlrover_tpu.agent.device_check import run_device_check
+
+            ok = run_device_check(self._config, self._client)
+            if not ok:
+                logger.error("device check flagged this node as faulty")
+                self._client.report_node_status(
+                    NodeStatus.FAILED, "hardware-error"
+                )
+                return 1
+        self.start_saver()
+        while self._restart_count <= self._config.max_restarts:
+            outcome = self._rendezvous()
+            self._start_workers(outcome)
+            result = self._monitor_workers()
+            self._stop_workers()
+            if result == "succeeded":
+                self._client.report_node_status(NodeStatus.SUCCEEDED)
+                return 0
+            if result == "failed":
+                self._restart_count += 1
+                logger.info(
+                    "workers failed; restart %s/%s",
+                    self._restart_count, self._config.max_restarts,
+                )
+            elif result == "membership_changed":
+                logger.info("membership changed; re-forming rendezvous")
+            elif result == "stopped":
+                return 1
+        self._client.report_node_status(NodeStatus.FAILED, "fatal-error")
+        return 1
+
+    def _rendezvous(self) -> RendezvousOutcome:
+        handler = MasterRendezvousHandler(
+            self._client,
+            RendezvousName.TRAINING,
+            self._config.node_rank,
+            self._config.nproc_per_node,
+            self._config.rdzv_timeout,
+        )
+        outcome = handler.next_rendezvous()
+        logger.info(
+            "rendezvous round %s: %s nodes, world size %s, coordinator %s",
+            outcome.round, outcome.num_nodes, outcome.world_size,
+            outcome.coordinator_addr,
+        )
+        return outcome
+
+    def _worker_env(self, outcome: RendezvousOutcome, local_rank: int) -> Dict:
+        env = dict(os.environ)
+        env.update(self._config.worker_env)
+        env.update(
+            {
+                NodeEnv.JOB_NAME: self._config.job_name,
+                NodeEnv.MASTER_ADDR: self._client.master_addr,
+                NodeEnv.NODE_ID: str(self._config.node_rank),
+                NodeEnv.NODE_RANK: str(self._config.node_rank),
+                NodeEnv.NODE_NUM: str(outcome.num_nodes),
+                NodeEnv.COORDINATOR_ADDR: outcome.coordinator_addr,
+                NodeEnv.PROCESS_ID: str(outcome.rank_offset + local_rank),
+                NodeEnv.NUM_PROCESSES: str(outcome.world_size),
+                NodeEnv.LOCAL_RANK: str(local_rank),
+                NodeEnv.LOCAL_WORLD_SIZE: str(self._config.nproc_per_node),
+                NodeEnv.RESTART_COUNT: str(self._restart_count),
+            }
+        )
+        return env
+
+    def _start_workers(self, outcome: RendezvousOutcome):
+        self._workers = []
+        for local_rank in range(self._config.nproc_per_node):
+            env = self._worker_env(outcome, local_rank)
+            cmd = [sys.executable, self._spec.entrypoint, *self._spec.args]
+            stdout = stderr = None
+            if self._config.log_dir:
+                os.makedirs(self._config.log_dir, exist_ok=True)
+                rank = outcome.rank_offset + local_rank
+                stdout = open(
+                    os.path.join(self._config.log_dir, f"rank{rank}.log"), "ab"
+                )
+                stderr = subprocess.STDOUT
+            proc = subprocess.Popen(
+                cmd, env=env, stdout=stdout, stderr=stderr,
+                start_new_session=True,
+            )
+            self._workers.append(proc)
+        self._client.report_node_status(NodeStatus.RUNNING)
+        logger.info("started %s worker processes", len(self._workers))
+
+    def _monitor_workers(self) -> str:
+        while not self._stopped:
+            time.sleep(self._config.monitor_interval)
+            codes = [p.poll() for p in self._workers]
+            if any(c is not None and c != 0 for c in codes):
+                failed = [
+                    (i, c) for i, c in enumerate(codes) if c not in (None, 0)
+                ]
+                logger.error("worker processes failed: %s", failed)
+                self._client.report_failure(
+                    f"worker exit codes {failed}",
+                    level=TrainingExceptionLevel.PROCESS_ERROR,
+                    restart_count=self._restart_count,
+                )
+                self._save_shm_to_storage()
+                return "failed"
+            if all(c == 0 for c in codes):
+                return "succeeded"
+            try:
+                self._client.report_heartbeat()
+                waiting = self._client.num_nodes_waiting(RendezvousName.TRAINING)
+            except Exception as e:
+                logger.warning("master unreachable from monitor loop: %s", e)
+                continue
+            if waiting > 0:
+                self._save_shm_to_storage()
+                return "membership_changed"
+        return "stopped"
+
+    def _stop_workers(self, timeout: float = 15.0):
+        for p in self._workers:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.monotonic() + timeout
+        for p in self._workers:
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                p.wait()
+        self._workers = []
+
+    def stop(self):
+        self._stopped = True
+        self._stop_workers()
+
+
+def launch_agent(config: ElasticLaunchConfig, entrypoint: str,
+                 args: List[str]) -> int:
+    """Entry used by the CLI (parity: training.py:655)."""
+    spec = WorkerSpec(entrypoint, args)
+    client = MasterClient.singleton_instance()
+    agent = ElasticTrainingAgent(config, spec, client)
+
+    def _on_sigterm(signum, frame):
+        logger.info("agent received signal %s; flushing checkpoint", signum)
+        agent._save_shm_to_storage()
+        agent.stop()
+        sys.exit(143)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    try:
+        return agent.run()
+    finally:
+        agent.stop()

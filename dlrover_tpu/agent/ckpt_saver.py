@@ -1,0 +1,25 @@
+"""Agent-side async flash-checkpoint saver (full engine lands in train/checkpoint).
+
+Placeholder registry so the agent can flush on crash before phase 4 wires
+the real saver hierarchy.
+"""
+
+import threading
+from typing import Optional
+
+
+class AsyncCheckpointSaver:
+    _saver: Optional["AsyncCheckpointSaver"] = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def start_async_saving_ckpt(cls):
+        """Start the factory thread waiting for trainer saver registrations."""
+        # Full implementation arrives with the flash-checkpoint phase.
+
+    @classmethod
+    def get_ckpt_saver(cls) -> Optional["AsyncCheckpointSaver"]:
+        return cls._saver
+
+    def save_shm_to_storage(self):
+        """Persist the last shm snapshot (crash flush)."""

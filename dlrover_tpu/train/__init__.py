@@ -1,0 +1,54 @@
+"""Trainer-side library: process bootstrap, flash checkpoint, elastic data."""
+
+import os
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import logger
+
+
+def init_training(coordinator_addr: Optional[str] = None,
+                  num_processes: Optional[int] = None,
+                  process_id: Optional[int] = None):
+    """Initialize JAX distributed from the agent's env handoff.
+
+    The elastic agent exports ``DLROVER_TPU_COORDINATOR_ADDR`` /
+    ``NUM_PROCESSES`` / ``PROCESS_ID`` for every worker; this is the analog
+    of torchrun's env contract feeding ``init_process_group`` (reference
+    ``training.py:433``), lowered to ``jax.distributed.initialize``.
+
+    No-op for single-process jobs so the same script runs standalone.
+    """
+    import jax
+
+    coordinator = coordinator_addr or os.getenv(NodeEnv.COORDINATOR_ADDR, "")
+    n = num_processes or int(os.getenv(NodeEnv.NUM_PROCESSES, "1"))
+    pid = process_id if process_id is not None else int(
+        os.getenv(NodeEnv.PROCESS_ID, "0")
+    )
+    if n <= 1 or not coordinator:
+        logger.info("single-process run; skipping jax.distributed.initialize")
+        return
+    logger.info(
+        "jax.distributed.initialize(coordinator=%s, num_processes=%s, "
+        "process_id=%s)", coordinator, n, pid,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=n, process_id=pid
+    )
+
+
+def global_rank() -> int:
+    return int(os.getenv(NodeEnv.PROCESS_ID, "0"))
+
+
+def world_size() -> int:
+    return int(os.getenv(NodeEnv.NUM_PROCESSES, "1"))
+
+
+def local_rank() -> int:
+    return int(os.getenv(NodeEnv.LOCAL_RANK, "0"))
+
+
+def restart_count() -> int:
+    return int(os.getenv(NodeEnv.RESTART_COUNT, "0"))

@@ -1,0 +1,88 @@
+"""Minimal elastic training script used by the e2e tests and demos.
+
+Trains a tiny linear regression with plain JAX. Demonstrates the trainer
+contract: ``init_training()`` bootstrap, master-backed progress reporting,
+and (optionally) a one-shot injected crash to exercise agent restarts.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu import train as dtrain
+from dlrover_tpu.agent.master_client import MasterClient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--crash-at", type=int, default=-1,
+                        help="crash at this step on the first run")
+    parser.add_argument("--crash-sentinel", type=str, default="")
+    parser.add_argument("--progress-file", type=str, default="")
+    parser.add_argument("--expect-world", type=int, default=0)
+    args = parser.parse_args()
+
+    dtrain.init_training()
+    rank = dtrain.global_rank()
+    if args.expect_world:
+        assert jax.process_count() == args.expect_world, (
+            f"expected {args.expect_world} processes, got {jax.process_count()}"
+        )
+
+    client = None
+    if os.getenv("DLROVER_TPU_MASTER_ADDR"):
+        client = MasterClient.singleton_instance()
+
+    key = jax.random.PRNGKey(0)
+    w = jnp.zeros((4,))
+    x = jax.random.normal(key, (64, 4))
+    y = x @ jnp.array([1.0, -2.0, 3.0, 0.5])
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(w)
+
+    @jax.jit
+    def step_fn(w, opt_state):
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(w)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(w, updates), opt_state, loss
+
+    start = 0
+    if args.progress_file and os.path.exists(args.progress_file):
+        with open(args.progress_file) as f:
+            start = int(f.read().strip() or 0)
+
+    for step in range(start, args.steps):
+        if (
+            args.crash_at >= 0
+            and step == args.crash_at
+            and args.crash_sentinel
+            and not os.path.exists(args.crash_sentinel)
+        ):
+            with open(args.crash_sentinel, "w") as f:
+                f.write("crashed")
+            print(f"rank {rank}: injected crash at step {step}", flush=True)
+            sys.exit(1)
+        w, opt_state, loss = step_fn(w, opt_state)
+        if args.progress_file:
+            with open(args.progress_file, "w") as f:
+                f.write(str(step + 1))
+        if client is not None and rank == 0:
+            client.report_global_step(step + 1, time.time())
+
+    final_loss = float(jnp.mean((x @ w - y) ** 2))
+    print(f"rank {rank}: done, final loss {final_loss:.6f}", flush=True)
+    if args.steps >= 15:  # enough steps to converge
+        assert final_loss < 1.0
+
+
+if __name__ == "__main__":
+    main()

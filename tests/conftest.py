@@ -7,7 +7,9 @@ virtual 8-device CPU mesh; control-plane tests use an in-process master.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment points JAX at a real TPU
+# (JAX_PLATFORMS=axon + an eagerly-registered PJRT plugin on PYTHONPATH).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

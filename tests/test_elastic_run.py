@@ -1,0 +1,113 @@
+"""End-to-end elastic launch tests: standalone run, crash-restart, 2-node world.
+
+Mirrors the reference's agent e2e strategy (SURVEY.md §4.1): a real master,
+real agents, real worker processes — all on localhost with CPU JAX.
+"""
+
+import os
+import subprocess
+import sys
+import time
+import uuid
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "examples", "train_tiny.py")
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DLROVER_TPU_MASTER_ADDR", None)
+    # Drop any TPU-plugin site dir (its sitecustomize eagerly initializes a
+    # PJRT backend, which breaks multi-process CPU jax.distributed).
+    paths = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO, *paths])
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _run_cli(cli_args, extra_env=None, timeout=180):
+    cmd = [sys.executable, "-m", "dlrover_tpu.cli", *cli_args]
+    return subprocess.run(
+        cmd, env=_env(extra_env), timeout=timeout,
+        capture_output=True, text=True,
+    )
+
+
+@pytest.mark.e2e
+class TestElasticRun:
+    def test_standalone_run_succeeds(self, tmp_path):
+        job = f"e2e-{uuid.uuid4().hex[:6]}"
+        result = _run_cli(
+            [
+                "--standalone", "--nproc_per_node=1", f"--job_name={job}",
+                "--monitor_interval=0.2", SCRIPT, "--", "--steps", "5",
+            ],
+        )
+        assert result.returncode == 0, result.stderr[-2000:]
+
+    def test_crash_restart_resumes(self, tmp_path):
+        job = f"e2e-{uuid.uuid4().hex[:6]}"
+        sentinel = str(tmp_path / "crash.sentinel")
+        progress = str(tmp_path / "progress.txt")
+        result = _run_cli(
+            [
+                "--standalone", "--nproc_per_node=1", f"--job_name={job}",
+                "--monitor_interval=0.2", "--max_restarts=2",
+                SCRIPT, "--",
+                "--steps", "6", "--crash-at", "3",
+                "--crash-sentinel", sentinel, "--progress-file", progress,
+            ],
+        )
+        assert result.returncode == 0, result.stderr[-2000:]
+        assert os.path.exists(sentinel), "crash was never injected"
+        with open(progress) as f:
+            assert int(f.read()) == 6
+
+    def test_two_node_world(self, tmp_path):
+        """Two agents rendezvous through one master; workers form a
+        2-process JAX world via jax.distributed."""
+        job = f"e2e-{uuid.uuid4().hex[:6]}"
+        port_file = str(tmp_path / "port")
+        master = subprocess.Popen(
+            [
+                sys.executable, "-m", "dlrover_tpu.master.main",
+                "--node_num", "2", "--job_name", job,
+                "--port_file", port_file,
+            ],
+            env=_env(),
+        )
+        try:
+            deadline = time.monotonic() + 30
+            while not os.path.exists(port_file):
+                assert time.monotonic() < deadline, "master never started"
+                time.sleep(0.05)
+            with open(port_file) as f:
+                addr = f"127.0.0.1:{f.read().strip()}"
+
+            agents = []
+            for rank in range(2):
+                agents.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable, "-m", "dlrover_tpu.cli",
+                            "--nnodes=2", "--nproc_per_node=1",
+                            f"--node_rank={rank}", f"--master_addr={addr}",
+                            f"--job_name={job}", "--monitor_interval=0.2",
+                            SCRIPT, "--", "--steps", "3",
+                            "--expect-world", "2",
+                        ],
+                        env=_env(), stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT, text=True,
+                    )
+                )
+            for a in agents:
+                out, _ = a.communicate(timeout=180)
+                assert a.returncode == 0, out[-3000:]
+        finally:
+            master.terminate()
+            master.wait(timeout=10)
