@@ -88,7 +88,9 @@ class MasterRendezvousHandler:
         )
         deadline = time.monotonic() + self._timeout
         while time.monotonic() < deadline:
-            round_, _, world = self._client.get_comm_world(self._name)
+            round_, _, world = self._client.get_comm_world(
+                self._name, self._node_rank
+            )
             if world and self._node_rank in world:
                 coordinator = self._setup_coordinator(round_, world)
                 return RendezvousOutcome(

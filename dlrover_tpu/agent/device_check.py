@@ -1,0 +1,177 @@
+"""Pre-flight device/ICI check (agent side).
+
+Capability parity with the reference's ``NetworkCheckElasticAgent``
+(``elastic_agent/torch/training.py:767-906``): before training starts, the
+agent joins the master's device-check rendezvous, the master pairs nodes
+into small groups, and every group runs a timed collective + matmul
+exercise in a spawned process (:mod:`dlrover_tpu.agent.run_device_check`).
+Results go back to the master, whose
+:class:`~dlrover_tpu.master.rendezvous.DeviceCheckRendezvousManager`
+localizes fault nodes by re-pairing suspects with known-good nodes in a
+second round, and flags stragglers by the elapsed-time median×2 rule.
+
+TPU specifics: the exercise runs JAX collectives (over ICI on real chips,
+over the CPU backend in tests) instead of NCCL allgathers; a hung or dead
+partner surfaces as an exercise-process timeout, which is exactly the
+failure signature of a sick chip or link.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, Tuple
+
+from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import find_free_port
+
+# How long a single exercise process may run before we call the node (or
+# its partner) faulty. Tests shrink this via the environment.
+_EXERCISE_TIMEOUT_ENV = "DLROVER_TPU_CHECK_EXERCISE_TIMEOUT"
+_MAX_CHECK_ROUNDS = 3
+
+
+def _exercise_timeout() -> float:
+    try:
+        return float(os.getenv(_EXERCISE_TIMEOUT_ENV, "60"))
+    except ValueError:
+        return 60.0
+
+
+def _setup_group_coordinator(client, round_: int, group: int,
+                             world: Dict[int, int], node_rank: int) -> str:
+    """The lowest rank of the check group hosts a JAX coordinator; the
+    address is published through the master kv-store."""
+    key = f"devcheck/{round_}/{group}"
+    first = sorted(world)[0]
+    if node_rank == first:
+        host = os.getenv("DLROVER_TPU_HOST_IP", "127.0.0.1")
+        addr = f"{host}:{find_free_port()}"
+        client.kv_store_set(key, addr.encode())
+        return addr
+    return client.kv_store_wait([key], timeout=60.0)[key].decode()
+
+
+def _run_exercise(config, client, round_: int, group: int,
+                  world: Dict[int, int], node_rank: int) -> Tuple[bool, float]:
+    """Spawn the check program for this group; returns (normal, elapsed)."""
+    members = sorted(world)
+    try:
+        coordinator = _setup_group_coordinator(client, round_, group, world,
+                                               node_rank)
+    except TimeoutError:
+        # The group leader died before publishing the coordinator address:
+        # report a failed check instead of crashing the healthy agent.
+        logger.error("device check: group %s coordinator never appeared",
+                     group)
+        return False, float("inf")
+    result_path = tempfile.mktemp(prefix="dlrover_tpu_devcheck_")
+    env = dict(os.environ)
+    env.update({
+        NodeEnv.JOB_NAME: config.job_name,
+        NodeEnv.NODE_RANK: str(node_rank),
+        NodeEnv.COORDINATOR_ADDR: coordinator,
+        NodeEnv.PROCESS_ID: str(members.index(node_rank)),
+        NodeEnv.NUM_PROCESSES: str(len(members)),
+        "DLROVER_TPU_CHECK_RESULT_PATH": result_path,
+    })
+    cmd = [sys.executable, "-m", "dlrover_tpu.agent.run_device_check"]
+    start = time.monotonic()
+    timeout = _exercise_timeout()
+    try:
+        proc = subprocess.run(
+            cmd, env=env, timeout=timeout,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        normal = proc.returncode == 0
+        if not normal:
+            logger.error(
+                "device-check exercise failed (rc=%s):\n%s",
+                proc.returncode, proc.stdout.decode(errors="replace")[-2000:],
+            )
+    except subprocess.TimeoutExpired:
+        logger.error("device-check exercise timed out after %ss", timeout)
+        normal = False
+    elapsed = time.monotonic() - start
+    if normal and os.path.exists(result_path):
+        try:
+            with open(result_path) as f:
+                elapsed = float(f.read().strip())
+        except (ValueError, OSError):
+            pass
+    if os.path.exists(result_path):
+        os.unlink(result_path)
+    return normal, elapsed
+
+
+def run_device_check(config, client) -> bool:
+    """Run check rounds until the diagnosis is done.
+
+    Returns False when this node must not join training: it was confirmed
+    faulty, or it is a straggler and ``--exclude-straggler`` is set.
+    """
+    node_rank = config.node_rank
+    for check_round in range(_MAX_CHECK_ROUNDS):
+        client.join_rendezvous(
+            RendezvousName.DEVICE_CHECK, node_rank, config.nproc_per_node
+        )
+        # Wait for the master to freeze the round and hand us a group.
+        deadline = time.monotonic() + config.rdzv_timeout
+        world: Dict[int, int] = {}
+        while time.monotonic() < deadline:
+            round_, group, world = client.get_comm_world(
+                RendezvousName.DEVICE_CHECK, node_rank
+            )
+            if world and node_rank in world:
+                break
+            time.sleep(0.2)
+        if not world:
+            logger.warning("device check round never formed; skipping check")
+            return True
+        logger.info(
+            "device check round %s: group %s members %s",
+            round_, group, sorted(world),
+        )
+        normal, elapsed = _run_exercise(
+            config, client, round_, group, world, node_rank
+        )
+        client.report_check_result(node_rank, normal, elapsed, round_=round_)
+
+        # Poll the diagnosis: done -> act; suspects AND our round fully
+        # reported -> another round; otherwise keep waiting for reports.
+        poll_deadline = time.monotonic() + _exercise_timeout() + 60.0
+        need_new_round = False
+        while time.monotonic() < poll_deadline:
+            fault_nodes, done, completed = client.get_fault_nodes()
+            if done:
+                stragglers, _, _ = client.get_stragglers()
+                if node_rank in fault_nodes:
+                    logger.error(
+                        "device check: this node (%s) is a confirmed fault "
+                        "node", node_rank,
+                    )
+                    return False
+                if node_rank in stragglers:
+                    logger.warning(
+                        "device check: this node (%s) is a straggler "
+                        "(exclude=%s)", node_rank, config.exclude_straggler,
+                    )
+                    if config.exclude_straggler:
+                        return False
+                logger.info(
+                    "device check passed (fault=%s stragglers=%s)",
+                    fault_nodes, stragglers,
+                )
+                return True
+            if fault_nodes and completed >= round_:
+                need_new_round = True
+                break
+            time.sleep(0.3)
+        if not need_new_round:
+            logger.warning("device-check diagnosis timed out; proceeding")
+            return True
+    logger.warning("device check inconclusive after %s rounds; proceeding",
+                   _MAX_CHECK_ROUNDS)
+    return True

@@ -61,8 +61,13 @@ class MasterClient:
             )
         )
 
-    def get_comm_world(self, rdzv_name: str) -> Tuple[int, int, Dict[int, int]]:
-        resp: m.CommWorld = self._call(m.CommWorldRequest(rdzv_name=rdzv_name))
+    def get_comm_world(
+        self, rdzv_name: str, node_rank: Optional[int] = None
+    ) -> Tuple[int, int, Dict[int, int]]:
+        rank = self._node_id if node_rank is None else node_rank
+        resp: m.CommWorld = self._call(
+            m.CommWorldRequest(rdzv_name=rdzv_name, node_rank=rank)
+        )
         return resp.round, resp.group, resp.world
 
     def num_nodes_waiting(self, rdzv_name: str) -> int:
@@ -80,20 +85,22 @@ class MasterClient:
         )
 
     # ---------------- device check ----------------
-    def report_check_result(self, node_rank: int, normal: bool, elapsed: float):
+    def report_check_result(self, node_rank: int, normal: bool,
+                            elapsed: float, round_: int = 0):
         return self._call(
             m.DeviceCheckResult(
-                node_rank=node_rank, normal=normal, elapsed_time=elapsed
+                node_rank=node_rank, normal=normal, elapsed_time=elapsed,
+                round=round_,
             )
         )
 
     def get_fault_nodes(self):
         resp: m.DiagnosisResult = self._call(m.FaultNodesRequest())
-        return resp.nodes, resp.done
+        return resp.nodes, resp.done, resp.completed_rounds
 
     def get_stragglers(self):
         resp: m.DiagnosisResult = self._call(m.StragglersRequest())
-        return resp.nodes, resp.done
+        return resp.nodes, resp.done, resp.completed_rounds
 
     # ---------------- kv store ----------------
     def kv_store_set(self, key: str, value: bytes):

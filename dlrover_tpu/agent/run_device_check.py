@@ -1,0 +1,96 @@
+"""The spawned device-check exercise program.
+
+Capability parity with the reference's
+``dlrover/trainer/torch/run_network_check.py:44-111`` (timed allgather +
+matmul benches, with ``MOCK_ERR_RANK``-style fault injection for tests),
+lowered to JAX: a bf16 matmul exercises the chip's MXU and a repeated
+cross-process allgather exercises ICI/DCN. The measured compute+collective
+time is written to ``DLROVER_TPU_CHECK_RESULT_PATH`` for the master's
+straggler rule; any crash/hang surfaces as a nonzero exit or a timeout in
+the supervising agent.
+"""
+
+import os
+import sys
+import time
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import logger
+
+_MATMUL_SIZE = int(os.getenv("DLROVER_TPU_CHECK_MATMUL_SIZE", "1024"))
+_ALLGATHER_ROUNDS = int(os.getenv("DLROVER_TPU_CHECK_ALLGATHER_ROUNDS", "10"))
+
+
+def main() -> int:
+    node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+    mock_err = os.getenv(NodeEnv.MOCK_ERR_RANK, "")
+    if mock_err and int(mock_err) == node_rank:
+        logger.error("mock error injected on node %s", node_rank)
+        return 1
+
+    import jax
+    import jax.numpy as jnp
+
+    coordinator = os.getenv(NodeEnv.COORDINATOR_ADDR, "")
+    num_processes = int(os.getenv(NodeEnv.NUM_PROCESSES, "1"))
+    process_id = int(os.getenv(NodeEnv.PROCESS_ID, "0"))
+    if num_processes > 1 and coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+    start = time.monotonic()
+
+    # MXU exercise: a chain of bf16 matmuls, timed after compile.
+    key = jax.random.PRNGKey(node_rank)
+    a = jax.random.normal(key, (_MATMUL_SIZE, _MATMUL_SIZE), jnp.bfloat16)
+
+    @jax.jit
+    def matmul_chain(x):
+        for _ in range(4):
+            x = x @ x / _MATMUL_SIZE
+        return x
+
+    matmul_chain(a).block_until_ready()  # compile
+    t0 = time.monotonic()
+    out = matmul_chain(a).block_until_ready()
+    matmul_time = time.monotonic() - t0
+    if not bool(jnp.isfinite(out.astype(jnp.float32)).all()):
+        logger.error("matmul produced non-finite values")
+        return 1
+
+    # ICI/DCN exercise: repeated cross-process allgather.
+    allgather_time = 0.0
+    if num_processes > 1:
+        from jax.experimental import multihost_utils
+
+        payload = jnp.arange(1024, dtype=jnp.float32) + process_id
+        multihost_utils.process_allgather(payload)  # compile/warm-up
+        t0 = time.monotonic()
+        for _ in range(_ALLGATHER_ROUNDS):
+            gathered = multihost_utils.process_allgather(payload)
+        allgather_time = time.monotonic() - t0
+        if gathered.shape[0] != num_processes:
+            logger.error("allgather returned wrong world size")
+            return 1
+
+    mock_straggler = os.getenv(NodeEnv.MOCK_STRAGGLER_RANK, "")
+    if mock_straggler and int(mock_straggler) == node_rank:
+        time.sleep(float(os.getenv("DLROVER_TPU_MOCK_STRAGGLER_SECS", "3")))
+
+    elapsed = time.monotonic() - start
+    result_path = os.getenv("DLROVER_TPU_CHECK_RESULT_PATH", "")
+    if result_path:
+        with open(result_path, "w") as f:
+            f.write(str(elapsed))
+    logger.info(
+        "device check ok: matmul %.4fs allgather %.4fs total %.4fs",
+        matmul_time, allgather_time, elapsed,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

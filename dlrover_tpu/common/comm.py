@@ -19,7 +19,8 @@ import socket
 import struct
 import threading
 import time
-from typing import Any, Dict, Optional
+import uuid
+from typing import Any, Dict, Optional, Tuple
 
 from dlrover_tpu.common.constants import CommResource
 from dlrover_tpu.common.log import logger
@@ -142,42 +143,110 @@ class LocalSocketComm:
         )
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
 class SharedLock(LocalSocketComm):
     """A lock owned by the agent; any process on the host can acquire it.
 
     The flash-checkpoint protocol uses it for dirty-write detection: the
     saver refuses to persist a shard whose lock is held by a writer.
+
+    Ownership is tracked per client ``(pid, token)``: a dead owner's lock is
+    force-released, so a trainer that crashes mid-write can never wedge the
+    saver, and retried acquire/release calls are idempotent (each call runs
+    on a fresh connection, so the owner token — not the connection — is the
+    identity).
     """
 
     KIND = "lock"
 
     def __init__(self, name: str, create: bool = False, job: str = ""):
-        self._lock = threading.Lock() if create else None
+        if create:
+            self._cond = threading.Condition()
+            self._owner: Optional[Tuple[int, str]] = None
+        self._client_token = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
         super().__init__(name, create, job)
 
-    def _srv_acquire(self, blocking: bool = True, timeout: float = -1):
+    # Server side: `owner` is (pid, token) of the requesting client.
+    def _srv_acquire(self, owner, blocking: bool = True, timeout: float = -1):
+        deadline = None
         if blocking and timeout >= 0:
-            return self._lock.acquire(timeout=timeout)
-        return self._lock.acquire(blocking=blocking)
+            deadline = time.monotonic() + timeout
+        # Cap any blocking acquire so a server thread never waits forever on
+        # behalf of a client that has already timed out and gone away.
+        hard_deadline = time.monotonic() + 55.0
+        owner = tuple(owner)
+        with self._cond:
+            while True:
+                if self._owner is not None and not _pid_alive(self._owner[0]):
+                    logger.warning(
+                        "lock %s: owner pid %s died; force-releasing",
+                        self.name, self._owner[0],
+                    )
+                    self._owner = None
+                if self._owner is None:
+                    self._owner = owner
+                    return True
+                if self._owner == owner:  # idempotent re-acquire (rpc retry)
+                    return True
+                if not blocking:
+                    return False
+                now = time.monotonic()
+                limit = hard_deadline if deadline is None else min(deadline, hard_deadline)
+                if now >= limit:
+                    return False
+                self._cond.wait(timeout=min(1.0, limit - now))
 
-    def _srv_release(self):
-        try:
-            self._lock.release()
-            return True
-        except RuntimeError:
+    def _srv_release(self, owner):
+        owner = tuple(owner)
+        with self._cond:
+            if self._owner == owner:
+                self._owner = None
+                self._cond.notify_all()
+                return True
             return False
 
     def _srv_locked(self):
-        return self._lock.locked()
+        with self._cond:
+            if self._owner is not None and not _pid_alive(self._owner[0]):
+                self._owner = None
+                self._cond.notify_all()
+            return self._owner is not None
+
+    # Each server-side wait is bounded (a server thread must never block
+    # forever for a client that already gave up), so a long or infinite
+    # client acquire is issued as a loop of bounded slices.
+    _SLICE = 30.0
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        call_timeout = 60.0 if timeout < 0 else timeout + 60.0
-        return self._call(
-            "acquire", blocking, timeout, timeout=call_timeout
-        )
+        owner = (os.getpid(), self._client_token)
+        if not blocking or (0 <= timeout <= self._SLICE):
+            return self._call(
+                "acquire", owner, blocking, timeout,
+                timeout=max(60.0, timeout + 30.0),
+            )
+        deadline = None if timeout < 0 else time.monotonic() + timeout
+        while True:
+            remaining = self._SLICE if deadline is None else min(
+                self._SLICE, deadline - time.monotonic()
+            )
+            if remaining <= 0:
+                return False
+            if self._call(
+                "acquire", owner, True, remaining, timeout=remaining + 30.0
+            ):
+                return True
 
     def release(self) -> bool:
-        return self._call("release")
+        return self._call("release", (os.getpid(), self._client_token))
 
     def locked(self) -> bool:
         return self._call("locked")

@@ -1,6 +1,15 @@
 """Master-side tunables singleton (parity: reference ``common/global_context.py``)."""
 
+import os
+
 from dlrover_tpu.common.singleton import Singleton
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.getenv(name, default))
+    except ValueError:
+        return default
 
 
 class Context(Singleton):
@@ -10,12 +19,16 @@ class Context(Singleton):
         self.seconds_to_wait_failed_node = 120.0
         self.seconds_for_stable_worker_count = 60.0
         self.seconds_to_wait_pending_node = 900.0
-        self.hang_detection_seconds = 1800.0
+        self.hang_detection_seconds = _env_float(
+            "DLROVER_TPU_HANG_DETECTION_SECS", 1800.0
+        )
         self.relaunch_always = False
         self.max_relaunch_count = 3
         self.rdzv_waiting_timeout = 30.0
         self.rdzv_lastcall_timeout = 3.0
-        self.device_check_timeout = 300.0
+        self.device_check_timeout = _env_float(
+            "DLROVER_TPU_DEVICE_CHECK_TIMEOUT", 300.0
+        )
         self.straggler_time_ratio = 2.0
         self.auto_scale_enabled = False
         self.checkpoint_gc_keep = 3

@@ -29,6 +29,7 @@ class JoinRendezvous(BaseRequest):
 @dataclass
 class CommWorldRequest(BaseRequest):
     rdzv_name: str = ""
+    node_rank: int = 0
     round: int = 0
 
 
@@ -79,6 +80,10 @@ class StragglersRequest(BaseRequest):
 class DiagnosisResult:
     nodes: List[int] = field(default_factory=list)
     done: bool = False
+    # Number of check rounds whose members have all reported; lets an agent
+    # distinguish "another round is needed" from "current round still
+    # reporting" without racing other agents.
+    completed_rounds: int = 0
 
 
 # ---------------- kv store ----------------

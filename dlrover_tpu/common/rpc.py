@@ -16,6 +16,9 @@ import socket
 import socketserver
 import struct
 import threading
+import time
+import uuid
+from collections import OrderedDict
 from typing import Any, Callable, Optional, Tuple
 
 from dlrover_tpu.common.log import logger
@@ -50,11 +53,73 @@ def find_free_port(host: str = "") -> int:
         return s.getsockname()[1]
 
 
+class _DedupCache:
+    """Remember recent request-id → response so client retries after a
+    connection error never apply a non-idempotent message twice.
+
+    ``begin`` claims an id before the handler runs; a duplicate arriving
+    while the first execution is still in flight waits for it to finish
+    instead of re-executing the handler concurrently.
+    """
+
+    def __init__(self, maxsize: int = 4096, ttl: float = 120.0):
+        # req_id -> (timestamp, response) once done; response is None and a
+        # pending Event is registered while the handler is executing.
+        self._entries: "OrderedDict[str, Tuple[float, Any]]" = OrderedDict()
+        self._pending: dict = {}
+        self._lock = threading.Lock()
+        self._maxsize = maxsize
+        self._ttl = ttl
+
+    def begin(self, req_id: str):
+        """Returns (is_duplicate, response). For an in-flight duplicate,
+        blocks until the first execution completes."""
+        with self._lock:
+            entry = self._entries.get(req_id)
+            if entry is not None:
+                return True, entry[1]
+            event = self._pending.get(req_id)
+            if event is None:
+                self._pending[req_id] = threading.Event()
+                return False, None
+        event.wait(timeout=60.0)
+        with self._lock:
+            entry = self._entries.get(req_id)
+            if entry is not None:
+                return True, entry[1]
+        # First execution vanished (crashed thread / timeout): re-execute.
+        return False, None
+
+    def finish(self, req_id: str, response: Any):
+        now = time.monotonic()
+        with self._lock:
+            self._entries[req_id] = (now, response)
+            self._entries.move_to_end(req_id)
+            event = self._pending.pop(req_id, None)
+            while len(self._entries) > self._maxsize:
+                self._entries.popitem(last=False)
+            while self._entries:
+                oldest = next(iter(self._entries))
+                if now - self._entries[oldest][0] > self._ttl:
+                    self._entries.popitem(last=False)
+                else:
+                    break
+        if event is not None:
+            event.set()
+
+
 class RpcServer:
-    """Threaded request/response server: ``handler(request) -> response``."""
+    """Threaded request/response server: ``handler(request) -> response``.
+
+    Requests arrive as ``(req_id, payload)``; responses for recent ids are
+    cached so a retried request is answered from cache instead of being
+    re-applied (the wire retry in :class:`RpcClient` is therefore safe for
+    mutating messages such as KVStoreAdd/JoinRendezvous/TaskReport).
+    """
 
     def __init__(self, port: int, handler: Callable[[Any], Any], host: str = "0.0.0.0"):
         self._handler = handler
+        self._dedup = _DedupCache()
 
         outer = self
 
@@ -63,14 +128,26 @@ class RpcServer:
                 sock = self.request
                 while True:
                     try:
-                        request = _recv(sock)
+                        envelope = _recv(sock)
                     except (ConnectionError, EOFError, OSError):
                         return
-                    try:
-                        response = (True, outer._handler(request))
-                    except Exception as e:
-                        logger.exception("rpc handler error for %r", type(request))
-                        response = (False, repr(e))
+                    if isinstance(envelope, tuple) and len(envelope) == 2:
+                        req_id, request = envelope
+                    else:  # bare request (tests / simple callers)
+                        req_id, request = None, envelope
+                    duplicate, response = (
+                        outer._dedup.begin(req_id) if req_id else (False, None)
+                    )
+                    if not duplicate:
+                        try:
+                            response = (True, outer._handler(request))
+                        except Exception as e:
+                            logger.exception(
+                                "rpc handler error for %r", type(request)
+                            )
+                            response = (False, repr(e))
+                        if req_id is not None:
+                            outer._dedup.finish(req_id, response)
                     try:
                         _send(sock, response)
                     except OSError:
@@ -111,16 +188,27 @@ class RpcClient:
         self._sock = s
 
     def call(self, request: Any, timeout: Optional[float] = None) -> Any:
+        envelope = (uuid.uuid4().hex, request)
         with self._lock:
             for attempt in (0, 1):
                 try:
                     if self._sock is None:
                         self._connect()
                     self._sock.settimeout(timeout or self._timeout)
-                    _send(self._sock, request)
+                    _send(self._sock, envelope)
                     ok, payload = _recv(self._sock)
                     break
+                except socket.timeout:
+                    # Never retry a timeout: the first attempt may still be
+                    # executing on the server, so a retried envelope could
+                    # miss the dedup cache and run the handler concurrently.
+                    self._close_locked()
+                    raise
                 except (ConnectionError, OSError, EOFError):
+                    # Safe to retry: the connection is dead (the server is
+                    # not still processing it) and the server dedups on the
+                    # request id, so a request that was applied before the
+                    # connection died is answered from cache, not re-applied.
                     self._close_locked()
                     if attempt:
                         raise

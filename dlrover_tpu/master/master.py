@@ -43,7 +43,8 @@ class JobMaster:
                 RendezvousName.TRAINING
             ),
             RendezvousName.DEVICE_CHECK: DeviceCheckRendezvousManager(
-                RendezvousName.DEVICE_CHECK
+                RendezvousName.DEVICE_CHECK,
+                check_timeout=ctx.device_check_timeout,
             ),
         }
         for mgr in self.rdzv_managers.values():

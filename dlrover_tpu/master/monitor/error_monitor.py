@@ -1,18 +1,28 @@
 """Classify reported process/node errors (parity: reference ``monitor/error_monitor.py``)."""
 
+import re
 from typing import List, Tuple
 
 from dlrover_tpu.common.constants import NodeExitReason, TrainingExceptionLevel
 from dlrover_tpu.common.log import logger
 
-_OOM_MARKERS = ("out of memory", "oom", "resource_exhausted", "hbm")
-_HARDWARE_MARKERS = (
-    "tpu halted",
-    "device unavailable",
-    "data loss",
-    "uncorrectable ecc",
-    "ici",
-    "deadline exceeded: failed to connect",
+# Word-boundary patterns so ordinary words ("bloom", "policies",
+# "suspicious") never classify a benign traceback as node-fatal. DOTALL +
+# generous windows so real multi-line XLA allocator messages (e.g.
+# "Error allocating device buffer: Attempting to allocate 4.00G. That was
+# not possible. ...; (0x0x0_HBM0)") still classify as OOM.
+_OOM_RE = re.compile(
+    r"out of memory|\boom\b|resource_exhausted"
+    r"|attempting to allocate"
+    r"|\bhbm_?\d*\b.{0,400}?(oom|exhaust|exceed|not possible)"
+    r"|allocat\w*.{0,400}?(\bhbm_?\d*\b|device buffer|device memory)",
+    re.IGNORECASE | re.DOTALL,
+)
+_HARDWARE_RE = re.compile(
+    r"tpu halted|device unavailable|\bdata loss\b|uncorrectable ecc"
+    r"|\bici\b.{0,80}?(fail|error|timeout|down)"
+    r"|deadline exceeded: failed to connect",
+    re.IGNORECASE | re.DOTALL,
 )
 
 
@@ -36,10 +46,9 @@ class ErrorMonitor:
 
     @staticmethod
     def classify(error_data: str) -> str:
-        text = error_data.lower()
-        if any(m in text for m in _OOM_MARKERS):
+        if _OOM_RE.search(error_data):
             return NodeExitReason.OOM
-        if any(m in text for m in _HARDWARE_MARKERS):
+        if _HARDWARE_RE.search(error_data):
             return NodeExitReason.HARDWARE_ERROR
         return NodeExitReason.FATAL_ERROR
 

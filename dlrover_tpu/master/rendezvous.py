@@ -150,19 +150,30 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
 class DeviceCheckRendezvousManager(RendezvousManager):
     """Paired-group check rounds for fault/straggler localization.
 
-    Round r=0: nodes are paired sequentially ``(0,1)(2,3)...``; each pair
-    runs an allgather+matmul exercise. A failed pair makes both members
-    suspects. Round r=1: suspects are re-paired with known-good nodes. A
-    node that fails both rounds is the fault node; with only one round of
-    data the diagnosis is not ``done``.
+    Check round 1: nodes are paired sequentially ``(0,1)(2,3)...``; each
+    pair runs an allgather+matmul exercise. A failed pair makes both
+    members suspects. Check round 2: every suspect is deliberately
+    re-paired with a node that passed round 1 (parity: reference
+    ``rdzv_manager.py:449-507``). A node that fails both rounds is the
+    fault node; with only one round of data the diagnosis is not ``done``.
+
+    A report deadline guards against a node dying mid-check: members that
+    fail to report within ``check_timeout`` of the round freezing are
+    recorded as failed, so the diagnosis can never wedge on a silent node.
     """
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, check_timeout: float = 120.0):
         super().__init__(name)
         self._node_status: Dict[int, Dict[int, bool]] = {}  # round -> rank -> ok
         self._node_times: Dict[int, Dict[int, float]] = {}  # round -> rank -> sec
+        self._round_members: Dict[int, Set[int]] = {}  # round -> frozen members
         self._check_round = 0
         self._straggler_ratio = 2.0
+        self._check_timeout = check_timeout
+        self._round_frozen_time = 0.0
+        # Groups snapshotted at freeze time so every member of a round sees
+        # the same pairing even if earlier-round data changes underneath.
+        self._groups: List[List[int]] = []
 
     def join_rendezvous(self, node_rank: int, local_world_size: int = 1) -> int:
         with self._lock:
@@ -175,26 +186,62 @@ class DeviceCheckRendezvousManager(RendezvousManager):
 
     def get_comm_world(self, node_rank: int):
         with self._lock:
+            self._expire_round()
             if not self._rdzv_nodes and self._freeze_ready():
                 self._freeze_round()
-                self._check_round += 1
+                if self._rdzv_nodes:  # node_unit may admit zero nodes
+                    self._check_round += 1
+                    self._round_members[self._check_round] = set(
+                        self._rdzv_nodes
+                    )
+                    self._round_frozen_time = time.monotonic()
+                    self._groups = self._build_groups()
             if node_rank in self._rdzv_nodes:
-                groups = self._build_groups()
-                for group_idx, members in enumerate(groups):
+                for group_idx, members in enumerate(self._groups):
                     if node_rank in members:
                         world = {r: self._rdzv_nodes[r] for r in members}
                         return self._rdzv_round, group_idx, world
             return self._rdzv_round, 0, {}
 
+    def _expire_round(self):
+        """With the lock held: time out members that never reported."""
+        if not self._rdzv_nodes or self._round_frozen_time <= 0:
+            return
+        if time.monotonic() - self._round_frozen_time < self._check_timeout:
+            return
+        r = self._check_round
+        reported = set(self._node_status.get(r, {}))
+        for rank in set(self._rdzv_nodes) - reported:
+            logger.warning(
+                "device check %s: node %s never reported in round %s; "
+                "recording as failed", self.name, rank, r,
+            )
+            self._node_status.setdefault(r, {})[rank] = False
+            self._node_times.setdefault(r, {})[rank] = float("inf")
+        self._rdzv_nodes = {}
+
     def _build_groups(self) -> List[List[int]]:
-        """Pair nodes; in later check rounds, shift pairing so a suspect
-        lands with a node that succeeded in the previous round."""
+        """Pair nodes; from check round 2 on, pair each suspect (failed the
+        previous round) with a known-good node so the faulty member of a
+        failed pair is isolated."""
         ranks = sorted(self._rdzv_nodes)
-        round_idx = self._check_round
-        if round_idx > 1 and len(ranks) > 2:
-            # Rotate by one so every node gets a different partner than in
-            # the previous round (reference: re-pair suspects with good).
-            ranks = ranks[1:] + ranks[:1]
+        prev = self._node_status.get(self._check_round - 1, {})
+        suspects = [r for r in ranks if prev.get(r) is False]
+        good = [r for r in ranks if r not in set(suspects)]
+        if self._check_round > 1 and suspects and good:
+            pairs: List[List[int]] = []
+            g, s = list(good), list(suspects)
+            while s and g:
+                pairs.append([g.pop(0), s.pop(0)])
+            rest = g + s
+            for i in range(0, len(rest) - 1, 2):
+                pairs.append([rest[i], rest[i + 1]])
+            if len(rest) % 2:
+                if pairs:
+                    pairs[-1].append(rest[-1])
+                else:
+                    pairs.append([rest[-1]])
+            return pairs
         groups = []
         for i in range(0, len(ranks) - 1, 2):
             groups.append([ranks[i], ranks[i + 1]])
@@ -205,20 +252,54 @@ class DeviceCheckRendezvousManager(RendezvousManager):
                 groups.append([ranks[-1]])
         return groups
 
-    def report_check_result(self, node_rank: int, normal: bool, elapsed: float):
+    def report_check_result(self, node_rank: int, normal: bool,
+                            elapsed: float, round_: Optional[int] = None):
         with self._lock:
-            r = self._check_round
+            r = self._check_round if round_ is None else round_
+            members = self._round_members.get(r)
+            if members is not None and node_rank not in members:
+                logger.warning(
+                    "device check %s: dropping report from node %s for "
+                    "round %s it was not a member of", self.name, node_rank, r,
+                )
+                return
+            if members is not None and set(
+                self._node_status.get(r, {})
+            ) >= members:
+                # The round already completed (possibly via expiry): a late
+                # report must not flip a diagnosis others have acted on.
+                logger.warning(
+                    "device check %s: dropping late report from node %s for "
+                    "completed round %s", self.name, node_rank, r,
+                )
+                return
             self._node_status.setdefault(r, {})[node_rank] = normal
             self._node_times.setdefault(r, {})[node_rank] = elapsed
             # The reported world is consumed; allow the next check round to
-            # freeze once every member reported.
-            if set(self._node_status[r]) >= set(self._rdzv_nodes):
+            # freeze once every member of the current round reported.
+            if r == self._check_round and set(
+                self._node_status[r]
+            ) >= set(self._rdzv_nodes):
                 self._rdzv_nodes = {}
+
+    def _complete_rounds(self) -> List[int]:
+        """With the lock held: rounds where every frozen member reported."""
+        done = []
+        for r, members in self._round_members.items():
+            if set(self._node_status.get(r, {})) >= members:
+                done.append(r)
+        return sorted(done)
+
+    def completed_rounds(self) -> int:
+        with self._lock:
+            self._expire_round()
+            return len(self._complete_rounds())
 
     def check_fault_node(self) -> Tuple[List[int], bool]:
         """Returns (fault node ranks, diagnosis finished)."""
         with self._lock:
-            rounds = sorted(self._node_status)
+            self._expire_round()
+            rounds = self._complete_rounds()
             if not rounds:
                 return [], False
             last = rounds[-1]
@@ -235,13 +316,14 @@ class DeviceCheckRendezvousManager(RendezvousManager):
     def check_straggler(self) -> Tuple[List[int], bool]:
         """Elapsed-time median×ratio rule (reference rdzv_manager.py:492)."""
         with self._lock:
-            rounds = sorted(self._node_times)
+            rounds = self._complete_rounds()
             if not rounds:
                 return [], False
             times = self._node_times[rounds[-1]]
-            if len(times) < 2:
+            finite = [t for t in times.values() if t != float("inf")]
+            if len(times) < 2 or not finite:
                 return [], True
-            median = statistics.median(times.values())
+            median = statistics.median(finite)
             if median <= 0:
                 return [], True
             stragglers = [

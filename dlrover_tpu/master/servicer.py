@@ -54,7 +54,7 @@ class MasterServicer:
 
     def _get_comm_world(self, req: m.CommWorldRequest):
         mgr = self._rdzv_managers[req.rdzv_name]
-        round_, group, world = mgr.get_comm_world(req.node_id)
+        round_, group, world = mgr.get_comm_world(req.node_rank)
         return m.CommWorld(
             rdzv_name=req.rdzv_name, round=round_, group=group, world=world
         )
@@ -72,18 +72,25 @@ class MasterServicer:
     # ---------------- device check ----------------
     def _report_check_result(self, req: m.DeviceCheckResult):
         mgr = self._rdzv_managers[RendezvousName.DEVICE_CHECK]
-        mgr.report_check_result(req.node_rank, req.normal, req.elapsed_time)
+        mgr.report_check_result(
+            req.node_rank, req.normal, req.elapsed_time,
+            round_=req.round if req.round > 0 else None,
+        )
         return m.Response()
 
     def _get_fault_nodes(self, req: m.FaultNodesRequest):
         mgr = self._rdzv_managers[RendezvousName.DEVICE_CHECK]
         nodes, done = mgr.check_fault_node()
-        return m.DiagnosisResult(nodes=nodes, done=done)
+        return m.DiagnosisResult(
+            nodes=nodes, done=done, completed_rounds=mgr.completed_rounds()
+        )
 
     def _get_stragglers(self, req: m.StragglersRequest):
         mgr = self._rdzv_managers[RendezvousName.DEVICE_CHECK]
         nodes, done = mgr.check_straggler()
-        return m.DiagnosisResult(nodes=nodes, done=done)
+        return m.DiagnosisResult(
+            nodes=nodes, done=done, completed_rounds=mgr.completed_rounds()
+        )
 
     # ---------------- kv store ----------------
     def _kv_set(self, req: m.KVStoreSet):

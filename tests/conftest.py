@@ -20,6 +20,26 @@ import uuid
 
 import pytest
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cpu_subprocess_env(extra=None):
+    """Environment for spawning CPU-JAX subprocesses in tests.
+
+    Strips the TPU-plugin site dir from PYTHONPATH (its sitecustomize
+    eagerly initializes a PJRT backend, which hangs/breaks CPU runs) and
+    forces the CPU platform.
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DLROVER_TPU_MASTER_ADDR", None)
+    paths = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO, *paths])
+    if extra:
+        env.update(extra)
+    return env
+
 
 @pytest.fixture
 def job_name(monkeypatch):

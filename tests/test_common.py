@@ -20,6 +20,12 @@ def _shm_child(n):
     # die without cleanup
 
 
+def _lock_holding_child(job):
+    c = SharedLock("l2", job=job)
+    assert c.acquire()
+    # die holding the lock
+
+
 class TestSharedMemory:
     def test_create_attach_persist(self):
         name = f"shm-{uuid.uuid4().hex[:8]}"
@@ -50,11 +56,30 @@ class TestSharedObjects:
     def test_lock(self, job_name):
         lock = SharedLock("l1", create=True)
         client = SharedLock("l1")
+        other = SharedLock("l1")  # distinct owner token, same process
         assert client.acquire()
         assert lock.locked()
-        assert not client.acquire(blocking=False)
+        # Same owner: idempotent (rpc-retry safety); other owner: blocked.
+        assert client.acquire(blocking=False)
+        assert not other.acquire(blocking=False)
+        assert not other.release()  # non-owner release refused
         assert client.release()
         assert not lock.locked()
+        assert other.acquire(blocking=False)
+        assert other.release()
+        lock.close()
+
+    def test_lock_dead_owner_force_release(self, job_name):
+        lock = SharedLock("l2", create=True)
+        p = mp.get_context("spawn").Process(
+            target=_lock_holding_child, args=(job_name,)
+        )
+        p.start()
+        p.join()
+        # The dead owner must not wedge the lock: a live client acquires.
+        survivor = SharedLock("l2")
+        assert survivor.acquire(timeout=10)
+        assert survivor.release()
         lock.close()
 
     def test_queue(self, job_name):
@@ -113,3 +138,29 @@ class TestRpc:
 
     def test_find_free_port(self):
         assert find_free_port() > 0
+
+    def test_retry_dedup(self):
+        """A retried request id must be applied once and answered from cache."""
+        counter = {"n": 0}
+
+        def handler(req):
+            counter["n"] += 1
+            return counter["n"]
+
+        server = RpcServer(0, handler)
+        server.start()
+        import socket as socket_mod
+
+        from dlrover_tpu.common.rpc import _recv, _send
+
+        s = socket_mod.create_connection(("127.0.0.1", server.port))
+        envelope = ("fixed-req-id", messages.KVStoreAdd(key="k"))
+        _send(s, envelope)
+        ok1, v1 = _recv(s)
+        _send(s, envelope)  # simulated retry after a lost response
+        ok2, v2 = _recv(s)
+        assert ok1 and ok2
+        assert v1 == v2 == 1
+        assert counter["n"] == 1
+        s.close()
+        server.stop()

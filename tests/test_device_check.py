@@ -1,0 +1,184 @@
+"""Device-check tests: diagnosis protocol (fake exercise) + real exercise.
+
+Mirrors the reference's strategy of testing multi-node logic in one
+process (SURVEY.md §4.3): four simulated agents drive the full check
+protocol against an in-process master; the real exercise program is
+spawned separately with fault injection (MOCK_ERR_RANK analog).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent import device_check
+from dlrover_tpu.agent.agent import ElasticLaunchConfig
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.rpc import find_free_port
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.rendezvous import DeviceCheckRendezvousManager
+
+
+@pytest.fixture
+def master4():
+    m = JobMaster(port=0, node_num=4, job_name="devcheck-job")
+    m.prepare()
+    yield m
+    m.stop()
+
+
+def _drive_agents(master, exercise, exclude_straggler=False):
+    """Run the full check protocol for 4 nodes concurrently."""
+    results = {}
+
+    def _one(rank):
+        client = MasterClient(master.addr, node_id=rank)
+        config = ElasticLaunchConfig(
+            min_nodes=4, max_nodes=4, node_rank=rank, rdzv_timeout=30.0,
+            exclude_straggler=exclude_straggler,
+        )
+        try:
+            results[rank] = device_check.run_device_check(config, client)
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=_one, args=(r,), daemon=True) for r in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "check protocol wedged"
+    return results
+
+
+class TestCheckProtocol:
+    def test_fault_node_localized_in_two_rounds(self, master4, monkeypatch):
+        """Node 3 is faulty: every group containing it fails its members.
+        Round 1 suspects {2,3}; round 2 re-pairs them with good nodes and
+        confirms only node 3."""
+
+        def fake_exercise(config, client, round_, group, world, node_rank):
+            return 3 not in world, 1.0
+
+        monkeypatch.setattr(device_check, "_run_exercise", fake_exercise)
+        results = _drive_agents(master4, fake_exercise)
+        assert results == {0: True, 1: True, 2: True, 3: False}
+
+    def test_straggler_excluded(self, master4, monkeypatch):
+        def fake_exercise(config, client, round_, group, world, node_rank):
+            return True, (5.0 if node_rank == 2 else 1.0)
+
+        monkeypatch.setattr(device_check, "_run_exercise", fake_exercise)
+        results = _drive_agents(master4, fake_exercise, exclude_straggler=True)
+        assert results == {0: True, 1: True, 2: False, 3: True}
+
+    def test_straggler_tolerated_by_default(self, master4, monkeypatch):
+        def fake_exercise(config, client, round_, group, world, node_rank):
+            return True, (5.0 if node_rank == 2 else 1.0)
+
+        monkeypatch.setattr(device_check, "_run_exercise", fake_exercise)
+        results = _drive_agents(master4, fake_exercise)
+        assert results == {0: True, 1: True, 2: True, 3: True}
+
+
+class TestRepairingAndExpiry:
+    def test_round2_pairs_suspects_with_good(self):
+        mgr = DeviceCheckRendezvousManager("check")
+        mgr.update_rdzv_params(4, 4)
+        for r in range(4):
+            mgr.join_rendezvous(r)
+        for r in range(4):
+            mgr.get_comm_world(r)
+        # Pair (2,3) failed round 1.
+        for r in range(4):
+            mgr.report_check_result(r, r not in (2, 3), elapsed=1.0)
+        for r in range(4):
+            mgr.join_rendezvous(r)
+        groups = {}
+        for r in range(4):
+            _, g, world = mgr.get_comm_world(r)
+            assert world
+            groups[g] = set(world)
+        # Every suspect must be paired with a round-1-good node.
+        for members in groups.values():
+            assert members & {0, 1}, f"group {members} has no good node"
+            assert members & {2, 3}, f"group {members} has no suspect"
+
+    def test_silent_node_expires(self):
+        mgr = DeviceCheckRendezvousManager("check", check_timeout=0.3)
+        mgr.update_rdzv_params(2, 2)
+        for r in range(2):
+            mgr.join_rendezvous(r)
+        for r in range(2):
+            mgr.get_comm_world(r)
+        mgr.report_check_result(0, True, elapsed=1.0)
+        # Node 1 never reports; after the timeout it is recorded failed and
+        # the diagnosis completes instead of wedging.
+        time.sleep(0.4)
+        fault, done = mgr.check_fault_node()
+        assert fault == [1] and not done  # one round: suspect, not confirmed
+
+
+class TestRealExercise:
+    def test_single_process_ok(self, tmp_path):
+        from conftest import cpu_subprocess_env
+
+        result = tmp_path / "res"
+        env = cpu_subprocess_env()
+        env.update({
+            NodeEnv.NODE_RANK: "0",
+            NodeEnv.NUM_PROCESSES: "1",
+            "DLROVER_TPU_CHECK_RESULT_PATH": str(result),
+            "DLROVER_TPU_CHECK_MATMUL_SIZE": "128",
+        })
+        proc = subprocess.run(
+            [sys.executable, "-m", "dlrover_tpu.agent.run_device_check"],
+            env=env, timeout=60, capture_output=True,
+        )
+        assert proc.returncode == 0, proc.stderr.decode()
+        assert float(result.read_text()) > 0
+
+    def test_mock_err_rank_fails(self):
+        from conftest import cpu_subprocess_env
+
+        env = cpu_subprocess_env()
+        env.update({
+            NodeEnv.NODE_RANK: "1",
+            NodeEnv.MOCK_ERR_RANK: "1",
+            NodeEnv.NUM_PROCESSES: "1",
+        })
+        proc = subprocess.run(
+            [sys.executable, "-m", "dlrover_tpu.agent.run_device_check"],
+            env=env, timeout=60, capture_output=True,
+        )
+        assert proc.returncode == 1
+
+    @pytest.mark.e2e
+    def test_two_process_allgather(self, tmp_path):
+        from conftest import cpu_subprocess_env
+
+        port = find_free_port()
+        procs = []
+        for pid in range(2):
+            env = cpu_subprocess_env()
+            env.update({
+                NodeEnv.NODE_RANK: str(pid),
+                NodeEnv.COORDINATOR_ADDR: f"127.0.0.1:{port}",
+                NodeEnv.NUM_PROCESSES: "2",
+                NodeEnv.PROCESS_ID: str(pid),
+                "DLROVER_TPU_CHECK_RESULT_PATH": str(tmp_path / f"r{pid}"),
+                "DLROVER_TPU_CHECK_MATMUL_SIZE": "128",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "dlrover_tpu.agent.run_device_check"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            ))
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            assert p.returncode == 0, out.decode()

@@ -50,6 +50,19 @@ class TestElasticRun:
         )
         assert result.returncode == 0, result.stderr[-2000:]
 
+    def test_standalone_with_network_check(self):
+        """--network-check runs the device-check round before training."""
+        job = f"e2e-{uuid.uuid4().hex[:6]}"
+        result = _run_cli(
+            [
+                "--standalone", "--nproc_per_node=1", f"--job_name={job}",
+                "--monitor_interval=0.2", "--network-check",
+                SCRIPT, "--", "--steps", "3",
+            ],
+            extra_env={"DLROVER_TPU_CHECK_MATMUL_SIZE": "128"},
+        )
+        assert result.returncode == 0, result.stderr[-2000:]
+
     def test_crash_restart_resumes(self, tmp_path):
         job = f"e2e-{uuid.uuid4().hex[:6]}"
         sentinel = str(tmp_path / "crash.sentinel")

@@ -199,3 +199,33 @@ class TestShardCheckpoint:
             starts.add(task.start)
             tm.report_task("ds2", task.task_id, True)
         assert t.start in starts
+
+
+class TestErrorMonitor:
+    def test_word_boundary_classification(self):
+        from dlrover_tpu.common.constants import NodeExitReason
+        from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
+
+        # Benign words must not trigger fatal classification.
+        for benign in (
+            "KeyError in policies lookup",
+            "suspicious bloom filter mismatch",
+            "assertion failed in hbm_viewer formatting",
+        ):
+            assert ErrorMonitor.classify(benign) == NodeExitReason.FATAL_ERROR
+        assert (
+            ErrorMonitor.classify("RESOURCE_EXHAUSTED: while allocating")
+            == NodeExitReason.OOM
+        )
+        assert (
+            ErrorMonitor.classify("jaxlib: out of memory allocating 2G")
+            == NodeExitReason.OOM
+        )
+        assert (
+            ErrorMonitor.classify("TPU halted unexpectedly")
+            == NodeExitReason.HARDWARE_ERROR
+        )
+        assert (
+            ErrorMonitor.classify("ICI link failure on port 3")
+            == NodeExitReason.HARDWARE_ERROR
+        )
