@@ -143,7 +143,7 @@ class ElasticTrainingAgent:
         """Start the async flash-checkpoint saver thread in this process."""
         from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
 
-        AsyncCheckpointSaver.start_async_saving_ckpt()
+        AsyncCheckpointSaver.start_async_saving_ckpt(self._config.node_rank)
 
     def _save_shm_to_storage(self):
         from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver

@@ -1,0 +1,108 @@
+"""Flash-checkpoint wire/shared-memory metadata.
+
+These dataclasses cross two boundaries, so they live in ``common``:
+
+- trainer engine → agent saver, pickled over the "factory" / event
+  ``SharedQueue`` (parity: reference ``ckpt_saver.py`` ``SaverClassMeta`` and
+  the save-event protocol, ``dlrover/python/elastic_agent/torch/ckpt_saver.py:395-482``);
+- trainer engine ↔ agent saver through the checkpoint ``SharedDict`` (parity:
+  the reference's TensorMeta tree stored in the meta SharedDict,
+  ``ckpt_saver.py:206-291``).
+
+The agent side must never import jax (the agent process should not grab a
+TPU client), so everything here is numpy/stdlib only.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Names of the on-host shared objects (namespaced per job by the socket
+# dir, and per node rank so same-host multi-agent tests never collide).
+
+
+def ckpt_factory_queue(node_rank: int) -> str:
+    return f"ckpt_factory_n{node_rank}"
+
+
+def ckpt_event_queue(node_rank: int) -> str:
+    return f"ckpt_events_n{node_rank}"
+
+
+def ckpt_meta_dict(node_rank: int) -> str:
+    return f"ckpt_meta_n{node_rank}"
+
+
+def ckpt_lock_name(node_rank: int, local_rank: int) -> str:
+    return f"ckpt_lock_n{node_rank}_{local_rank}"
+
+
+def ckpt_shm_name(job: str, node_rank: int, local_rank: int) -> str:
+    return f"ckpt_{job}_n{node_rank}_rank{local_rank}"
+
+
+@dataclass
+class TensorMeta:
+    """One array staged in the shm buffer."""
+
+    path: str  # jax.tree_util.keystr of the leaf's key path
+    offset: int
+    nbytes: int
+    dtype: str
+    shape: Tuple[int, ...]
+
+    def read(self, buf: memoryview) -> np.ndarray:
+        arr = np.frombuffer(
+            buf[self.offset : self.offset + self.nbytes], dtype=self.dtype
+        )
+        return arr.reshape(self.shape).copy()
+
+
+@dataclass
+class ShardMeta:
+    """Everything needed to rebuild one rank's state dict from its buffer."""
+
+    step: int = -1
+    shm_name: str = ""
+    used_bytes: int = 0
+    tensors: List[TensorMeta] = field(default_factory=list)
+    # Non-array leaves: path -> pickled-safe python object (int step counters,
+    # strings, ...). Stored inline — they are tiny.
+    objects: Dict[str, Any] = field(default_factory=dict)
+    # Identity of this shard in the global checkpoint.
+    global_shard_id: int = 0
+    global_shard_num: int = 1
+    # False for ranks that stage to memory (fast local restore) but whose
+    # shard is persisted by another rank — replicated state dicts persist
+    # only rank 0's copy.
+    persist: bool = True
+    # Monotonic id distinguishing buffer layouts (size growth recreates shm).
+    layout_version: int = 0
+
+
+@dataclass
+class SaverRegistration:
+    """Trainer → agent: create/configure the saver singleton.
+
+    Parity: reference ``SaverClassMeta`` through the factory queue
+    (``ckpt_saver.py:395-414``).
+    """
+
+    class_name: str = "CommonDirCheckpointSaver"
+    checkpoint_dir: str = ""
+    local_shard_num: int = 1
+    global_shard_num: int = 1
+    node_rank: int = 0
+    # Whether this node's agent also runs the global commit (tracker file).
+    is_committer: bool = True
+    keep_latest: int = 3
+
+
+@dataclass
+class SaveEvent:
+    """Trainer → agent: persist the current memory snapshot of `step`."""
+
+    step: int = -1
+    # "save" persists to storage; "stop" shuts the saver loop down.
+    kind: str = "save"

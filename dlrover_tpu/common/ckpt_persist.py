@@ -1,0 +1,141 @@
+"""Shard persistence + two-phase commit, shared by agent saver and
+standalone (agent-less) trainer engines.
+
+Layout under ``checkpoint_dir`` (parity: reference done-file + tracker-file
+protocol, ``dlrover/python/elastic_agent/torch/ckpt_saver.py:747-785``)::
+
+    checkpoint-{step}/shard_{gid}.bin    raw shm buffer (used bytes only)
+    checkpoint-{step}/shard_{gid}.meta   pickled ShardMeta
+    checkpoint-{step}/done_{gid}         commit vote of shard gid
+    latest_checkpointed_iteration.txt    tracker: last fully-committed step
+
+A step is readable iff the tracker names it; the tracker is written only
+after every ``done_*`` file exists, so readers can never observe a torn
+checkpoint.
+"""
+
+import os
+import pickle
+import time
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.common.ckpt_meta import ShardMeta
+from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.storage import CheckpointStorage
+
+
+def step_dir(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, f"{CheckpointConstant.STEP_DIR_PREFIX}{step}")
+
+
+def _tracker_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE)
+
+
+def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
+                  meta: ShardMeta, buf: memoryview) -> None:
+    """Write one shard's buffer + meta and its done file."""
+    d = step_dir(ckpt_dir, meta.step)
+    storage.safe_makedirs(d)
+    gid = meta.global_shard_id
+    prefix = os.path.join(d, f"{CheckpointConstant.SHARD_FILE_PREFIX}{gid}")
+    storage.write_bytes(bytes(buf[: meta.used_bytes]), prefix + ".bin")
+    storage.write_bytes(pickle.dumps(meta), prefix + ".meta")
+    storage.write(
+        "", os.path.join(d, f"{CheckpointConstant.DONE_FILE_PREFIX}{gid}")
+    )
+
+
+def count_done(storage: CheckpointStorage, ckpt_dir: str, step: int) -> int:
+    d = step_dir(ckpt_dir, step)
+    return sum(
+        1 for f in storage.listdir(d)
+        if f.startswith(CheckpointConstant.DONE_FILE_PREFIX)
+    )
+
+
+def commit_step(storage: CheckpointStorage, ckpt_dir: str, step: int,
+                global_shard_num: int, timeout: float = 600.0) -> bool:
+    """Wait for every shard's done file, then publish `step` in the tracker.
+
+    Returns False (and leaves the tracker untouched) on timeout — a partial
+    step directory is garbage-collected later, never published.
+    """
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        n = count_done(storage, ckpt_dir, step)
+        if n >= global_shard_num:
+            storage.write(str(step), _tracker_path(ckpt_dir))
+            logger.info(
+                "flash ckpt: committed step %s (%s shards)", step, n
+            )
+            return True
+        time.sleep(0.1)
+    logger.error(
+        "flash ckpt: commit of step %s timed out (%s/%s done)",
+        step, count_done(storage, ckpt_dir, step), global_shard_num,
+    )
+    return False
+
+
+def read_tracker(storage: CheckpointStorage, ckpt_dir: str) -> Optional[int]:
+    content = storage.read(_tracker_path(ckpt_dir))
+    if not content:
+        return None
+    try:
+        return int(str(content).strip())
+    except ValueError:
+        return None
+
+
+def load_shard(storage: CheckpointStorage, ckpt_dir: str, step: int,
+               gid: int) -> Optional[Tuple[ShardMeta, bytes]]:
+    d = step_dir(ckpt_dir, step)
+    prefix = os.path.join(d, f"{CheckpointConstant.SHARD_FILE_PREFIX}{gid}")
+    raw_meta = storage.read_bytes(prefix + ".meta")
+    raw_bin = storage.read_bytes(prefix + ".bin")
+    if raw_meta is None or raw_bin is None:
+        return None
+    return pickle.loads(raw_meta), raw_bin
+
+
+def list_steps(storage: CheckpointStorage, ckpt_dir: str) -> List[int]:
+    """Sorted step numbers that have a step directory (committed or not)."""
+    steps = []
+    for name in storage.listdir(ckpt_dir):
+        if name.startswith(CheckpointConstant.STEP_DIR_PREFIX):
+            try:
+                steps.append(
+                    int(name[len(CheckpointConstant.STEP_DIR_PREFIX):])
+                )
+            except ValueError:
+                continue
+    return sorted(steps)
+
+
+def gc_steps(storage: CheckpointStorage, ckpt_dir: str, keep_latest: int,
+             global_shard_num: int = 0):
+    """Drop old step dirs: keep the newest `keep_latest` *fully committed*
+    dirs (all done files present, when global_shard_num is known); delete
+    every other dir at or below the tracker step — including torn partial
+    saves from crash flushes, which otherwise leak multi-GB dirs forever.
+    Dirs newer than the tracker are in-flight and never touched."""
+    tracker = read_tracker(storage, ckpt_dir)
+    if tracker is None or keep_latest <= 0:
+        return
+    candidates = [s for s in list_steps(storage, ckpt_dir) if s <= tracker]
+
+    def complete(s: int) -> bool:
+        if s == tracker:
+            return True  # the published step is always kept
+        if global_shard_num <= 0:
+            return True
+        return count_done(storage, ckpt_dir, s) >= global_shard_num
+
+    keep = set(
+        [s for s in candidates if complete(s)][-keep_latest:] + [tracker]
+    )
+    for s in candidates:
+        if s not in keep:
+            storage.safe_remove(step_dir(ckpt_dir, s))

@@ -338,6 +338,28 @@ class SharedDict(LocalSocketComm):
         return self._call("copy")
 
 
+def server_exists(kind: str, name: str, job: str = "") -> bool:
+    """True iff the owner process of a shared object is live and accepting.
+
+    A real connect probe, not a stat: a SIGKILLed agent leaves its socket
+    file behind, and a stale file must not make a standalone trainer
+    misdetect agent mode. Used by the checkpoint engine to decide between
+    agent mode (stage to shm, agent persists asynchronously) and standalone
+    mode (persist inline).
+    """
+    job = job or os.getenv("DLROVER_TPU_JOB_NAME", "local-job")
+    path = _sock_path(job, kind, name)
+    if not os.path.exists(path):
+        return False
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(2.0)
+            s.connect(path)
+        return True
+    except OSError:
+        return False
+
+
 def clear_job_sockets(job: str):
     """Remove all socket files of a job (test/bootstrap hygiene)."""
     d = CommResource.SOCKET_DIR_FMT.format(job=job)

@@ -1,0 +1,17 @@
+"""Flash Checkpoint — trainer-side engines and the user-facing API.
+
+Capability parity with the reference's
+``dlrover/trainer/torch/flash_checkpoint/`` (engine.py + checkpointer.py):
+state is staged from device to a host shared-memory buffer in milliseconds;
+the elastic agent persists it to storage asynchronously and flushes the last
+snapshot when anything crashes. TPU-specific: the state dict is a JAX pytree,
+D2H goes through ``jax.device_get`` batching, and multi-host step consistency
+rides the master kv-store instead of a gloo process group.
+"""
+
+from dlrover_tpu.train.checkpoint.checkpointer import (  # noqa: F401
+    Checkpointer,
+    FlashCheckpointer,
+    StorageType,
+)
+from dlrover_tpu.train.checkpoint.engine import CheckpointEngine  # noqa: F401

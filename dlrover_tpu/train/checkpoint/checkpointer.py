@@ -1,0 +1,104 @@
+"""User-facing flash-checkpoint API.
+
+Parity: reference ``dlrover/trainer/torch/flash_checkpoint/checkpointer.py``
+(``Checkpointer`` ABC + ``StorageType``) and ``ddp.py`` (the replicated-state
+checkpointer). Typical loop::
+
+    ckpt = FlashCheckpointer("/ckpts")          # replicated state, rank 0 saves
+    step, state = ckpt.load_checkpoint(state)   # resume (memory → disk)
+    for step in range(step + 1, steps):
+        state = train_step(state, batch)
+        ckpt.save_checkpoint(step, state, StorageType.MEMORY)   # every step, ~ms
+        if step % 100 == 0:
+            ckpt.save_checkpoint(step, state, StorageType.DISK) # async persist
+
+A crash at any point restores the last MEMORY snapshot (the agent flushes it
+to disk), not just the last DISK save.
+"""
+
+import os
+from typing import Any, Optional, Tuple
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.storage import CheckpointStorage
+from dlrover_tpu.train.checkpoint.engine import CheckpointEngine
+
+
+class StorageType:
+    MEMORY = 0
+    DISK = 1
+
+
+class Checkpointer:
+    """Base: one engine per process, storage-type dispatch."""
+
+    def __init__(self, engine: CheckpointEngine):
+        self._engine = engine
+
+    def save_checkpoint(self, step: int, state,
+                        storage_type: int = StorageType.DISK) -> bool:
+        if storage_type == StorageType.MEMORY:
+            return self._engine.save_to_memory(step, state)
+        return self._engine.save_to_storage(step, state)
+
+    def load_checkpoint(self, template) -> Tuple[int, Any]:
+        """Returns (last_step, state); (-1, template) when no checkpoint."""
+        return self._engine.load(template)
+
+    def wait_persisted(self, step: int, timeout: float = 120.0) -> bool:
+        return self._engine.wait_persisted(step, timeout)
+
+    @property
+    def engine(self) -> CheckpointEngine:
+        return self._engine
+
+    def close(self):
+        self._engine.close()
+
+
+class FlashCheckpointer(Checkpointer):
+    """Checkpointer for a state dict every process holds in full (pure DP).
+
+    Every process stages to its own shm (memory restore is node-local), but
+    only rank 0's copy is persisted as the single global disk shard
+    (parity: DdpCheckpointer, reference ``flash_checkpoint/ddp.py``). For
+    GSPMD-sharded states use ``ShardedCheckpointer`` (one shard per process).
+    """
+
+    def __init__(self, checkpoint_dir: str,
+                 storage: Optional[CheckpointStorage] = None,
+                 keep_latest: int = 3):
+        rank = int(os.getenv(NodeEnv.PROCESS_ID, "0"))
+        super().__init__(
+            CheckpointEngine(
+                checkpoint_dir,
+                global_shard_id=0,
+                global_shard_num=1,
+                persist_shard=rank == 0,
+                storage=storage,
+                keep_latest=keep_latest,
+            )
+        )
+
+
+class ShardedCheckpointer(Checkpointer):
+    """One shard per process — for GSPMD/pjit-sharded train states where each
+    process stages its addressable portion (parity: the FSDP/Megatron savers,
+    reference ``ckpt_saver.py:989-1029``). Requires the same world size on
+    restore; resharding restore lands with the accel layer."""
+
+    def __init__(self, checkpoint_dir: str,
+                 storage: Optional[CheckpointStorage] = None,
+                 keep_latest: int = 3):
+        rank = int(os.getenv(NodeEnv.PROCESS_ID, "0"))
+        world = int(os.getenv(NodeEnv.NUM_PROCESSES, "1"))
+        super().__init__(
+            CheckpointEngine(
+                checkpoint_dir,
+                global_shard_id=rank,
+                global_shard_num=world,
+                persist_shard=True,
+                storage=storage,
+                keep_latest=keep_latest,
+            )
+        )

@@ -1,0 +1,419 @@
+"""Trainer-side flash-checkpoint engine.
+
+Parity: reference ``dlrover/trainer/torch/flash_checkpoint/engine.py:47-304``
+(shm staging, readiness/step-consistency, memory/disk paths) merged with the
+shm-handler half of ``dlrover/python/elastic_agent/torch/ckpt_saver.py:171-291``
+(TensorMeta layout + buffer traversal), rebuilt for JAX:
+
+- the state dict is any JAX pytree; array leaves are staged into a POSIX shm
+  buffer, scalar/python leaves ride in the meta record;
+- D2H is one batched ``jax.device_get`` (async dispatch means the transfer
+  overlaps whatever is still running on device);
+- in **agent mode** (launched under `dlrover-tpu-run`) the engine registers a
+  saver with the agent over the factory queue and persists via save events —
+  `save_to_memory` returns in milliseconds and the agent owns disk I/O and
+  crash flushes;
+- in **standalone mode** (no agent) persists inline with the same two-phase
+  commit, so the file format is identical either way.
+"""
+
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common import ckpt_persist
+from dlrover_tpu.common.ckpt_meta import (
+    SaveEvent,
+    SaverRegistration,
+    ShardMeta,
+    TensorMeta,
+    ckpt_event_queue,
+    ckpt_factory_queue,
+    ckpt_lock_name,
+    ckpt_meta_dict,
+    ckpt_shm_name,
+)
+from dlrover_tpu.common.comm import (
+    SharedDict,
+    SharedLock,
+    SharedQueue,
+    server_exists,
+)
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.shared_memory import SharedMemory
+from dlrover_tpu.common.storage import CheckpointStorage, get_checkpoint_storage
+
+_ALIGN = 128  # bytes; keeps row-major copies cache-line aligned
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _flatten_state(state) -> Tuple[List[Tuple[str, Any]], Dict[str, Any]]:
+    """Split a pytree into (path, array) leaves and non-array objects.
+
+    Paths are ``jax.tree_util.keystr`` strings — deterministic for a given
+    tree structure, so a template flattened the same way yields the same keys.
+    """
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten_with_path(state)
+    arrays: List[Tuple[str, Any]] = []
+    objects: Dict[str, Any] = {}
+    for kp, leaf in leaves:
+        path = jax.tree_util.keystr(kp)
+        if isinstance(leaf, (jax.Array, np.ndarray, np.generic)):
+            arrays.append((path, leaf))
+        else:
+            objects[path] = leaf
+    return arrays, objects
+
+
+class CheckpointEngine:
+    """Stage one process's checkpoint shard into shared memory.
+
+    One engine per training process; ``global_shard_id``/``global_shard_num``
+    name this process's shard in the global checkpoint (for a replicated
+    state dict, rank 0 uses 1 shard; for a sharded state each process is a
+    shard — the DDP vs FSDP/Megatron saver split of the reference,
+    ``ckpt_saver.py:979-1029``).
+    """
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        global_shard_id: int = 0,
+        global_shard_num: int = 1,
+        persist_shard: bool = True,
+        storage: Optional[CheckpointStorage] = None,
+        keep_latest: int = 3,
+        job: str = "",
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self.global_shard_id = global_shard_id
+        self.global_shard_num = global_shard_num
+        # Every process stages to its own shm (so memory restore is local);
+        # only processes with persist_shard=True own a disk shard.
+        self.persist_shard = persist_shard
+        self.storage = get_checkpoint_storage(storage)
+        self.keep_latest = keep_latest
+        self._job = job or os.getenv(NodeEnv.JOB_NAME, "local-job")
+        self._local_rank = int(os.getenv(NodeEnv.LOCAL_RANK, "0"))
+        self._node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+        self._local_world = int(os.getenv(NodeEnv.LOCAL_WORLD_SIZE, "1"))
+        self._world_size = int(os.getenv(NodeEnv.NUM_PROCESSES, "1"))
+        self._rank = int(os.getenv(NodeEnv.PROCESS_ID, "0"))
+
+        self._shm: Optional[SharedMemory] = None
+        self._shm_name = ckpt_shm_name(
+            self._job, self._node_rank, self._local_rank
+        )
+        self._layout_version = 0
+        self._cached_step = -1
+
+        self.agent_mode = server_exists(
+            "queue", ckpt_factory_queue(self._node_rank), self._job
+        )
+        if self.agent_mode:
+            self._register_with_agent()
+            self._lock = SharedLock(
+                ckpt_lock_name(self._node_rank, self._local_rank),
+                create=False, job=self._job,
+            )
+            self._meta = SharedDict(
+                ckpt_meta_dict(self._node_rank), create=False, job=self._job
+            )
+            self._events = SharedQueue(
+                ckpt_event_queue(self._node_rank), create=False, job=self._job
+            )
+            logger.info(
+                "checkpoint engine in agent mode (shard %s/%s, shm %s)",
+                global_shard_id, global_shard_num, self._shm_name,
+            )
+        else:
+            self._lock = None
+            self._meta_local: Dict[str, bytes] = {}
+            logger.info(
+                "checkpoint engine in standalone mode (shard %s/%s)",
+                global_shard_id, global_shard_num,
+            )
+
+    # ------------- agent handshake -------------
+    def _register_with_agent(self):
+        factory = SharedQueue(
+            ckpt_factory_queue(self._node_rank), create=False, job=self._job
+        )
+        factory.put(
+            SaverRegistration(
+                class_name="CommonDirCheckpointSaver",
+                checkpoint_dir=self.checkpoint_dir,
+                local_shard_num=self._local_world,
+                global_shard_num=self.global_shard_num,
+                node_rank=self._node_rank,
+                is_committer=self._node_rank == 0,
+                keep_latest=self.keep_latest,
+            )
+        )
+
+    # ------------- staging -------------
+    def _materialize(self, arrays: List[Tuple[str, Any]]):
+        """Batched D2H: fetch all device arrays to host numpy at once."""
+        import jax
+
+        host = jax.device_get([a for _, a in arrays])
+        return [
+            (path, np.asarray(h)) for (path, _), h in zip(arrays, host)
+        ]
+
+    def _layout(self, host_arrays) -> Tuple[List[TensorMeta], int]:
+        metas, offset = [], 0
+        for path, arr in host_arrays:
+            nbytes = arr.nbytes
+            metas.append(
+                TensorMeta(
+                    path=path, offset=offset, nbytes=nbytes,
+                    dtype=str(arr.dtype), shape=tuple(arr.shape),
+                )
+            )
+            offset += _aligned(nbytes)
+        return metas, offset
+
+    def _ensure_shm(self, needed: int):
+        if self._shm is not None and self._shm.size >= needed:
+            return
+        if self._shm is None and SharedMemory.exists(self._shm_name):
+            try:
+                existing = SharedMemory(self._shm_name)
+                if existing.size >= needed:
+                    self._shm = existing
+                    return
+                existing.close()
+            except (ValueError, OSError):
+                pass
+        if self._shm is not None:
+            self._shm.close()
+        # Slack so steady-state training never recreates the segment.
+        size = _aligned(int(needed * 1.1) + 4096)
+        SharedMemory.remove(self._shm_name)
+        self._shm = SharedMemory(self._shm_name, create=True, size=size)
+        self._layout_version += 1
+        logger.info(
+            "created checkpoint shm %s (%.1f MB)",
+            self._shm_name, size / 1e6,
+        )
+
+    def save_to_memory(self, step: int, state, block: bool = False) -> bool:
+        """Stage `state` into the shm buffer. With ``block=False`` (the
+        MEMORY fast path) returns False when the saver is persisting this
+        buffer right now — a skipped snapshot is cheaper than a stalled step
+        (parity with the reference's skip-on-contention, ``engine.py:272``).
+        DISK saves pass ``block=True`` so a requested persist is never lost
+        to brief lock contention."""
+        if self._lock is not None and not self._lock.acquire(
+            blocking=block, timeout=30.0 if block else -1
+        ):
+            logger.warning(
+                "skip memory save at step %s: saver holds the shard lock",
+                step,
+            )
+            return False
+        try:
+            arrays, objects = _flatten_state(state)
+            host_arrays = self._materialize(arrays)
+            metas, used = self._layout(host_arrays)
+            self._ensure_shm(used)
+            buf = self._shm.buf
+            for meta, (_, arr) in zip(metas, host_arrays):
+                view = np.ndarray(
+                    arr.shape, dtype=arr.dtype, buffer=buf,
+                    offset=meta.offset,
+                )
+                np.copyto(view, arr)
+            self._shm.flush()
+            shard_meta = ShardMeta(
+                step=step,
+                shm_name=self._shm_name,
+                used_bytes=used,
+                tensors=metas,
+                objects=objects,
+                global_shard_id=self.global_shard_id,
+                global_shard_num=self.global_shard_num,
+                persist=self.persist_shard,
+                layout_version=self._layout_version,
+            )
+            self._publish_meta(shard_meta)
+            self._cached_step = step
+            return True
+        finally:
+            if self._lock is not None:
+                self._lock.release()
+
+    def _publish_meta(self, shard_meta: ShardMeta):
+        raw = pickle.dumps(shard_meta)
+        if self.agent_mode:
+            self._meta.set(f"rank_{self._local_rank}", raw)
+        else:
+            self._meta_local[f"rank_{self._local_rank}"] = raw
+
+    def save_to_storage(self, step: int, state) -> bool:
+        """Memory save + asynchronous (agent) or inline (standalone) persist."""
+        if not self.save_to_memory(step, state, block=True):
+            return False
+        if self.agent_mode:
+            # Local rank 0 triggers the node's persist; the agent saver
+            # persists every persist-owning local shard of this step
+            # (parity: ddp_engine.py:102-127).
+            if self._local_rank == 0:
+                self._events.put(SaveEvent(step=step))
+            return True
+        if not self.persist_shard:
+            return True
+        return self._persist_inline(step)
+
+    def _persist_inline(self, step: int) -> bool:
+        meta = pickle.loads(self._meta_local[f"rank_{self._local_rank}"])
+        ckpt_persist.persist_shard(
+            self.storage, self.checkpoint_dir, meta, self._shm.buf
+        )
+        if self.global_shard_id == 0:
+            ok = ckpt_persist.commit_step(
+                self.storage, self.checkpoint_dir, step,
+                self.global_shard_num,
+            )
+            if ok:
+                ckpt_persist.gc_steps(
+                    self.storage, self.checkpoint_dir, self.keep_latest,
+                    self.global_shard_num,
+                )
+            return ok
+        return True
+
+    # ------------- restore -------------
+    def _memory_meta(self) -> Optional[ShardMeta]:
+        raw = (
+            self._meta.get(f"rank_{self._local_rank}")
+            if self.agent_mode
+            else self._meta_local.get(f"rank_{self._local_rank}")
+        )
+        if not raw:
+            return None
+        try:
+            return pickle.loads(raw)
+        except Exception:
+            return None
+
+    def _consistent_memory_step(self, my_step: int) -> bool:
+        """All processes must restore the same step; vote via the master
+        kv-store (the reference allgathers on a gloo group, ``engine.py:64``)."""
+        if self._world_size <= 1 or not os.getenv(NodeEnv.MASTER_ADDR):
+            return my_step >= 0
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient.singleton_instance()
+        incarnation = os.getenv(NodeEnv.RESTART_COUNT, "0")
+        prefix = f"ckpt_vote/{incarnation}"
+        client.kv_store_set(f"{prefix}/{self._rank}", str(my_step).encode())
+        keys = [f"{prefix}/{r}" for r in range(self._world_size)]
+        try:
+            votes = client.kv_store_wait(keys, timeout=60.0)
+        except TimeoutError:
+            logger.warning("checkpoint step vote timed out; using storage")
+            return False
+        steps = {int(v.decode()) for v in votes.values()}
+        return len(steps) == 1 and my_step >= 0
+
+    def load(self, template) -> Tuple[int, Any]:
+        """Restore (step, state). Memory snapshot first, storage fallback.
+
+        `template` is a pytree of the same structure (e.g. the freshly
+        initialized train state); its leaves define paths, dtypes and shapes.
+        Returns ``(-1, template)`` when nothing is restorable.
+        """
+        meta = self._memory_meta()
+        has_memory = meta is not None and SharedMemory.exists(self._shm_name)
+        my_step = meta.step if has_memory else -1
+        # Vote unconditionally — a rank with no snapshot must still publish
+        # -1, or every other rank blocks the full wait before falling back.
+        consistent = self._consistent_memory_step(my_step)
+        if has_memory:
+            if consistent:
+                try:
+                    shm = self._shm or SharedMemory(self._shm_name)
+                    self._shm = shm
+                    state = self._rebuild(template, meta, shm.buf)
+                    self._cached_step = meta.step
+                    logger.info(
+                        "restored step %s from memory snapshot", meta.step
+                    )
+                    return meta.step, state
+                except Exception:
+                    logger.exception("memory restore failed; trying storage")
+        return self._load_from_storage(template)
+
+    def _load_from_storage(self, template) -> Tuple[int, Any]:
+        step = ckpt_persist.read_tracker(self.storage, self.checkpoint_dir)
+        if step is None:
+            return -1, template
+        shard = ckpt_persist.load_shard(
+            self.storage, self.checkpoint_dir, step, self.global_shard_id
+        )
+        if shard is None:
+            logger.error(
+                "tracker names step %s but shard %s is missing",
+                step, self.global_shard_id,
+            )
+            return -1, template
+        meta, raw = shard
+        state = self._rebuild(template, meta, memoryview(raw))
+        self._cached_step = step
+        logger.info("restored step %s from storage", step)
+        return step, state
+
+    def _rebuild(self, template, meta: ShardMeta, buf: memoryview):
+        import jax
+
+        by_path = {t.path: t for t in meta.tensors}
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+        out = []
+        for kp, leaf in leaves:
+            path = jax.tree_util.keystr(kp)
+            if path in by_path:
+                out.append(by_path[path].read(buf))
+            elif path in meta.objects:
+                out.append(meta.objects[path])
+            else:
+                raise KeyError(
+                    f"checkpoint is missing leaf {path}; topology or model "
+                    "definition changed since the snapshot"
+                )
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # ------------- misc -------------
+    @property
+    def cached_step(self) -> int:
+        return self._cached_step
+
+    def wait_persisted(self, step: int, timeout: float = 120.0) -> bool:
+        """Block until a step >= `step` is committed in storage.
+
+        `>=` because the async saver may chase a newer snapshot when the
+        trainer outpaces it; the committed step is never older than asked.
+        """
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            tracker = ckpt_persist.read_tracker(
+                self.storage, self.checkpoint_dir
+            )
+            if tracker is not None and tracker >= step:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def close(self):
+        if self._shm is not None:
+            self._shm.close()

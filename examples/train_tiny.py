@@ -1,8 +1,10 @@
 """Minimal elastic training script used by the e2e tests and demos.
 
-Trains a tiny linear regression with plain JAX. Demonstrates the trainer
-contract: ``init_training()`` bootstrap, master-backed progress reporting,
-and (optionally) a one-shot injected crash to exercise agent restarts.
+Trains a tiny linear regression with plain JAX. Demonstrates the full
+trainer contract: ``init_training()`` bootstrap, flash checkpointing
+(memory snapshot every step, disk persist every ``--persist-every``),
+master-backed progress reporting, and (optionally) a one-shot injected
+crash to exercise agent restart + checkpoint resume.
 """
 
 import argparse
@@ -16,6 +18,7 @@ import optax
 
 from dlrover_tpu import train as dtrain
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.train.checkpoint import FlashCheckpointer, StorageType
 
 
 def main():
@@ -24,7 +27,10 @@ def main():
     parser.add_argument("--crash-at", type=int, default=-1,
                         help="crash at this step on the first run")
     parser.add_argument("--crash-sentinel", type=str, default="")
-    parser.add_argument("--progress-file", type=str, default="")
+    parser.add_argument("--ckpt-dir", type=str, default="")
+    parser.add_argument("--persist-every", type=int, default=5)
+    parser.add_argument("--resume-marker", type=str, default="",
+                        help="file to record the step resumed from")
     parser.add_argument("--expect-world", type=int, default=0)
     args = parser.parse_args()
 
@@ -43,22 +49,34 @@ def main():
     w = jnp.zeros((4,))
     x = jax.random.normal(key, (64, 4))
     y = x @ jnp.array([1.0, -2.0, 3.0, 0.5])
-    opt = optax.sgd(0.1)
-    opt_state = opt.init(w)
+    opt = optax.adam(0.5)
+    state = {"w": w, "opt": opt.init(w), "step": 0}
 
     @jax.jit
-    def step_fn(w, opt_state):
+    def step_fn(state):
         def loss_fn(w):
             return jnp.mean((x @ w - y) ** 2)
 
-        loss, grads = jax.value_and_grad(loss_fn)(w)
-        updates, opt_state = opt.update(grads, opt_state)
-        return optax.apply_updates(w, updates), opt_state, loss
+        loss, grads = jax.value_and_grad(loss_fn)(state["w"])
+        updates, opt_state = opt.update(grads, state["opt"])
+        return {
+            "w": optax.apply_updates(state["w"], updates),
+            "opt": opt_state,
+            "step": state["step"] + 1,
+        }, loss
 
+    ckpt = None
     start = 0
-    if args.progress_file and os.path.exists(args.progress_file):
-        with open(args.progress_file) as f:
-            start = int(f.read().strip() or 0)
+    if args.ckpt_dir:
+        ckpt = FlashCheckpointer(args.ckpt_dir)
+        last_step, state = ckpt.load_checkpoint(state)
+        start = max(0, last_step)
+        if args.resume_marker and start > 0:
+            with open(args.resume_marker, "w") as f:
+                f.write(str(start))
+        if start > 0:
+            print(f"rank {rank}: resumed from flash checkpoint at step "
+                  f"{start}", flush=True)
 
     for step in range(start, args.steps):
         if (
@@ -71,15 +89,23 @@ def main():
                 f.write("crashed")
             print(f"rank {rank}: injected crash at step {step}", flush=True)
             sys.exit(1)
-        w, opt_state, loss = step_fn(w, opt_state)
-        if args.progress_file:
-            with open(args.progress_file, "w") as f:
-                f.write(str(step + 1))
+        state, loss = step_fn(state)
+        if ckpt is not None:
+            if args.persist_every and (step + 1) % args.persist_every == 0:
+                ckpt.save_checkpoint(step + 1, state, StorageType.DISK)
+            else:
+                ckpt.save_checkpoint(step + 1, state, StorageType.MEMORY)
         if client is not None and rank == 0:
             client.report_global_step(step + 1, time.time())
 
-    final_loss = float(jnp.mean((x @ w - y) ** 2))
-    print(f"rank {rank}: done, final loss {final_loss:.6f}", flush=True)
+    final_loss = float(jnp.mean((x @ state["w"] - y) ** 2))
+    resumed_step = int(state["step"])
+    print(f"rank {rank}: done at step {resumed_step}, final loss "
+          f"{final_loss:.6f}", flush=True)
+    assert resumed_step == args.steps, (
+        f"step counter {resumed_step} != {args.steps}: checkpoint resume "
+        "lost training state"
+    )
     if args.steps >= 15:  # enough steps to converge
         assert final_loss < 1.0
 

@@ -63,23 +63,40 @@ class TestElasticRun:
         )
         assert result.returncode == 0, result.stderr[-2000:]
 
-    def test_crash_restart_resumes(self, tmp_path):
+    def test_crash_restart_resumes_from_flash_checkpoint(self, tmp_path):
+        """The core goodput scenario: every-step MEMORY snapshots, DISK
+        persist every 10 steps, crash at step 7. The agent flushes the step-7
+        memory snapshot to storage; the restarted worker resumes model +
+        optimizer state from step 7 — NOT from the last disk persist and not
+        from scratch. The trainer itself asserts its step counter reached
+        --steps through the restart."""
         job = f"e2e-{uuid.uuid4().hex[:6]}"
         sentinel = str(tmp_path / "crash.sentinel")
-        progress = str(tmp_path / "progress.txt")
+        ckpt_dir = str(tmp_path / "ckpts")
+        marker = str(tmp_path / "resumed_from.txt")
         result = _run_cli(
             [
                 "--standalone", "--nproc_per_node=1", f"--job_name={job}",
                 "--monitor_interval=0.2", "--max_restarts=2",
                 SCRIPT, "--",
-                "--steps", "6", "--crash-at", "3",
-                "--crash-sentinel", sentinel, "--progress-file", progress,
+                "--steps", "12", "--crash-at", "7",
+                "--crash-sentinel", sentinel,
+                "--ckpt-dir", ckpt_dir, "--persist-every", "10",
+                "--resume-marker", marker,
             ],
         )
         assert result.returncode == 0, result.stderr[-2000:]
         assert os.path.exists(sentinel), "crash was never injected"
-        with open(progress) as f:
-            assert int(f.read()) == 6
+        assert os.path.exists(marker), "worker never resumed from checkpoint"
+        with open(marker) as f:
+            resumed = int(f.read())
+        assert resumed == 7, f"resumed from {resumed}, expected 7"
+        # The step-7 dir on disk proves the crash-FLUSH path specifically:
+        # no periodic DISK save could have created it (persist-every=10),
+        # and the memory-restore path alone would not touch storage.
+        assert os.path.isdir(os.path.join(ckpt_dir, "checkpoint-7")), (
+            "agent crash flush never persisted the step-7 memory snapshot"
+        )
 
     def test_two_node_world(self, tmp_path):
         """Two agents rendezvous through one master; workers form a
