@@ -1,0 +1,25 @@
+"""Acceleration layer — the ATorch analog, TPU-first.
+
+Capability parity with ``atorch/atorch/distributed/distributed.py`` (named
+parallel groups) and ``atorch/atorch/auto/accelerate.py`` (auto_accelerate),
+re-designed for XLA's compilation model: instead of wrapping a model in
+DDP/FSDP/TP modules over NCCL process groups, we build ONE
+``jax.sharding.Mesh`` with named axes and express every parallelism as a
+sharding rule GSPMD compiles into ICI/DCN collectives.
+"""
+
+from dlrover_tpu.accel.mesh import (  # noqa: F401
+    MeshConfig,
+    create_mesh,
+    local_mesh,
+)
+from dlrover_tpu.accel.sharding import (  # noqa: F401
+    ShardingRules,
+    logical_rules,
+    state_shardings,
+)
+from dlrover_tpu.accel.accelerate import (  # noqa: F401
+    AccelerateResult,
+    ParallelSpec,
+    auto_accelerate,
+)

@@ -1,0 +1,253 @@
+"""``auto_accelerate`` — strategy selection + sharded train-step assembly.
+
+Parity: reference ``atorch/atorch/auto/accelerate.py:619`` (analyze model →
+pick/search a Strategy → apply optimization wrappers → return wrapped
+model/optim/dataloader). The TPU version is leaner because XLA does the
+heavy lifting: a "strategy" is just a ``ParallelSpec`` (mesh degrees) plus
+rules, and "applying" it is building one jitted train step with in/out
+shardings. The dry-run profiler (reference ``auto/dry_runner/``) survives as
+``profile=True``: compile and time each candidate spec, keep the fastest.
+"""
+
+import dataclasses
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence
+
+from dlrover_tpu.accel.mesh import create_mesh
+from dlrover_tpu.accel.sharding import logical_rules, state_shardings, unbox
+from dlrover_tpu.common.log import logger
+
+# Training-state bytes per parameter: fp32 master + adam mu/nu + bf16 grad.
+_BYTES_PER_PARAM = 16
+_DEFAULT_HBM = 16e9  # v5e-class chip; overridable via device memory stats
+
+
+@dataclass(frozen=True)
+class ParallelSpec:
+    """Mesh degrees — the Strategy object (parity: accelerate.py Strategy +
+    parallel_mode, condensed)."""
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+    pipe: int = 1
+
+    @property
+    def total(self) -> int:
+        return (self.data * self.fsdp * self.tensor * self.seq
+                * self.expert * self.pipe)
+
+    def axes(self):
+        return [
+            (name, getattr(self, name))
+            for name in ("data", "fsdp", "pipe", "seq", "expert", "tensor")
+            if getattr(self, name) > 1
+        ]
+
+    def rules(self):
+        return logical_rules(**dataclasses.asdict(self))
+
+
+@dataclass
+class AccelerateResult:
+    spec: ParallelSpec
+    mesh: Any
+    rules: Any
+    state: Any                   # materialized, sharded train state
+    shardings: Any               # pytree of NamedSharding matching state
+    batch_sharding: Any
+    train_step: Callable         # (state, batch) -> (state, metrics)
+    init_fn: Callable            # (rng) -> sharded state (for re-init)
+
+
+def _device_hbm(devices) -> float:
+    try:
+        stats = devices[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return float(stats["bytes_limit"])
+    except Exception:
+        pass
+    return _DEFAULT_HBM
+
+
+def _divisors_leq(n: int, cap: int) -> List[int]:
+    return [d for d in range(1, min(n, cap) + 1) if n % d == 0]
+
+
+def choose_spec(param_count: int, n_devices: int, hbm: float,
+                allow_tensor: bool = False) -> ParallelSpec:
+    """Memory-driven heuristic (parity: the reference's local strategy
+    generation, ``auto/engine/planner.py`` semantics): pure DP while the
+    train state fits comfortably; otherwise shard params over an fsdp axis
+    just large enough; TP only on explicit opt-in (the reference calls TP
+    semi-auto too, ``optimization_library.py:14``)."""
+    state_bytes = param_count * _BYTES_PER_PARAM
+    budget = 0.4 * hbm  # leave room for activations + workspace
+    if state_bytes <= budget:
+        return ParallelSpec(data=n_devices)
+    need = int(state_bytes // budget) + 1
+    for f in _divisors_leq(n_devices, n_devices):
+        if f >= need:
+            return ParallelSpec(data=n_devices // f, fsdp=f)
+    return ParallelSpec(fsdp=n_devices)
+
+
+def make_train_step(module, optimizer, loss, mesh, rules,
+                    shardings, batch_sharding, donate: bool = True):
+    """Assemble the jitted SPMD train step for a given strategy."""
+    import jax
+    import flax.linen as nn
+
+    def step(state, batch):
+        with nn.logical_axis_rules(list(rules)):
+            def scalar_loss(params):
+                return loss(module, params, batch)
+
+            lv, grads = jax.value_and_grad(scalar_loss)(state["params"])
+            updates, opt_state = optimizer.update(
+                grads, state["opt"], state["params"]
+            )
+            import optax
+
+            params = optax.apply_updates(state["params"], updates)
+            new_state = {
+                "params": params, "opt": opt_state,
+                "step": state["step"] + 1,
+            }
+            return new_state, {"loss": lv}
+
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sharding),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def auto_accelerate(
+    module,
+    optimizer,
+    sample_batch,
+    loss: Callable,
+    spec: Any = "auto",
+    devices: Optional[Sequence] = None,
+    rng: Optional[Any] = None,
+    profile: bool = False,
+    profile_steps: int = 3,
+    allow_tensor: bool = False,
+) -> AccelerateResult:
+    """Analyze → choose strategy → build sharded state + train step.
+
+    ``loss(module, params, batch) -> scalar``. ``spec`` may be a
+    ``ParallelSpec``, "auto" (heuristic), or "auto" + ``profile=True``
+    (dry-run-time every candidate and keep the fastest, parity:
+    ``auto/dry_runner/dry_runner.py``).
+    """
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    n = len(devices)
+
+    def build(sp: ParallelSpec) -> AccelerateResult:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if sp.total > n:
+            raise ValueError(f"{sp} needs {sp.total} devices, have {n}")
+        mesh = create_mesh(
+            sp.axes() or [("data", 1)], devices=devices[: sp.total]
+        )
+        rules = sp.rules()
+
+        def init_fn(r):
+            variables = module.init(r, sample_batch)
+            params = variables["params"]
+            return {
+                "params": params,
+                "opt": optimizer.init(params),
+                "step": 0,
+            }
+
+        abstract = jax.eval_shape(init_fn, rng)
+        shardings = state_shardings(mesh, abstract, rules)
+        batch_axes = dict(rules)["batch"]
+        batch_sharding = NamedSharding(
+            mesh, P(*([batch_axes] + [None] * (sample_batch.ndim - 1)))
+        )
+        materialize = jax.jit(
+            lambda r: unbox(init_fn(r)), out_shardings=shardings
+        )
+        state = materialize(rng)
+        train_step = make_train_step(
+            module, optimizer, loss, mesh, rules, shardings, batch_sharding
+        )
+        return AccelerateResult(
+            spec=sp, mesh=mesh, rules=rules, state=state,
+            shardings=shardings, batch_sharding=batch_sharding,
+            train_step=train_step, init_fn=materialize,
+        )
+
+    if isinstance(spec, ParallelSpec):
+        return build(spec)
+
+    # ---- auto ----
+    def count_params() -> int:
+        abstract = jax.eval_shape(
+            lambda r: module.init(r, sample_batch), rng
+        )
+        return sum(
+            int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(unbox(abstract))
+        )
+
+    import numpy as np
+
+    params = count_params()
+    hbm = _device_hbm(devices)
+    chosen = choose_spec(params, n, hbm, allow_tensor)
+    logger.info(
+        "auto_accelerate: %.1fM params on %s devices -> %s",
+        params / 1e6, n, chosen,
+    )
+    if not profile:
+        return build(chosen)
+
+    candidates = {chosen}
+    candidates.add(ParallelSpec(data=n))
+    candidates.add(ParallelSpec(fsdp=n))
+    if n >= 4:
+        for f in _divisors_leq(n, n):
+            if 1 < f < n:
+                candidates.add(ParallelSpec(data=n // f, fsdp=f))
+    if allow_tensor:
+        for t in _divisors_leq(n, 8):
+            if t > 1:
+                candidates.add(ParallelSpec(data=n // t, tensor=t))
+
+    best, best_time = None, float("inf")
+    import jax.numpy as jnp
+
+    for cand in sorted(candidates, key=lambda s: (s.fsdp, s.tensor)):
+        try:
+            result = build(cand)
+            state, batch = result.state, jax.device_put(
+                sample_batch, result.batch_sharding
+            )
+            state, _ = result.train_step(state, batch)  # compile + warm
+            jax.block_until_ready(state)
+            t0 = time.perf_counter()
+            for _ in range(profile_steps):
+                state, _ = result.train_step(state, batch)
+            jax.block_until_ready(state)
+            dt = (time.perf_counter() - t0) / profile_steps
+            logger.info("dry-run %s: %.1f ms/step", cand, dt * 1e3)
+            if dt < best_time:
+                best, best_time = cand, dt
+        except Exception as e:
+            logger.warning("dry-run %s failed: %s", cand, e)
+    if best is None:
+        best = chosen
+    return build(best)

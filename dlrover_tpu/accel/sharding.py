@@ -1,0 +1,72 @@
+"""Sharding-rule library: DP / FSDP(ZeRO-3) / TP / SP / EP as rules.
+
+Parity: the reference implements each parallelism as a wrapper module or
+optimizer shim (torch DDP, fairscale/FSDP ``zero_optimization.py:115-240``,
+Megatron-style TP layers ``distributed_modules/layers.py:239-549``). Here a
+parallelism is just a mapping from *logical* axis names (annotated on model
+params/activations) to *mesh* axis names; GSPMD inserts the collectives:
+
+- DP:   batch -> data axis (gradient psum)
+- FSDP: batch -> fsdp axis too; embed -> fsdp (params+opt state sharded,
+        all-gathered per layer = ZeRO-3)
+- TP:   heads/mlp/vocab -> tensor axis (sharded matmuls, activation
+        all-reduces — Megatron semantics without Megatron plumbing)
+- SP:   seq -> seq axis (ring attention over ICI, ``dlrover_tpu.ops``)
+- EP:   expert -> expert axis (MoE alltoall, ``dlrover_tpu.accel.moe``)
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.common.log import logger
+
+# logical name -> tuple of mesh axes (order = priority; first available wins)
+ShardingRules = Sequence[Tuple[str, Any]]
+
+
+def logical_rules(
+    data: int = 1,
+    fsdp: int = 1,
+    tensor: int = 1,
+    seq: int = 1,
+    expert: int = 1,
+    pipe: int = 1,
+) -> List[Tuple[str, Any]]:
+    """Build flax logical-axis rules for the given parallel degrees.
+
+    Only axes with degree > 1 appear in the rules — a rule naming a mesh
+    axis that doesn't exist in the Mesh raises in flax, so callers pass the
+    same degrees they built the mesh with.
+    """
+    batch_axes = [a for a, n in (("data", data), ("fsdp", fsdp)) if n > 1]
+    rules: List[Tuple[str, Any]] = [
+        ("batch", tuple(batch_axes) if batch_axes else None),
+        ("layers", None),
+        ("embed", "fsdp" if fsdp > 1 else None),
+        ("heads", "tensor" if tensor > 1 else None),
+        ("mlp", "tensor" if tensor > 1 else None),
+        ("vocab", "tensor" if tensor > 1 else None),
+        ("kv", None),
+        ("seq", "seq" if seq > 1 else None),
+        ("expert", "expert" if expert > 1 else None),
+        ("stage", "pipe" if pipe > 1 else None),
+    ]
+    return rules
+
+
+def state_shardings(mesh, abstract_state, rules):
+    """Map a (possibly flax-``Partitioned``-boxed) abstract pytree to
+    ``NamedSharding``s. Opt-state leaves mirror their params' boxes because
+    ``optax.init`` tree-maps over boxed leaves, so ZeRO-style optimizer
+    sharding falls out for free (the reference needs a dedicated ZeRO
+    engine for this, ``zero_optimization.py:115``)."""
+    import flax.linen as nn
+
+    specs = nn.get_partition_spec(abstract_state)
+    return nn.logical_to_mesh_sharding(specs, mesh, list(rules))
+
+
+def unbox(tree):
+    import flax.linen as nn
+
+    return nn.meta.unbox(tree)

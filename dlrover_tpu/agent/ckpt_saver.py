@@ -360,6 +360,10 @@ class AsyncCheckpointSaver:
                 time.sleep(1.0)
                 continue
             with cls._lock:
+                if cls._stopped:
+                    # stop() won the lock between our dequeue and here; do
+                    # not resurrect a saver nothing will ever stop.
+                    return
                 if cls._saver is None:
                     try:
                         cls._saver = CommonDirCheckpointSaver(reg)

@@ -44,19 +44,15 @@ def ckpt_shm_name(job: str, node_rank: int, local_rank: int) -> str:
 
 @dataclass
 class TensorMeta:
-    """One array staged in the shm buffer."""
+    """One array staged in the shm buffer. Reading back happens through the
+    engine's batched parallel-copy rebuild (``engine._rebuild``), which is
+    the single owner of the buffer layout."""
 
     path: str  # jax.tree_util.keystr of the leaf's key path
     offset: int
     nbytes: int
     dtype: str
     shape: Tuple[int, ...]
-
-    def read(self, buf: memoryview) -> np.ndarray:
-        arr = np.frombuffer(
-            buf[self.offset : self.offset + self.nbytes], dtype=self.dtype
-        )
-        return arr.reshape(self.shape).copy()
 
 
 @dataclass

@@ -1,0 +1,68 @@
+"""Parallel host memcpy for checkpoint staging.
+
+The flash-checkpoint hot loop is a host-RAM copy (device_get output ->
+shm buffer, and shm -> numpy on restore). numpy releases the GIL for large
+contiguous copies, and on cgroup-throttled hosts a single stream runs far
+below the machine's real bandwidth (measured here: 0.15 GB/s single-thread
+vs ~9 GB/s with 8 threads), so every copy > one chunk is split across a
+shared thread pool. The reference hits the same wall with torch tensors and
+solves it with the same trick implicitly (torch.Tensor.copy_ is itself
+multithreaded); numpy needs it spelled out.
+"""
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_CHUNK = 64 << 20  # 64 MB per task: large enough to amortize, small enough to balance
+_POOL: Optional[ThreadPoolExecutor] = None
+
+
+def _pool() -> ThreadPoolExecutor:
+    global _POOL
+    if _POOL is None:
+        workers = int(os.getenv("DLROVER_TPU_COPY_THREADS", "8"))
+        _POOL = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="fastcopy"
+        )
+    return _POOL
+
+
+def as_bytes_view(arr: np.ndarray) -> np.ndarray:
+    """Flat uint8 view of a contiguous array (no copy)."""
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    return arr.reshape(-1).view(np.uint8)
+
+
+_INLINE = 1 << 20  # copies below 1 MB aren't worth a pool dispatch
+
+
+def copy_many(pairs: Sequence[Tuple[np.ndarray, np.ndarray]]):
+    """Copy src -> dst for each (dst, src) pair of equal-size flat uint8
+    views. Small pairs run inline (pytrees have hundreds of scalar-sized
+    leaves); large ones are chunked across the shared pool."""
+    tasks: List[Tuple[np.ndarray, np.ndarray, int, int]] = []
+    for dst, src in pairs:
+        n = dst.nbytes
+        if src.nbytes != n:
+            raise ValueError(f"size mismatch {src.nbytes} != {n}")
+        if n <= _INLINE:
+            dst[:n] = src[:n]
+            continue
+        for off in range(0, n, _CHUNK):
+            tasks.append((dst, src, off, min(_CHUNK, n - off)))
+    if not tasks:
+        return
+    if len(tasks) == 1:
+        dst, src, off, ln = tasks[0]
+        dst[off:off + ln] = src[off:off + ln]
+        return
+
+    def run(t):
+        dst, src, off, ln = t
+        dst[off:off + ln] = src[off:off + ln]
+
+    list(_pool().map(run, tasks))

@@ -1,0 +1,3 @@
+"""Model zoo: sharding-annotated reference models for the framework."""
+
+from dlrover_tpu.models.gpt import GPT, GPTConfig  # noqa: F401

@@ -1,0 +1,220 @@
+"""GPT-2-class decoder, TPU-first.
+
+The flagship model for benchmarks and examples — the workload class the
+reference optimizes (GLM/GPT LLM pretraining with ATorch's TP/SP/FSDP
+modules, ``atorch/atorch/modules/distributed_modules/transformer.py``). This
+is NOT a port of those torch modules: every parallelism is expressed as
+flax *logical axis* metadata on params and activation constraints, which
+GSPMD turns into sharded matmuls + collectives for whatever mesh the
+caller provides (see ``dlrover_tpu/accel/sharding.py`` for the rules).
+
+TPU specifics:
+- bf16 activations / fp32 params by default (MXU-native);
+- layers stacked with ``nn.scan`` so compile time is O(1) in depth;
+- optional per-layer remat (``jax.checkpoint``) to trade FLOPs for HBM;
+- attention is a plain einsum softmax by default — the Pallas
+  flash/ring-attention kernel from ``dlrover_tpu.ops`` plugs in via
+  ``attn_impl``.
+
+Logical axis names used: batch, seq, embed, heads, kv, mlp, vocab.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 0  # 0 -> 4 * d_model
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    scan_layers: bool = True
+    attn_impl: str = "xla"  # "xla" | "pallas" | "ring"
+    dropout: float = 0.0
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    def flops_per_token(self) -> float:
+        """Approx training FLOPs/token (6*N params + attention)."""
+        n = self.param_count()
+        attn = 12 * self.num_layers * self.d_model * self.max_seq_len
+        return 6 * n + attn
+
+    def param_count(self) -> int:
+        d, f, v, l = self.d_model, self.ff_dim, self.vocab_size, self.num_layers
+        per_layer = 4 * d * d + 2 * d * f + 4 * d  # qkvo + mlp + ln
+        return v * d + self.max_seq_len * d + l * per_layer + d
+
+    @staticmethod
+    def tiny():
+        return GPTConfig(vocab_size=256, max_seq_len=64, num_layers=2,
+                         num_heads=2, d_model=32)
+
+    @staticmethod
+    def gpt2_xl():
+        """GPT-2 1.5B — BASELINE.md's checkpoint/perf model class."""
+        return GPTConfig(vocab_size=50257, max_seq_len=1024, num_layers=48,
+                         num_heads=25, d_model=1600, remat=True)
+
+
+def _dense(features, name, kernel_axes, cfg: GPTConfig):
+    return nn.Dense(
+        features,
+        use_bias=True,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), kernel_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (kernel_axes[-1],)
+        ),
+        name=name,
+    )
+
+
+def _layernorm(name, cfg: GPTConfig):
+    return nn.LayerNorm(
+        epsilon=1e-5,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        scale_init=nn.with_logical_partitioning(
+            nn.initializers.ones_init(), ("embed",)
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), ("embed",)
+        ),
+        name=name,
+    )
+
+
+def _attention(q, k, v, cfg: GPTConfig):
+    """Causal attention. q,k,v: [B, S, H, D]."""
+    if cfg.attn_impl == "pallas":
+        from dlrover_tpu.ops.attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    if cfg.attn_impl == "ring":
+        from dlrover_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, causal=True, axis_name="seq")
+    scale = 1.0 / np.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    probs = probs.astype(cfg.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block with TP-ready logical axes."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, _=None):
+        cfg = self.cfg
+        b, s, d = x.shape
+        h, hd = cfg.num_heads, cfg.head_dim
+
+        y = _layernorm("ln1", cfg)(x)
+        qkv = _dense(3 * d, "qkv", ("embed", "heads"), cfg)(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, h, hd)
+        k = k.reshape(b, s, h, hd)
+        v = v.reshape(b, s, h, hd)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
+        attn = _attention(q, k, v, cfg).reshape(b, s, d)
+        x = x + _dense(d, "proj", ("heads", "embed"), cfg)(attn)
+
+        y = _layernorm("ln2", cfg)(x)
+        y = _dense(cfg.ff_dim, "up", ("embed", "mlp"), cfg)(y)
+        y = nn.gelu(y)
+        y = nn.with_logical_constraint(y, ("batch", "seq", "mlp"))
+        x = x + _dense(d, "down", ("mlp", "embed"), cfg)(y)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        return x, None
+
+
+class GPT(nn.Module):
+    """Decoder-only LM. ``__call__(tokens[B,S]) -> logits[B,S,V]``."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        b, s = tokens.shape
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="wte",
+        )
+        pos_embed = self.param(
+            "wpe",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.01), ("seq", "embed")
+            ),
+            (cfg.max_seq_len, cfg.d_model),
+            cfg.param_dtype,
+        )
+        x = embed(tokens) + pos_embed[None, :s].astype(cfg.dtype)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(
+                Block, prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="blocks")(x)
+        else:
+            for i in range(cfg.num_layers):
+                x, _ = block(cfg, name=f"block_{i}")(x)
+
+        x = _layernorm("ln_f", cfg)(x)
+        # Tied output head: logits via the embedding table (GPT-2 style).
+        logits = embed.attend(x.astype(cfg.param_dtype))
+        return nn.with_logical_constraint(
+            logits, ("batch", "seq", "vocab")
+        )
+
+
+def loss_fn(logits, tokens, ignore_first: bool = True):
+    """Next-token cross entropy; logits[B,S,V], tokens[B,S]."""
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

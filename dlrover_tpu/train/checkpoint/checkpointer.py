@@ -36,9 +36,16 @@ class Checkpointer:
         self._engine = engine
 
     def save_checkpoint(self, step: int, state,
-                        storage_type: int = StorageType.DISK) -> bool:
+                        storage_type: int = StorageType.DISK,
+                        block: bool = False) -> bool:
+        """MEMORY saves are asynchronous by default: the D2H transfer is
+        dispatched and a background thread completes the shm write, so the
+        training loop blocks for milliseconds regardless of state size
+        (pass ``block=True`` for the synchronous reference semantics)."""
         if storage_type == StorageType.MEMORY:
-            return self._engine.save_to_memory(step, state)
+            if block:
+                return self._engine.save_to_memory(step, state, block=True)
+            return self._engine.save_to_memory_async(step, state)
         return self._engine.save_to_storage(step, state)
 
     def load_checkpoint(self, template) -> Tuple[int, Any]:

@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from dlrover_tpu.common import ckpt_persist
+from dlrover_tpu.common import ckpt_persist, fastcopy
 from dlrover_tpu.common.ckpt_meta import (
     SaveEvent,
     SaverRegistration,
@@ -115,6 +115,24 @@ class CheckpointEngine:
         )
         self._layout_version = 0
         self._cached_step = -1
+        # Async staging: one background writer, at most one snapshot in
+        # flight (a newer request while busy is skipped, not queued).
+        import concurrent.futures
+        import threading
+
+        self._stage_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-stage"
+        )
+        self._staging = None
+        # Write ordering: every snapshot request takes a generation number;
+        # the buffer write + meta publish happen under _write_mutex and a
+        # request superseded by a newer one is dropped. This keeps a stalled
+        # async staging from landing a stale step over a newer sync save
+        # (and from tearing the buffer under it).
+        self._write_mutex = threading.Lock()
+        self._gen_lock = threading.Lock()
+        self._next_gen = 0
+        self._done_gen = 0
 
         self.agent_mode = server_exists(
             "queue", ckpt_factory_queue(self._node_rank), self._job
@@ -208,50 +226,126 @@ class CheckpointEngine:
         )
 
     def save_to_memory(self, step: int, state, block: bool = False) -> bool:
-        """Stage `state` into the shm buffer. With ``block=False`` (the
-        MEMORY fast path) returns False when the saver is persisting this
-        buffer right now — a skipped snapshot is cheaper than a stalled step
-        (parity with the reference's skip-on-contention, ``engine.py:272``).
-        DISK saves pass ``block=True`` so a requested persist is never lost
-        to brief lock contention."""
-        if self._lock is not None and not self._lock.acquire(
-            blocking=block, timeout=30.0 if block else -1
-        ):
-            logger.warning(
-                "skip memory save at step %s: saver holds the shard lock",
-                step,
-            )
+        """Stage `state` into the shm buffer synchronously. With
+        ``block=False`` (the MEMORY fast path) returns False when the saver
+        is persisting this buffer right now — a skipped snapshot is cheaper
+        than a stalled step (parity with the reference's skip-on-contention,
+        ``engine.py:272``). DISK saves pass ``block=True`` so a requested
+        persist is never lost to brief lock contention."""
+        gen = self._take_gen()
+        arrays, objects = _flatten_state(state)
+        host_arrays = self._materialize(arrays)
+        return self._write_snapshot(step, host_arrays, objects, block, gen)
+
+    def save_to_memory_async(self, step: int, state) -> bool:
+        """Non-blocking memory snapshot: dispatch the D2H transfers and
+        return immediately; a background thread finishes the fetch and the
+        shm write. This is the TPU-first answer to the reference's
+        blocking-save design — JAX arrays are immutable, so the snapshot is
+        consistent no matter how far training runs ahead, and the blocking
+        cost is just the async-dispatch (~ms) instead of D2H + memcpy.
+
+        Returns False (snapshot skipped) while a previous staging is still
+        in flight — same semantics as a lock-contention skip.
+        """
+        if self._staging is not None and not self._staging.done():
             return False
-        try:
-            arrays, objects = _flatten_state(state)
-            host_arrays = self._materialize(arrays)
-            metas, used = self._layout(host_arrays)
-            self._ensure_shm(used)
-            buf = self._shm.buf
-            for meta, (_, arr) in zip(metas, host_arrays):
-                view = np.ndarray(
-                    arr.shape, dtype=arr.dtype, buffer=buf,
-                    offset=meta.offset,
-                )
-                np.copyto(view, arr)
-            self._shm.flush()
-            shard_meta = ShardMeta(
-                step=step,
-                shm_name=self._shm_name,
-                used_bytes=used,
-                tensors=metas,
-                objects=objects,
-                global_shard_id=self.global_shard_id,
-                global_shard_num=self.global_shard_num,
-                persist=self.persist_shard,
-                layout_version=self._layout_version,
+        gen = self._take_gen()
+        arrays, objects = _flatten_state(state)
+        for _, a in arrays:
+            fn = getattr(a, "copy_to_host_async", None)
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:
+                    pass
+        self._staging = self._stage_pool.submit(
+            self._stage_async, step, arrays, objects, gen
+        )
+        return True
+
+    def _stage_async(self, step, arrays, objects, gen):
+        host_arrays = self._materialize(arrays)
+        ok = self._write_snapshot(step, host_arrays, objects, True, gen)
+        if not ok:
+            # Make the drop observable: an async save that returned True at
+            # dispatch did NOT land (lock contention or superseded).
+            logger.warning(
+                "async memory snapshot of step %s was not staged", step
             )
-            self._publish_meta(shard_meta)
-            self._cached_step = step
+        return ok
+
+    def wait_staged(self, timeout: float = 600.0) -> bool:
+        """Join an in-flight async staging (no-op when none pending)."""
+        if self._staging is None:
             return True
-        finally:
-            if self._lock is not None:
-                self._lock.release()
+        try:
+            return bool(self._staging.result(timeout=timeout))
+        except Exception:
+            logger.exception("async checkpoint staging failed")
+            return False
+
+    def _take_gen(self) -> int:
+        with self._gen_lock:
+            self._next_gen += 1
+            return self._next_gen
+
+    def _superseded(self, gen: int) -> bool:
+        with self._gen_lock:
+            return gen <= self._done_gen
+
+    def _write_snapshot(self, step, host_arrays, objects,
+                        block: bool, gen: Optional[int] = None) -> bool:
+        if gen is None:
+            gen = self._take_gen()
+        # Serialize buffer writers; a request that lost the race to a newer
+        # one is dropped instead of landing stale data over it.
+        with self._write_mutex:
+            if self._superseded(gen):
+                logger.info(
+                    "memory snapshot of step %s superseded; dropped", step
+                )
+                return False
+            if self._lock is not None and not self._lock.acquire(
+                blocking=block, timeout=30.0 if block else -1
+            ):
+                logger.warning(
+                    "skip memory save at step %s: saver holds the shard "
+                    "lock", step,
+                )
+                return False
+            try:
+                metas, used = self._layout(host_arrays)
+                self._ensure_shm(used)
+                buf = self._shm.buf
+                pairs = []
+                for meta, (_, arr) in zip(metas, host_arrays):
+                    dst = np.ndarray(
+                        (meta.nbytes,), dtype=np.uint8, buffer=buf,
+                        offset=meta.offset,
+                    )
+                    pairs.append((dst, fastcopy.as_bytes_view(arr)))
+                fastcopy.copy_many(pairs)
+                self._shm.flush()
+                shard_meta = ShardMeta(
+                    step=step,
+                    shm_name=self._shm_name,
+                    used_bytes=used,
+                    tensors=metas,
+                    objects=objects,
+                    global_shard_id=self.global_shard_id,
+                    global_shard_num=self.global_shard_num,
+                    persist=self.persist_shard,
+                    layout_version=self._layout_version,
+                )
+                self._publish_meta(shard_meta)
+                self._cached_step = step
+                with self._gen_lock:
+                    self._done_gen = max(self._done_gen, gen)
+                return True
+            finally:
+                if self._lock is not None:
+                    self._lock.release()
 
     def _publish_meta(self, shard_meta: ShardMeta):
         raw = pickle.dumps(shard_meta)
@@ -334,6 +428,7 @@ class CheckpointEngine:
         initialized train state); its leaves define paths, dtypes and shapes.
         Returns ``(-1, template)`` when nothing is restorable.
         """
+        self.wait_staged(60.0)
         meta = self._memory_meta()
         has_memory = meta is not None and SharedMemory.exists(self._shm_name)
         my_step = meta.step if has_memory else -1
@@ -345,7 +440,10 @@ class CheckpointEngine:
                 try:
                     shm = self._shm or SharedMemory(self._shm_name)
                     self._shm = shm
-                    state = self._rebuild(template, meta, shm.buf)
+                    # The write mutex keeps a straggling staging thread from
+                    # rewriting the buffer mid-read.
+                    with self._write_mutex:
+                        state = self._rebuild(template, meta, shm.buf)
                     self._cached_step = meta.step
                     logger.info(
                         "restored step %s from memory snapshot", meta.step
@@ -380,10 +478,17 @@ class CheckpointEngine:
         by_path = {t.path: t for t in meta.tensors}
         leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
         out = []
+        pairs = []  # batched parallel reads for all array leaves
         for kp, leaf in leaves:
             path = jax.tree_util.keystr(kp)
             if path in by_path:
-                out.append(by_path[path].read(buf))
+                t = by_path[path]
+                arr = np.empty(t.shape, dtype=t.dtype)
+                src = np.ndarray(
+                    (t.nbytes,), dtype=np.uint8, buffer=buf, offset=t.offset
+                )
+                pairs.append((fastcopy.as_bytes_view(arr), src))
+                out.append(arr)
             elif path in meta.objects:
                 out.append(meta.objects[path])
             else:
@@ -391,6 +496,7 @@ class CheckpointEngine:
                     f"checkpoint is missing leaf {path}; topology or model "
                     "definition changed since the snapshot"
                 )
+        fastcopy.copy_many(pairs)
         return jax.tree_util.tree_unflatten(treedef, out)
 
     # ------------- misc -------------
@@ -415,5 +521,15 @@ class CheckpointEngine:
         return False
 
     def close(self):
+        done = self.wait_staged(30.0)
+        self._stage_pool.shutdown(wait=False)
+        if self._staging is not None and not self._staging.done():
+            # A wedged staging thread still owns the buffer — leave the shm
+            # mapping open rather than yank it out from under the write.
+            logger.warning(
+                "checkpoint staging still in flight at close; leaving shm "
+                "mapped (done=%s)", done,
+            )
+            return
         if self._shm is not None:
             self._shm.close()

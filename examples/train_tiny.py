@@ -94,7 +94,11 @@ def main():
             if args.persist_every and (step + 1) % args.persist_every == 0:
                 ckpt.save_checkpoint(step + 1, state, StorageType.DISK)
             else:
-                ckpt.save_checkpoint(step + 1, state, StorageType.MEMORY)
+                # block=True: deterministic for the e2e crash test (async
+                # staging may legitimately skip steps while busy).
+                ckpt.save_checkpoint(
+                    step + 1, state, StorageType.MEMORY, block=True
+                )
         if client is not None and rank == 0:
             client.report_global_step(step + 1, time.time())
 

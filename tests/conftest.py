@@ -16,6 +16,15 @@ if "--xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The axon sitecustomize imports jax at interpreter startup (before this
+# conftest), so the env vars above can be too late for the in-process
+# backend. jax.config.update still works as long as no backend has been
+# created yet — force CPU + 8 virtual devices explicitly.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
 import uuid
 
 import pytest

@@ -199,6 +199,34 @@ class TestAgentModeEngine:
         finally:
             engine.close()
 
+    def test_async_memory_save(self, saver_env):
+        """Async staging: save returns immediately, snapshot lands after
+        wait_staged, restore sees it."""
+        self._start_agent_side()
+        state = make_state(4)
+        engine = CheckpointEngine(saver_env)
+        try:
+            assert engine.save_to_memory_async(3, state)
+            assert engine.wait_staged(timeout=30.0)
+            self._wait_saver()
+            step, restored = CheckpointEngine(saver_env).load(make_state(0))
+            assert step == 3
+            assert_state_equal(restored, state)
+        finally:
+            engine.close()
+
+    def test_async_ordering_with_sync_save(self, saver_env):
+        """A sync save issued after an async one must not be overwritten by
+        the older staging completing later."""
+        self._start_agent_side()
+        engine = CheckpointEngine(saver_env)
+        try:
+            engine.save_to_memory_async(1, make_state(1))
+            assert engine.save_to_memory(2, make_state(2), block=True)
+            assert engine._memory_meta().step == 2
+        finally:
+            engine.close()
+
     def test_saver_skips_step_moved_under_lock(self, saver_env):
         """A shard that advanced past the event's step is not persisted into
         the wrong step dir."""
@@ -231,11 +259,13 @@ class TestFlashCheckpointerAPI:
                 )
                 ok = ckpt.save_checkpoint(s, state, st)
                 # DISK saves block for the lock and must never be dropped;
-                # MEMORY saves may legitimately skip under saver contention.
+                # MEMORY saves may legitimately skip under saver contention
+                # or while a previous async staging is in flight.
                 if st == StorageType.DISK:
                     assert ok
                 if ok:
                     last_memory = s
+            assert ckpt.engine.wait_staged()
             assert ckpt.wait_persisted(4, timeout=30.0)
             # The newest staged snapshot wins on restore.
             step, restored = FlashCheckpointer(saver_env).load_checkpoint(
