@@ -259,8 +259,7 @@ class CommonDirCheckpointSaver:
             )
             if ok:
                 ckpt_persist.gc_steps(
-                    self.storage, self.checkpoint_dir, self.keep_latest,
-                    self.global_shard_num,
+                    self.storage, self.checkpoint_dir, self.keep_latest
                 )
 
     # ------------- crash / SIGTERM flush -------------

@@ -44,15 +44,28 @@ def ckpt_shm_name(job: str, node_rank: int, local_rank: int) -> str:
 
 @dataclass
 class TensorMeta:
-    """One array staged in the shm buffer. Reading back happens through the
-    engine's batched parallel-copy rebuild (``engine._rebuild``), which is
-    the single owner of the buffer layout."""
+    """One array *block* staged in the shm buffer.
+
+    An unsharded leaf stages one block with ``index=None``.  A GSPMD-sharded
+    leaf stages one block per unique addressable shard index: ``shape`` is
+    the local block shape, ``global_shape`` the full array, ``index`` the
+    (start, stop) bounds of this block per dimension.  ``persist`` marks the
+    blocks this process owns for disk (the globally replica-0 copy), so a
+    sharded state persists each byte exactly once across all processes
+    (parity: one-DCP-shard-per-rank, reference
+    ``dlrover/trainer/torch/flash_checkpoint/fsdp_engine.py:158-224``).
+    Reading back happens through the engine's rebuild, the single owner of
+    the buffer layout.
+    """
 
     path: str  # jax.tree_util.keystr of the leaf's key path
     offset: int
     nbytes: int
     dtype: str
     shape: Tuple[int, ...]
+    global_shape: Optional[Tuple[int, ...]] = None  # None => unsharded
+    index: Optional[Tuple[Tuple[int, int], ...]] = None  # block bounds
+    persist: bool = True
 
 
 @dataclass

@@ -14,12 +14,13 @@ after every ``done_*`` file exists, so readers can never observe a torn
 checkpoint.
 """
 
+import dataclasses
 import os
 import pickle
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
-from dlrover_tpu.common.ckpt_meta import ShardMeta
+from dlrover_tpu.common.ckpt_meta import ShardMeta, TensorMeta
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.storage import CheckpointStorage
@@ -35,13 +36,31 @@ def _tracker_path(ckpt_dir: str) -> str:
 
 def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
                   meta: ShardMeta, buf: memoryview) -> None:
-    """Write one shard's buffer + meta and its done file."""
+    """Write one shard's persist-owned blocks + meta and its done file.
+
+    The shm buffer may hold blocks this process stages only for fast local
+    memory restore (replica copies another process persists); the disk file
+    carries exclusively the ``persist=True`` blocks, with offsets remapped
+    to the file layout, so a sharded checkpoint stores each byte once.
+    """
     d = step_dir(ckpt_dir, meta.step)
     storage.safe_makedirs(d)
     gid = meta.global_shard_id
     prefix = os.path.join(d, f"{CheckpointConstant.SHARD_FILE_PREFIX}{gid}")
-    storage.write_bytes(bytes(buf[: meta.used_bytes]), prefix + ".bin")
-    storage.write_bytes(pickle.dumps(meta), prefix + ".meta")
+    disk_tensors: List[TensorMeta] = []
+    chunks: List[memoryview] = []
+    offset = 0
+    for t in meta.tensors:
+        if not t.persist:
+            continue
+        disk_tensors.append(dataclasses.replace(t, offset=offset))
+        chunks.append(buf[t.offset:t.offset + t.nbytes])
+        offset += t.nbytes
+    disk_meta = dataclasses.replace(
+        meta, tensors=disk_tensors, used_bytes=offset, shm_name=""
+    )
+    storage.write_chunks(chunks, prefix + ".bin")
+    storage.write_bytes(pickle.dumps(disk_meta), prefix + ".meta")
     storage.write(
         "", os.path.join(d, f"{CheckpointConstant.DONE_FILE_PREFIX}{gid}")
     )
@@ -100,6 +119,46 @@ def load_shard(storage: CheckpointStorage, ckpt_dir: str, step: int,
     return pickle.loads(raw_meta), raw_bin
 
 
+def load_step_metas(storage: CheckpointStorage, ckpt_dir: str,
+                    step: int) -> Dict[int, ShardMeta]:
+    """All shard metas of a step, keyed by global shard id.
+
+    Restore after a world-size change cannot know how many shards the save
+    wrote, so the step directory is enumerated instead of trusting the
+    current world size (the reshard-on-restore entry point)."""
+    d = step_dir(ckpt_dir, step)
+    metas: Dict[int, ShardMeta] = {}
+    for name in storage.listdir(d):
+        if not (name.startswith(CheckpointConstant.SHARD_FILE_PREFIX)
+                and name.endswith(".meta")):
+            continue
+        try:
+            gid = int(name[len(CheckpointConstant.SHARD_FILE_PREFIX):-5])
+        except ValueError:
+            continue
+        raw = storage.read_bytes(os.path.join(d, name))
+        if raw is None:
+            continue
+        try:
+            metas[gid] = pickle.loads(raw)
+        except Exception:
+            logger.warning("undecodable shard meta %s", name)
+    return metas
+
+
+def read_block(storage: CheckpointStorage, ckpt_dir: str, step: int,
+               gid: int, t: TensorMeta) -> Optional[bytes]:
+    """Read one block's bytes out of a shard's bin file."""
+    d = step_dir(ckpt_dir, step)
+    path = os.path.join(
+        d, f"{CheckpointConstant.SHARD_FILE_PREFIX}{gid}.bin"
+    )
+    data = storage.read_range(path, t.offset, t.nbytes)
+    if data is None or len(data) != t.nbytes:
+        return None
+    return data
+
+
 def list_steps(storage: CheckpointStorage, ckpt_dir: str) -> List[int]:
     """Sorted step numbers that have a step directory (committed or not)."""
     steps = []
@@ -114,13 +173,32 @@ def list_steps(storage: CheckpointStorage, ckpt_dir: str) -> List[int]:
     return sorted(steps)
 
 
-def gc_steps(storage: CheckpointStorage, ckpt_dir: str, keep_latest: int,
-             global_shard_num: int = 0):
+def _step_shard_num(storage: CheckpointStorage, ckpt_dir: str,
+                    step: int) -> int:
+    """How many shards the step's own save wrote (from its metas) — NOT the
+    current world size: reshard-on-restore means old steps may have been
+    saved under a different world, and they are still complete."""
+    d = step_dir(ckpt_dir, step)
+    for name in storage.listdir(d):
+        if (name.startswith(CheckpointConstant.SHARD_FILE_PREFIX)
+                and name.endswith(".meta")):
+            raw = storage.read_bytes(os.path.join(d, name))
+            if raw is None:
+                continue
+            try:
+                return int(pickle.loads(raw).global_shard_num)
+            except Exception:
+                continue
+    return 0
+
+
+def gc_steps(storage: CheckpointStorage, ckpt_dir: str, keep_latest: int):
     """Drop old step dirs: keep the newest `keep_latest` *fully committed*
-    dirs (all done files present, when global_shard_num is known); delete
-    every other dir at or below the tracker step — including torn partial
-    saves from crash flushes, which otherwise leak multi-GB dirs forever.
-    Dirs newer than the tracker are in-flight and never touched."""
+    dirs (all done files present, judged against each step's OWN saved
+    shard count); delete every other dir at or below the tracker step —
+    including torn partial saves from crash flushes, which otherwise leak
+    multi-GB dirs forever. Dirs newer than the tracker are in-flight and
+    never touched."""
     tracker = read_tracker(storage, ckpt_dir)
     if tracker is None or keep_latest <= 0:
         return
@@ -129,9 +207,10 @@ def gc_steps(storage: CheckpointStorage, ckpt_dir: str, keep_latest: int,
     def complete(s: int) -> bool:
         if s == tracker:
             return True  # the published step is always kept
-        if global_shard_num <= 0:
-            return True
-        return count_done(storage, ckpt_dir, s) >= global_shard_num
+        expected = _step_shard_num(storage, ckpt_dir, s)
+        if expected <= 0:
+            return False  # no readable meta: torn beyond use
+        return count_done(storage, ckpt_dir, s) >= expected
 
     keep = set(
         [s for s in candidates if complete(s)][-keep_latest:] + [tracker]

@@ -30,11 +30,29 @@ def _pool() -> ThreadPoolExecutor:
     return _POOL
 
 
-def as_bytes_view(arr: np.ndarray) -> np.ndarray:
-    """Flat uint8 view of a contiguous array (no copy)."""
+def as_bytes_view(arr: np.ndarray, writeback: bool = False) -> np.ndarray:
+    """Flat uint8 view of a contiguous array (no copy).
+
+    ``writeback=True`` marks a copy *destination*: a non-contiguous array
+    would silently receive the writes in a temporary and lose them, so it
+    raises instead. Sources fall back to a contiguous copy."""
     if not arr.flags.c_contiguous:
+        if writeback:
+            raise ValueError(
+                "copy destination must be C-contiguous; writes to a "
+                "temporary copy would be lost"
+            )
         arr = np.ascontiguousarray(arr)
     return arr.reshape(-1).view(np.uint8)
+
+
+def parallel_map(fn, items):
+    """Run fn over items on the shared pool (restore reads are I/O-bound;
+    serializing them leaves disk bandwidth on the table)."""
+    items = list(items)
+    if len(items) <= 1:
+        return [fn(i) for i in items]
+    return list(_pool().map(fn, items))
 
 
 _INLINE = 1 << 20  # copies below 1 MB aren't worth a pool dispatch

@@ -29,6 +29,22 @@ class CheckpointStorage(ABC):
     def read_bytes(self, path: str) -> bytes:
         ...
 
+    def read_range(self, path: str, offset: int, nbytes: int):
+        """Read `nbytes` starting at `offset`.
+
+        The default falls back to a whole-file read — O(filesize) PER
+        BLOCK during sharded restore. Real backends (object stores, ...)
+        should override with a native range read.
+        """
+        data = self.read_bytes(path)
+        if data is None:
+            return None
+        return data[offset:offset + nbytes]
+
+    def write_chunks(self, chunks, path: str):
+        """Write an iterable of bytes-like chunks as one file (atomic)."""
+        self.write_bytes(b"".join(bytes(c) for c in chunks), path)
+
     @abstractmethod
     def safe_rename(self, src: str, dst: str):
         ...
@@ -74,6 +90,22 @@ class PosixDiskStorage(CheckpointStorage):
 
     def read_bytes(self, path: str) -> Optional[bytes]:
         return self.read(path, "rb")
+
+    def read_range(self, path: str, offset: int, nbytes: int):
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return f.read(nbytes)
+
+    def write_chunks(self, chunks, path: str):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            for c in chunks:
+                f.write(c)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def safe_rename(self, src: str, dst: str):
         os.replace(src, dst)

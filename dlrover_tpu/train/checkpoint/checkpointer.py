@@ -89,10 +89,16 @@ class FlashCheckpointer(Checkpointer):
 
 
 class ShardedCheckpointer(Checkpointer):
-    """One shard per process — for GSPMD/pjit-sharded train states where each
-    process stages its addressable portion (parity: the FSDP/Megatron savers,
-    reference ``ckpt_saver.py:989-1029``). Requires the same world size on
-    restore; resharding restore lands with the accel layer."""
+    """One shard per process — for GSPMD/pjit-sharded train states.
+
+    Each process stages only its *addressable* blocks (deduplicated by shard
+    index) and persists the globally replica-0 copy of each, so a sharded
+    state is stored exactly once across processes (parity: the FSDP/Megatron
+    savers, reference ``ckpt_saver.py:989-1029`` and the DCP shm writer,
+    ``fsdp_engine.py:158-224``). Restore re-assembles blocks for the
+    template's shardings, so the world size / mesh may change between save
+    and load (reshard-on-restore; capability match
+    ``atorch/atorch/utils/fsdp_save_util.py``)."""
 
     def __init__(self, checkpoint_dir: str,
                  storage: Optional[CheckpointStorage] = None,

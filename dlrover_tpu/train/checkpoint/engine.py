@@ -3,12 +3,20 @@
 Parity: reference ``dlrover/trainer/torch/flash_checkpoint/engine.py:47-304``
 (shm staging, readiness/step-consistency, memory/disk paths) merged with the
 shm-handler half of ``dlrover/python/elastic_agent/torch/ckpt_saver.py:171-291``
-(TensorMeta layout + buffer traversal), rebuilt for JAX:
+(TensorMeta layout + buffer traversal) and the one-shard-per-rank design of
+``fsdp_engine.py:158-224``, rebuilt for JAX:
 
 - the state dict is any JAX pytree; array leaves are staged into a POSIX shm
   buffer, scalar/python leaves ride in the meta record;
-- D2H is one batched ``jax.device_get`` (async dispatch means the transfer
-  overlaps whatever is still running on device);
+- GSPMD-sharded leaves stage only this process's *addressable* blocks
+  (deduplicated by shard index); the globally replica-0 copy of each block
+  is marked for disk persist, so a sharded state stores each byte exactly
+  once across processes and restore can re-assemble it for any new mesh;
+- **asynchronous saves are donation-safe**: ``save_to_memory_async``
+  dispatches engine-owned device→host copies (XLA host memory space when
+  available, on-device copy otherwise) and returns in milliseconds; the
+  runtime orders those copies before any later donated step reuses the
+  buffers, so the background fetch never races training;
 - in **agent mode** (launched under `dlrover-tpu-run`) the engine registers a
   saver with the agent over the factory queue and persists via save events —
   `save_to_memory` returns in milliseconds and the agent owns disk I/O and
@@ -17,10 +25,11 @@ shm-handler half of ``dlrover/python/elastic_agent/torch/ckpt_saver.py:171-291``
   commit, so the file format is identical either way.
 """
 
+import dataclasses
 import os
 import pickle
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -74,6 +83,39 @@ def _flatten_state(state) -> Tuple[List[Tuple[str, Any]], Dict[str, Any]]:
     return arrays, objects
 
 
+def _index_key(index, shape) -> Tuple[Tuple[int, int], ...]:
+    """Normalize a shard's slice-tuple index to ((start, stop), ...)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _memo_reader(read: Callable[[], np.ndarray]) -> Callable[[], np.ndarray]:
+    """Cache a block reader's result for the duration of one leaf rebuild."""
+    cache: List[np.ndarray] = []
+
+    def cached() -> np.ndarray:
+        if not cache:
+            cache.append(read())
+        return cache[0]
+
+    return cached
+
+
+@dataclasses.dataclass
+class _Block:
+    """One staged block in flight: metadata + an engine-owned data handle."""
+
+    path: str
+    index: Optional[Tuple[Tuple[int, int], ...]]  # None => whole array
+    global_shape: Optional[Tuple[int, ...]]
+    persist: bool
+    handle: Any  # jax.Array (engine-owned copy) or np.ndarray
+
+
 class CheckpointEngine:
     """Stage one process's checkpoint shard into shared memory.
 
@@ -115,6 +157,8 @@ class CheckpointEngine:
         )
         self._layout_version = 0
         self._cached_step = -1
+        # None = undecided; probed on the first snapshot.
+        self._host_memory_kind_ok: Optional[bool] = None
         # Async staging: one background writer, at most one snapshot in
         # flight (a newer request while busy is skipped, not queued).
         import concurrent.futures
@@ -179,23 +223,143 @@ class CheckpointEngine:
         )
 
     # ------------- staging -------------
-    def _materialize(self, arrays: List[Tuple[str, Any]]):
-        """Batched D2H: fetch all device arrays to host numpy at once."""
+    def _snapshot(self, state, own: bool) -> Tuple[List[_Block], Dict]:
+        """Decompose `state` into staged blocks (dispatch-only, no host sync).
+
+        A GSPMD leaf contributes one block per unique addressable shard
+        index; ``persist`` marks blocks whose replica-0 copy lives on this
+        process. With ``own=True`` every device block is snapshotted into an
+        engine-owned array (host memory space when the backend supports it,
+        else an on-device copy): the XLA runtime orders those copies before
+        any later donated execution overwrites the source buffers, which is
+        what makes the async path safe against ``donate_argnums`` training
+        steps. ``own=False`` skips the copy for synchronous saves that fetch
+        before returning.
+        """
         import jax
 
-        host = jax.device_get([a for _, a in arrays])
-        return [
-            (path, np.asarray(h)) for (path, _), h in zip(arrays, host)
-        ]
+        arrays, objects = _flatten_state(state)
+        blocks: List[_Block] = []
+        device_data: List[Any] = []
+        device_slots: List[int] = []
+        for path, leaf in arrays:
+            if not isinstance(leaf, jax.Array):
+                host = np.asarray(leaf)
+                if own:
+                    # The caller may mutate host arrays after an async
+                    # dispatch returns; snapshot them now.
+                    host = host.copy()
+                blocks.append(_Block(path, None, None, True, host))
+                continue
+            uniq: Dict[Tuple, List] = {}
+            for sh in leaf.addressable_shards:
+                key = _index_key(sh.index, leaf.shape)
+                ent = uniq.get(key)
+                if ent is None:
+                    uniq[key] = ent = [False, sh.data]
+                if sh.replica_id == 0:
+                    ent[0] = True
+            full = tuple((0, int(d)) for d in leaf.shape)
+            whole = len(uniq) == 1 and next(iter(uniq)) == full
+            if self.global_shard_num == 1 and self.persist_shard:
+                # Replicated layout (FlashCheckpointer): this process IS
+                # the one disk shard — persist all its blocks even when the
+                # mesh's device order gives its replicas nonzero ids
+                # (replica-0 dedup only applies to multi-shard layouts).
+                for ent in uniq.values():
+                    ent[0] = True
+            for key, (persist, data) in uniq.items():
+                blocks.append(
+                    _Block(
+                        path,
+                        None if whole else key,
+                        None if whole else tuple(int(d) for d in leaf.shape),
+                        persist,
+                        data,
+                    )
+                )
+                device_data.append(data)
+                device_slots.append(len(blocks) - 1)
+        if own and device_data:
+            owned = self._own_copies(device_data)
+            for slot, arr in zip(device_slots, owned):
+                blocks[slot].handle = arr
+        return blocks, objects
 
-    def _layout(self, host_arrays) -> Tuple[List[TensorMeta], int]:
+    def _own_copies(self, arrs: List[Any]) -> List[Any]:
+        """Dispatch engine-owned copies of single-device arrays (async).
+
+        Preferred: one batched ``device_put`` into the host memory space
+        (``pinned_host``) — zero extra HBM, the D2H DMA overlaps whatever
+        runs next. Fallback: ``jnp.copy`` on device. Either way the result's
+        lifetime is independent of the caller's arrays, so later donation
+        cannot invalidate the snapshot.
+        """
+        import jax
+
+        if self._host_memory_kind_ok is not False:
+            try:
+                shardings = [
+                    jax.sharding.SingleDeviceSharding(
+                        list(a.devices())[0], memory_kind="pinned_host"
+                    )
+                    for a in arrs
+                ]
+                out = jax.device_put(arrs, shardings)
+                self._host_memory_kind_ok = True
+                return out
+            except (ValueError, NotImplementedError) as e:
+                # Memory kinds genuinely unsupported on this backend:
+                # remember and stop trying.
+                logger.info(
+                    "host memory space unavailable (%s); snapshotting via "
+                    "on-device copies", e,
+                )
+                self._host_memory_kind_ok = False
+            except Exception:
+                # Transient failure (e.g. allocation pressure): fall back
+                # for THIS snapshot only and say why — do not silently
+                # degrade every future save.
+                logger.exception(
+                    "pinned-host snapshot failed; falling back to "
+                    "on-device copies for this save"
+                )
+        import jax.numpy as jnp
+
+        return [jnp.copy(a) for a in arrs]
+
+    def _fetch(self, blocks: List[_Block]) -> List[np.ndarray]:
+        """Complete the device→host fetch for every block (one batched
+        transfer), release the engine-owned handles, and return host arrays
+        aligned with `blocks`."""
+        import jax
+
+        device_idx = [
+            i for i, b in enumerate(blocks) if isinstance(b.handle, jax.Array)
+        ]
+        fetched = jax.device_get([blocks[i].handle for i in device_idx])
+        out: List[np.ndarray] = []
+        by_slot = dict(zip(device_idx, fetched))
+        for i, b in enumerate(blocks):
+            arr = by_slot.get(i)
+            if arr is None:
+                arr = np.asarray(b.handle)
+            out.append(np.asarray(arr))
+            b.handle = None  # free the device/host-space copy eagerly
+        return out
+
+    def _layout(
+        self, blocks: List[_Block], host_arrays: List[np.ndarray]
+    ) -> Tuple[List[TensorMeta], int]:
         metas, offset = [], 0
-        for path, arr in host_arrays:
+        for b, arr in zip(blocks, host_arrays):
             nbytes = arr.nbytes
             metas.append(
                 TensorMeta(
-                    path=path, offset=offset, nbytes=nbytes,
+                    path=b.path, offset=offset, nbytes=nbytes,
                     dtype=str(arr.dtype), shape=tuple(arr.shape),
+                    global_shape=b.global_shape, index=b.index,
+                    persist=b.persist,
                 )
             )
             offset += _aligned(nbytes)
@@ -233,17 +397,21 @@ class CheckpointEngine:
         ``engine.py:272``). DISK saves pass ``block=True`` so a requested
         persist is never lost to brief lock contention."""
         gen = self._take_gen()
-        arrays, objects = _flatten_state(state)
-        host_arrays = self._materialize(arrays)
-        return self._write_snapshot(step, host_arrays, objects, block, gen)
+        blocks, objects = self._snapshot(state, own=False)
+        host_arrays = self._fetch(blocks)
+        return self._write_snapshot(
+            step, blocks, host_arrays, objects, block, gen
+        )
 
     def save_to_memory_async(self, step: int, state) -> bool:
-        """Non-blocking memory snapshot: dispatch the D2H transfers and
-        return immediately; a background thread finishes the fetch and the
-        shm write. This is the TPU-first answer to the reference's
-        blocking-save design — JAX arrays are immutable, so the snapshot is
-        consistent no matter how far training runs ahead, and the blocking
-        cost is just the async-dispatch (~ms) instead of D2H + memcpy.
+        """Non-blocking memory snapshot: dispatch engine-owned D2H copies
+        and return immediately; a background thread finishes the fetch and
+        the shm write. This is the TPU-first answer to the reference's
+        blocking-save design — the dispatched copies are ordered by the
+        runtime before any later donated step reuses the buffers, so the
+        snapshot is consistent even when training runs ahead through a
+        ``donate_argnums`` train step, and the blocking cost is just the
+        dispatch (~ms) instead of D2H + memcpy.
 
         Returns False (snapshot skipped) while a previous staging is still
         in flight — same semantics as a lock-contention skip.
@@ -251,22 +419,25 @@ class CheckpointEngine:
         if self._staging is not None and not self._staging.done():
             return False
         gen = self._take_gen()
-        arrays, objects = _flatten_state(state)
-        for _, a in arrays:
-            fn = getattr(a, "copy_to_host_async", None)
-            if fn is not None:
-                try:
-                    fn()
-                except Exception:
-                    pass
+        blocks, objects = self._snapshot(state, own=True)
         self._staging = self._stage_pool.submit(
-            self._stage_async, step, arrays, objects, gen
+            self._stage_async, step, blocks, objects, gen
         )
         return True
 
-    def _stage_async(self, step, arrays, objects, gen):
-        host_arrays = self._materialize(arrays)
-        ok = self._write_snapshot(step, host_arrays, objects, True, gen)
+    def _stage_async(self, step, blocks, objects, gen):
+        try:
+            host_arrays = self._fetch(blocks)
+            ok = self._write_snapshot(
+                step, blocks, host_arrays, objects, True, gen
+            )
+        except Exception:
+            # The future is often never awaited — a silent raise here would
+            # turn every crash-restore guarantee into a lie. Log loudly.
+            logger.exception(
+                "async memory snapshot of step %s FAILED to stage", step
+            )
+            return False
         if not ok:
             # Make the drop observable: an async save that returned True at
             # dispatch did NOT land (lock contention or superseded).
@@ -294,7 +465,7 @@ class CheckpointEngine:
         with self._gen_lock:
             return gen <= self._done_gen
 
-    def _write_snapshot(self, step, host_arrays, objects,
+    def _write_snapshot(self, step, blocks, host_arrays, objects,
                         block: bool, gen: Optional[int] = None) -> bool:
         if gen is None:
             gen = self._take_gen()
@@ -315,11 +486,11 @@ class CheckpointEngine:
                 )
                 return False
             try:
-                metas, used = self._layout(host_arrays)
+                metas, used = self._layout(blocks, host_arrays)
                 self._ensure_shm(used)
                 buf = self._shm.buf
                 pairs = []
-                for meta, (_, arr) in zip(metas, host_arrays):
+                for meta, arr in zip(metas, host_arrays):
                     dst = np.ndarray(
                         (meta.nbytes,), dtype=np.uint8, buffer=buf,
                         offset=meta.offset,
@@ -381,8 +552,7 @@ class CheckpointEngine:
             )
             if ok:
                 ckpt_persist.gc_steps(
-                    self.storage, self.checkpoint_dir, self.keep_latest,
-                    self.global_shard_num,
+                    self.storage, self.checkpoint_dir, self.keep_latest
                 )
             return ok
         return True
@@ -425,7 +595,10 @@ class CheckpointEngine:
         """Restore (step, state). Memory snapshot first, storage fallback.
 
         `template` is a pytree of the same structure (e.g. the freshly
-        initialized train state); its leaves define paths, dtypes and shapes.
+        initialized train state); its leaves define paths, dtypes, shapes
+        and — for GSPMD leaves — the target shardings: restore re-assembles
+        blocks for the template's mesh, so a checkpoint saved under one
+        topology loads under another (reshard-on-restore).
         Returns ``(-1, template)`` when nothing is restorable.
         """
         self.wait_staged(60.0)
@@ -440,10 +613,16 @@ class CheckpointEngine:
                 try:
                     shm = self._shm or SharedMemory(self._shm_name)
                     self._shm = shm
+                    buf = shm.buf
+                    catalog: Dict[str, List] = {}
+                    for t in meta.tensors:
+                        catalog.setdefault(t.path, []).append(
+                            (t, self._shm_reader(buf, t))
+                        )
                     # The write mutex keeps a straggling staging thread from
                     # rewriting the buffer mid-read.
                     with self._write_mutex:
-                        state = self._rebuild(template, meta, shm.buf)
+                        state = self._rebuild(template, catalog, meta.objects)
                     self._cached_step = meta.step
                     logger.info(
                         "restored step %s from memory snapshot", meta.step
@@ -453,51 +632,206 @@ class CheckpointEngine:
                     logger.exception("memory restore failed; trying storage")
         return self._load_from_storage(template)
 
+    @staticmethod
+    def _shm_reader(buf, t: TensorMeta) -> Callable[[], np.ndarray]:
+        def read() -> np.ndarray:
+            flat = np.ndarray(
+                (t.nbytes,), dtype=np.uint8, buffer=buf, offset=t.offset
+            )
+            return flat.view(t.dtype).reshape(t.shape)
+
+        return read
+
     def _load_from_storage(self, template) -> Tuple[int, Any]:
         step = ckpt_persist.read_tracker(self.storage, self.checkpoint_dir)
         if step is None:
             return -1, template
-        shard = ckpt_persist.load_shard(
-            self.storage, self.checkpoint_dir, step, self.global_shard_id
+        metas = ckpt_persist.load_step_metas(
+            self.storage, self.checkpoint_dir, step
         )
-        if shard is None:
+        if not metas:
             logger.error(
-                "tracker names step %s but shard %s is missing",
-                step, self.global_shard_id,
+                "tracker names step %s but no shard metas found", step
             )
             return -1, template
-        meta, raw = shard
-        state = self._rebuild(template, meta, memoryview(raw))
+        catalog: Dict[str, List] = {}
+        objects: Dict[str, Any] = {}
+        for gid in sorted(metas):
+            meta = metas[gid]
+            for k, v in meta.objects.items():
+                objects.setdefault(k, v)
+            for t in meta.tensors:
+                catalog.setdefault(t.path, []).append(
+                    (t, self._storage_reader(step, gid, t))
+                )
+        state = self._rebuild(template, catalog, objects)
         self._cached_step = step
-        logger.info("restored step %s from storage", step)
+        logger.info(
+            "restored step %s from storage (%s shard files)",
+            step, len(metas),
+        )
         return step, state
 
-    def _rebuild(self, template, meta: ShardMeta, buf: memoryview):
+    def _storage_reader(
+        self, step: int, gid: int, t: TensorMeta
+    ) -> Callable[[], np.ndarray]:
+        def read() -> np.ndarray:
+            raw = ckpt_persist.read_block(
+                self.storage, self.checkpoint_dir, step, gid, t
+            )
+            if raw is None:
+                raise KeyError(
+                    f"block {t.path}{t.index} missing from shard {gid}"
+                )
+            return np.frombuffer(raw, dtype=t.dtype).reshape(t.shape)
+
+        return read
+
+    # ------------- rebuild -------------
+    def _rebuild(self, template, catalog: Dict[str, List], objects: Dict):
+        """Reconstruct the template pytree from available blocks.
+
+        Unsharded template leaves get host numpy arrays (the caller's first
+        jitted step commits them); GSPMD template leaves are assembled
+        per-device from whatever block partitioning the checkpoint holds and
+        wrapped via ``jax.make_array_from_single_device_arrays`` — the
+        reshard-on-restore path for world-size/mesh changes.
+        """
         import jax
 
-        by_path = {t.path: t for t in meta.tensors}
         leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
         out = []
-        pairs = []  # batched parallel reads for all array leaves
+        exact_pairs = []  # (dst, reader) resolved via batched parallel copy
         for kp, leaf in leaves:
             path = jax.tree_util.keystr(kp)
-            if path in by_path:
-                t = by_path[path]
-                arr = np.empty(t.shape, dtype=t.dtype)
-                src = np.ndarray(
-                    (t.nbytes,), dtype=np.uint8, buffer=buf, offset=t.offset
+            if path in catalog:
+                out.append(
+                    self._rebuild_leaf(leaf, catalog[path], exact_pairs)
                 )
-                pairs.append((fastcopy.as_bytes_view(arr), src))
-                out.append(arr)
-            elif path in meta.objects:
-                out.append(meta.objects[path])
+            elif path in objects:
+                out.append(objects[path])
             else:
                 raise KeyError(
-                    f"checkpoint is missing leaf {path}; topology or model "
-                    "definition changed since the snapshot"
+                    f"checkpoint is missing leaf {path}; model definition "
+                    "changed since the snapshot"
                 )
-        fastcopy.copy_many(pairs)
+        srcs = fastcopy.parallel_map(
+            lambda pair: fastcopy.as_bytes_view(pair[1]()), exact_pairs
+        )
+        fastcopy.copy_many(
+            [(dst, src) for (dst, _), src in zip(exact_pairs, srcs)]
+        )
         return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _rebuild_leaf(self, leaf, blocks: List, exact_pairs: List):
+        """blocks: list of (TensorMeta, reader). Returns the restored leaf:
+        numpy for unsharded templates, a sharded jax.Array for GSPMD ones."""
+        import jax
+
+        # The checkpoint's global shape must match the template exactly —
+        # a changed model dimension must fail loudly, not load cropped or
+        # zero-padded weights.
+        t0 = blocks[0][0]
+        saved_shape = tuple(
+            t0.global_shape if t0.global_shape is not None else t0.shape
+        )
+        want_shape = tuple(int(d) for d in np.shape(leaf))
+        if saved_shape != want_shape:
+            raise KeyError(
+                f"checkpoint leaf {t0.path} has global shape {saved_shape} "
+                f"but the template wants {want_shape}; model definition "
+                "changed since the snapshot"
+            )
+        # Per-leaf read memo: partial-overlap assembly touches a saved
+        # block once per overlapping target region; cache the bytes so a
+        # reshard reads each block once, not once per region.
+        blocks = [(t, _memo_reader(r)) for t, r in blocks]
+        sharded_template = (
+            isinstance(leaf, jax.Array)
+            and getattr(leaf, "sharding", None) is not None
+            and len(leaf.sharding.device_set) > 1
+        )
+        if not sharded_template:
+            shape = tuple(int(d) for d in np.shape(leaf))
+            arr = np.empty(shape, dtype=blocks[0][0].dtype)
+            # raises on gaps; exact matches land via the batched copy
+            self._region_fill(
+                arr, tuple((0, d) for d in shape), blocks, exact_pairs
+            )
+            return arr
+        # GSPMD leaf: assemble each unique addressable block of the target
+        # sharding, transfer once per device, rewrap.
+        region_cache: Dict[Tuple, np.ndarray] = {}
+        single_arrays = []
+        for sh in leaf.addressable_shards:
+            key = _index_key(sh.index, leaf.shape)
+            host = region_cache.get(key)
+            if host is None:
+                shape = tuple(stop - start for start, stop in key)
+                host = np.empty(shape, dtype=blocks[0][0].dtype)
+                self._region_fill(host, key, blocks, exact_pairs=None)
+                region_cache[key] = host
+            single_arrays.append(jax.device_put(host, sh.device))
+        return jax.make_array_from_single_device_arrays(
+            tuple(int(d) for d in leaf.shape), leaf.sharding, single_arrays
+        )
+
+    @staticmethod
+    def _region_fill(out: np.ndarray, region: Tuple[Tuple[int, int], ...],
+                     blocks: List, exact_pairs: Optional[List]) -> bool:
+        """Fill `out` (shaped as `region`) from the available blocks.
+
+        Exact-index matches are deferred to the caller's batched parallel
+        copy when `exact_pairs` is given; partial overlaps are assembled
+        inline. Raises KeyError if the blocks do not cover the region.
+        """
+        region_size = int(np.prod([stop - start for start, stop in region]))
+        if region_size == 0:
+            return True
+        for t, reader in blocks:
+            t_index = t.index
+            if t_index is None:
+                t_index = tuple((0, d) for d in t.shape)
+            if t_index == region:
+                if exact_pairs is not None:
+                    exact_pairs.append(
+                        (fastcopy.as_bytes_view(out, writeback=True), reader)
+                    )
+                else:
+                    np.copyto(out, reader())
+                return True
+        covered = 0
+        for t, reader in blocks:
+            t_index = t.index
+            if t_index is None:
+                t_index = tuple((0, d) for d in t.shape)
+            inter = []
+            for (rs, re), (bs, be) in zip(region, t_index):
+                s, e = max(rs, bs), min(re, be)
+                if s >= e:
+                    inter = None
+                    break
+                inter.append((s, e))
+            if inter is None:
+                continue
+            src = reader()
+            src_sl = tuple(
+                slice(s - bs, e - bs)
+                for (s, e), (bs, _) in zip(inter, t_index)
+            )
+            dst_sl = tuple(
+                slice(s - rs, e - rs)
+                for (s, e), (rs, _) in zip(inter, region)
+            )
+            out[dst_sl] = src[src_sl]
+            covered += int(np.prod([e - s for s, e in inter]))
+        if covered < region_size:
+            raise KeyError(
+                f"checkpoint blocks cover {covered}/{region_size} elements "
+                f"of region {region}; topology changed beyond what the "
+                "saved shards can rebuild"
+            )
+        return True
 
     # ------------- misc -------------
     @property

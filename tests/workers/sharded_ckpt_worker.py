@@ -1,0 +1,104 @@
+"""Multi-process sharded-checkpoint e2e worker (driven by
+tests/test_sharded_checkpoint.py::TestMultiProcess).
+
+Each process owns one CPU device of a global fsdp mesh; the train state is
+GSPMD-sharded across processes, so no process can address the full arrays —
+the case the round-2 engine could not checkpoint. Phase "save" trains and
+persists a sharded checkpoint; phase "resume" (run with a *different* world
+size) restores by re-assembling blocks for the new mesh and continues.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--nproc", type=int, required=True)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--mode", choices=["save", "resume"], required=True)
+    ap.add_argument("--steps", type=int, required=True)
+    ap.add_argument("--losses-out", default="")
+    args = ap.parse_args()
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # One device per process: the pytest harness exports
+    # xla_force_host_platform_device_count=8, which would give every
+    # process 8 local devices and leave ranks>0 with no addressable shard
+    # of a devices[:4] mesh.
+    os.environ["XLA_FLAGS"] = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    os.environ["DLROVER_TPU_NUM_PROCESSES"] = str(args.nproc)
+    os.environ["DLROVER_TPU_PROCESS_ID"] = str(args.rank)
+    os.environ["DLROVER_TPU_LOCAL_RANK"] = str(args.rank)
+    os.environ["DLROVER_TPU_NODE_RANK"] = "0"
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=args.coordinator,
+        num_processes=args.nproc,
+        process_id=args.rank,
+    )
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.accel import ParallelSpec, auto_accelerate
+    from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+    from dlrover_tpu.train.checkpoint.checkpointer import (
+        ShardedCheckpointer,
+        StorageType,
+    )
+
+    cfg = dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)
+    model = GPT(cfg)
+    opt = optax.adamw(1e-3)
+    tokens = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size)
+    )
+    res = auto_accelerate(
+        model, opt, jnp.asarray(tokens), _token_loss(loss_fn),
+        spec=ParallelSpec(fsdp=args.nproc), devices=jax.devices(),
+    )
+    batch = jax.make_array_from_callback(
+        tokens.shape, res.batch_sharding, lambda idx: tokens[idx]
+    )
+    ckpt = ShardedCheckpointer(args.ckpt_dir)
+    start = 0
+    state = res.state
+    if args.mode == "resume":
+        start, state = ckpt.load_checkpoint(res.state)
+        assert start > 0, "resume found no checkpoint"
+    losses = []
+    for s in range(start + 1, args.steps + 1):
+        state, m = res.train_step(state, batch)
+        losses.append(float(m["loss"]))
+    if args.mode == "save":
+        assert ckpt.save_checkpoint(
+            args.steps, state, StorageType.DISK
+        ), "sharded save failed"
+    ckpt.close()
+    if args.losses_out and args.rank == 0:
+        with open(args.losses_out, "w") as f:
+            json.dump({"start": start, "losses": losses}, f)
+    print(f"worker {args.rank}/{args.nproc} mode={args.mode} ok", flush=True)
+
+
+def _token_loss(loss_fn):
+    def token_loss(module, params, batch):
+        return loss_fn(module.apply({"params": params}, batch), batch)
+
+    return token_loss
+
+
+if __name__ == "__main__":
+    sys.exit(main())
