@@ -35,6 +35,17 @@ class ParallelSpec:
     expert: int = 1
     pipe: int = 1
 
+    def __post_init__(self):
+        for name in ("data", "fsdp", "tensor", "seq", "expert", "pipe"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} degree must be >= 1")
+        if self.pipe > 1:
+            # Do not silently waste devices on an axis nothing implements.
+            raise NotImplementedError(
+                "pipeline parallelism (pipe>1) is not implemented yet; "
+                "use data/fsdp/tensor/seq"
+            )
+
     @property
     def total(self) -> int:
         return (self.data * self.fsdp * self.tensor * self.seq
@@ -102,7 +113,9 @@ def make_train_step(module, optimizer, loss, mesh, rules,
     import flax.linen as nn
 
     def step(state, batch):
-        with nn.logical_axis_rules(list(rules)):
+        # The mesh context makes the mesh discoverable at trace time
+        # (thread_resources) — ops like ring attention shard_map over it.
+        with mesh, nn.logical_axis_rules(list(rules)):
             def scalar_loss(params):
                 return loss(module, params, batch)
 

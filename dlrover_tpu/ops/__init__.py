@@ -1,0 +1,20 @@
+"""TPU-native kernels (the reference's native-ops layer, rebuilt).
+
+Parity targets: ATorch's flash-attention module swaps
+(``atorch/atorch/modules/transformer/layers.py:898``) and the
+sequence-parallel attention
+(``atorch/atorch/modules/distributed_transformer/distributed_attention.py``).
+Here the hot op is a Pallas TPU kernel and sequence parallelism is a
+``shard_map`` ring over the ICI torus — the TPU-first replacements, not
+ports.
+"""
+
+from dlrover_tpu.ops.attention import flash_attention, reference_attention
+from dlrover_tpu.ops.ring_attention import ring_attention, ring_attention_shard
+
+__all__ = [
+    "flash_attention",
+    "reference_attention",
+    "ring_attention",
+    "ring_attention_shard",
+]

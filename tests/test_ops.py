@@ -1,0 +1,146 @@
+"""Kernel numerics: Pallas flash attention + ring attention vs the einsum
+oracle, standalone and end-to-end through the GPT model.
+
+The Pallas kernels run in interpreter mode on CPU — same kernel code path
+as the compiled TPU run (SURVEY.md §4's "multi-node logic without
+multi-node" strategy applied to kernels).
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.accel import ParallelSpec, auto_accelerate, create_mesh
+from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+from dlrover_tpu.ops import (
+    flash_attention,
+    reference_attention,
+    ring_attention,
+)
+
+
+def rand_qkv(key, b=2, s=128, h=2, d=32, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    mk = lambda k: jax.random.normal(k, (b, s, h, d), dtype)
+    return mk(ks[0]), mk(ks[1]), mk(ks[2])
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_reference(self, causal):
+        q, k, v = rand_qkv(jax.random.PRNGKey(0))
+        out = flash_attention(
+            q, k, v, causal=causal, block_q=64, block_k=64
+        )
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_reference(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(1), s=64)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, block_q=32, block_k=32) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr in zip(g_flash, g_ref):
+            np.testing.assert_allclose(gf, gr, rtol=1e-4, atol=1e-4)
+
+    def test_uneven_blocks(self):
+        """Sequence not divisible by the asked block size shrinks blocks."""
+        q, k, v = rand_qkv(jax.random.PRNGKey(2), s=96)
+        out = flash_attention(q, k, v, block_q=64, block_k=64)
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_bf16_inputs(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(3), dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, block_q=64, block_k=64)
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(
+            out.astype(np.float32), ref.astype(np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference_on_seq_mesh(self, causal):
+        mesh = create_mesh([("seq", 8)])
+        q, k, v = rand_qkv(jax.random.PRNGKey(4), s=64)
+        out = ring_attention(q, k, v, causal=causal, mesh=mesh)
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_falls_back_without_seq_axis(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(5), s=32)
+        out = ring_attention(q, k, v, mesh=None)
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+    def test_mixed_mesh_batch_and_seq(self):
+        mesh = create_mesh([("data", 2), ("seq", 4)])
+        q, k, v = rand_qkv(jax.random.PRNGKey(6), b=4, s=64)
+        out = ring_attention(q, k, v, mesh=mesh)
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def token_loss(module, params, batch):
+    return loss_fn(module.apply({"params": params}, batch), batch)
+
+
+def run_training(spec, cfg, steps=3):
+    model = GPT(cfg)
+    opt = optax.adamw(1e-3)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size
+    )
+    res = auto_accelerate(model, opt, tokens, token_loss, spec=spec)
+    state = res.state
+    batch = jax.device_put(tokens, res.batch_sharding)
+    losses = []
+    for _ in range(steps):
+        state, m = res.train_step(state, batch)
+        losses.append(float(m["loss"]))
+    return losses
+
+
+class TestModelIntegration:
+    """attn_impl end-to-end: training losses must match the einsum path."""
+
+    @pytest.fixture(scope="class")
+    def baseline(self):
+        cfg = dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)
+        return run_training(ParallelSpec(), cfg)
+
+    def test_sp_ring_training_matches(self, baseline):
+        cfg = dataclasses.replace(
+            GPTConfig.tiny(), dtype=jnp.float32, attn_impl="ring"
+        )
+        losses = run_training(ParallelSpec(seq=8), cfg)
+        np.testing.assert_allclose(losses, baseline, rtol=2e-5, atol=2e-5)
+
+    def test_sp_composes_with_dp(self, baseline):
+        cfg = dataclasses.replace(
+            GPTConfig.tiny(), dtype=jnp.float32, attn_impl="ring"
+        )
+        losses = run_training(ParallelSpec(data=2, seq=4), cfg)
+        np.testing.assert_allclose(losses, baseline, rtol=2e-5, atol=2e-5)
+
+    def test_pallas_training_matches(self, baseline):
+        cfg = dataclasses.replace(
+            GPTConfig.tiny(), dtype=jnp.float32, attn_impl="pallas"
+        )
+        losses = run_training(ParallelSpec(), cfg)
+        np.testing.assert_allclose(losses, baseline, rtol=1e-4, atol=1e-4)
