@@ -1,0 +1,23 @@
+"""Trainer-side data layer: dynamic sharding client, elastic sampler,
+elastic dataloader.
+
+Parity: reference ``dlrover/python/elastic_agent/sharding/client.py``
+(ShardingClient / IndexShardingClient), ``dlrover/trainer/torch/elastic/
+sampler.py`` (ElasticDistributedSampler) and ``elastic/dataloader.py``
+(ElasticDataLoader) — the consumers of the master's dynamic data sharding
+that were missing in rounds 1-2.
+"""
+
+from dlrover_tpu.train.data.dataloader import ElasticDataLoader
+from dlrover_tpu.train.data.sampler import ElasticSampler
+from dlrover_tpu.train.data.sharding_client import (
+    IndexShardingClient,
+    ShardingClient,
+)
+
+__all__ = [
+    "ElasticDataLoader",
+    "ElasticSampler",
+    "IndexShardingClient",
+    "ShardingClient",
+]

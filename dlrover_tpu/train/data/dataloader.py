@@ -1,0 +1,163 @@
+"""Elastic host dataloader.
+
+Parity: reference ``dlrover/trainer/torch/elastic/dataloader.py``
+(``ElasticDataLoader``: batch-size hot-reload from the master-tuned config
+file) + ATorch's ``elastic_dataloader.py`` (driven by the dlrover
+``IndexShardingClient``). No torch: a plain host-side loader producing
+stacked numpy batches for ``jax.device_put``, with an optional background
+prefetch thread (the GPU-prefetch-stream analog; on TPU the transfer
+overlap comes from ``device_put``'s async dispatch).
+
+Index sources, by priority:
+- ``sharding_client`` (IndexShardingClient): master-driven dynamic shards —
+  elastic, exactly-once across worker failures;
+- ``sampler`` (ElasticSampler): deterministic resumable local partitioning;
+- neither: sequential over the dataset.
+"""
+
+import json
+import os
+import queue
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.constants import ConfigPath
+from dlrover_tpu.common.log import logger
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, tuple):
+        return tuple(
+            np.stack([s[i] for s in samples]) for i in range(len(first))
+        )
+    if isinstance(first, dict):
+        return {k: np.stack([s[k] for s in samples]) for k in first}
+    return np.stack(samples)
+
+
+class ElasticDataLoader:
+    """Iterate batches of an indexable dataset.
+
+    ``dataset[i]`` must return a sample (array / tuple / dict of arrays).
+    ``set_batch_size`` (or the tuned-config file) changes the batch size
+    between epochs/batches without rebuilding the loader — the hook the
+    auto paral-config tuner drives (reference ``dataloader.py:133``).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        sampler=None,
+        sharding_client=None,
+        collate_fn: Optional[Callable] = None,
+        drop_last: bool = False,
+        prefetch: int = 0,
+        config_file: Optional[str] = None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = sampler
+        self.sharding_client = sharding_client
+        self.collate_fn = collate_fn or _default_collate
+        self.drop_last = drop_last
+        self.prefetch = prefetch
+        self._config_file = (
+            config_file
+            if config_file is not None
+            else os.getenv(ConfigPath.ENV_PARAL_CONFIG, "")
+        )
+        self._config_version = -1
+        self.load_config()
+
+    # ------------- tuned-config hot reload -------------
+    def load_config(self):
+        """Pick up a master-tuned batch size if the config file advanced."""
+        path = self._config_file
+        if not path or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                cfg = json.load(f)
+        except (OSError, ValueError):
+            return
+        version = cfg.get("version", 0)
+        if version <= self._config_version:
+            return
+        self._config_version = version
+        dl_cfg = cfg.get("dataloader", {})
+        bs = dl_cfg.get("batch_size")
+        if bs and int(bs) != self.batch_size:
+            logger.info(
+                "dataloader batch size %s -> %s (tuned config v%s)",
+                self.batch_size, bs, version,
+            )
+            self.batch_size = int(bs)
+
+    def set_batch_size(self, batch_size: int):
+        self.batch_size = int(batch_size)
+
+    # ------------- iteration -------------
+    def _index_stream(self) -> Iterator[int]:
+        if self.sharding_client is not None:
+            while True:
+                idx = self.sharding_client.fetch_sample_index()
+                if idx is None:
+                    return
+                yield idx
+        elif self.sampler is not None:
+            yield from iter(self.sampler)
+        else:
+            yield from range(len(self.dataset))
+
+    def _batches(self) -> Iterator[Any]:
+        batch = []
+        for idx in self._index_stream():
+            self.load_config()
+            batch.append(self.dataset[idx])
+            if len(batch) >= self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def __iter__(self) -> Iterator[Any]:
+        if self.prefetch <= 0:
+            yield from self._batches()
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        _END = object()
+        err: list = []
+
+        def producer():
+            try:
+                for b in self._batches():
+                    q.put(b)
+            except BaseException as e:  # surface in the consumer
+                err.append(e)
+            finally:
+                q.put(_END)
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="dataloader-prefetch")
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            yield item
+        t.join(timeout=5.0)
+        if err:
+            raise err[0]
+
+    def __len__(self) -> int:
+        if self.sampler is not None:
+            n = len(self.sampler)
+        else:
+            n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size

@@ -1,0 +1,103 @@
+"""Resumable, re-shardable distributed sampler.
+
+Parity: reference ``dlrover/trainer/torch/elastic/sampler.py``
+(``ElasticDistributedSampler``): deterministic per-epoch shuffle shared by
+all ranks, rank-strided partitioning, ``state_dict(step, batch_size)`` /
+``load_state_dict`` checkpointing that survives a *world-size change* —
+the resumed job re-partitions the not-yet-consumed tail of the epoch over
+the new world (``sampler.py:25,118-130``).
+"""
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class ElasticSampler:
+    """Yields dataset indices for this rank.
+
+    - Epoch order: deterministic permutation of ``range(size)`` seeded with
+      ``seed + epoch`` (identical on every rank), or sequential when
+      ``shuffle=False``.
+    - Partitioning: global order is consumed rank-strided (rank r takes
+      positions r, r+world, r+2*world ...), so any prefix of the *global*
+      stream maps to a consumed-count checkpoint that is world-size
+      independent.
+    - Resume: ``load_state_dict`` restores the epoch + global consumed
+      count; iteration continues from there under the *current* rank/world.
+    """
+
+    def __init__(self, size: int, rank: int = 0, world_size: int = 1,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = False):
+        if size <= 0:
+            raise ValueError("dataset size must be positive")
+        self.size = size
+        self.rank = rank
+        self.world_size = max(1, world_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self._consumed = 0  # global positions consumed in this epoch
+
+    # ------------- iteration -------------
+    def _epoch_order(self) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(self.size)
+        rng = np.random.default_rng(self.seed + self.epoch)
+        return rng.permutation(self.size)
+
+    def __iter__(self) -> Iterator[int]:
+        order = self._epoch_order()
+        n = self.size
+        if self.drop_last:
+            n -= n % self.world_size
+        start = self._consumed + self.rank
+        for pos in range(start, n, self.world_size):
+            self._consumed = pos - self.rank + self.world_size
+            yield int(order[pos])
+
+    def __len__(self) -> int:
+        remaining = self.size - self._consumed
+        if self.drop_last:
+            return remaining // self.world_size
+        return (remaining + self.world_size - 1 - self.rank) // self.world_size
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        self._consumed = 0
+
+    # ------------- checkpoint -------------
+    def state_dict(self, step: Optional[int] = None,
+                   micro_batch_size: Optional[int] = None) -> Dict:
+        """Snapshot progress. With (step, micro_batch_size) given, computes
+        the consumed count from the trainer's step counter — exact even if
+        the dataloader prefetched ahead (reference ``sampler.py:118``)."""
+        consumed = self._consumed
+        if step is not None and micro_batch_size is not None:
+            consumed = step * micro_batch_size * self.world_size
+        return {
+            "epoch": self.epoch,
+            "consumed": int(consumed),
+            "size": self.size,
+            "seed": self.seed,
+            "shuffle": self.shuffle,
+        }
+
+    def load_state_dict(self, state: Dict):
+        if state.get("size") not in (None, self.size):
+            raise ValueError(
+                f"sampler checkpoint is for a dataset of {state['size']} "
+                f"records, this one has {self.size}"
+            )
+        self.epoch = int(state.get("epoch", 0))
+        self.seed = int(state.get("seed", self.seed))
+        self.shuffle = bool(state.get("shuffle", self.shuffle))
+        # Align to a world-size boundary so every rank resumes on its own
+        # stride; at most world_size-1 records are replayed.
+        consumed = int(state.get("consumed", 0))
+        self._consumed = (consumed // self.world_size) * self.world_size
+        if self._consumed >= self.size:
+            self.epoch += 1
+            self._consumed = 0

@@ -1,0 +1,151 @@
+"""Trainer-side dynamic-sharding clients.
+
+Parity: reference ``dlrover/python/elastic_agent/sharding/client.py:31``
+(``ShardingClient``: register dataset, fetch/report shards) and ``:233``
+(``IndexShardingClient``: a per-sample index stream on top of shards).
+The master's TaskManager owns todo/doing bookkeeping and re-dispatches the
+in-flight shards of a failed worker (``master/shard/task_manager.py``), so
+a worker that crashes mid-shard never loses records and a record is
+consumed exactly once per epoch across the fleet.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient, build_master_client
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.messages import ShardTask
+
+
+class ShardingClient:
+    """Fetch [start, end) record shards of a master-managed dataset.
+
+    The flow (reference ``sharding/client.py`` semantics):
+
+    - first caller registers the dataset (idempotent on the master);
+    - ``fetch_shard()`` pulls the next shard or None when the epoch is
+      exhausted;
+    - ``report_batch_done()`` acks the *oldest* outstanding shard — an
+      unacked shard is re-dispatched by the master if this worker dies.
+    """
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        storage_type: str = "table",
+        client: Optional[MasterClient] = None,
+    ):
+        self.dataset_name = dataset_name
+        self._client = client or build_master_client()
+        self._pending: deque = deque()  # fetched, not yet acked task ids
+        self._lock = threading.Lock()
+        self._fetched = 0
+        self._reported = 0
+        self._client.report_dataset_shard_params(
+            dataset_name=dataset_name,
+            dataset_size=dataset_size,
+            shard_size=shard_size,
+            num_epochs=num_epochs,
+            shuffle=shuffle,
+            storage_type=storage_type,
+        )
+
+    def fetch_shard(self, retry_interval: float = 0.5,
+                    max_wait: float = 0.0) -> Optional[ShardTask]:
+        """Next shard, or None when the dataset is exhausted.
+
+        ``max_wait > 0`` retries an empty answer for stragglers' shards to
+        be recovered (an exhausted *epoch* still returns None immediately
+        once the master reports the dataset finished).
+        """
+        deadline = time.monotonic() + max_wait
+        while True:
+            task: ShardTask = self._client.get_task(self.dataset_name)
+            if task.exists:
+                with self._lock:
+                    self._pending.append(task.task_id)
+                    self._fetched += 1
+                return task
+            if max_wait <= 0 or time.monotonic() >= deadline:
+                return None
+            time.sleep(retry_interval)
+
+    def report_batch_done(self, task_id: Optional[int] = None,
+                          success: bool = True) -> bool:
+        with self._lock:
+            if task_id is None:
+                if not self._pending:
+                    return False
+                task_id = self._pending.popleft()
+            else:
+                try:
+                    self._pending.remove(task_id)
+                except ValueError:
+                    pass
+            self._reported += 1
+        return bool(
+            self._client.report_task(self.dataset_name, task_id, success)
+        )
+
+    @property
+    def pending_tasks(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def get_current_epoch(self) -> int:
+        return self._client.get_dataset_epoch(self.dataset_name)
+
+    def get_shard_checkpoint(self) -> str:
+        return self._client.get_shard_checkpoint(self.dataset_name)
+
+
+class IndexShardingClient(ShardingClient):
+    """Per-sample index stream (reference ``sharding/client.py:233``).
+
+    ``fetch_sample_index()`` hands out one record index at a time, fetching
+    a new shard under the hood and acking the previous shard once all its
+    indices were consumed — the dataloader never sees shard boundaries.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._indices: deque = deque()
+        self._current_task: Optional[ShardTask] = None
+        self._consumed_of_current = 0
+
+    def fetch_sample_index(self) -> Optional[int]:
+        if not self._indices:
+            if not self._advance_shard():
+                return None
+        return self._indices.popleft()
+
+    def _advance_shard(self) -> bool:
+        # Ack the fully-consumed previous shard BEFORE fetching the next:
+        # crash between shards then re-dispatches only unconsumed data.
+        if self._current_task is not None:
+            self.report_batch_done(self._current_task.task_id)
+            self._current_task = None
+        task = self.fetch_shard()
+        if task is None:
+            return False
+        self._current_task = task
+        indices = (
+            task.record_indices
+            if task.record_indices
+            else range(task.start, task.end)
+        )
+        self._indices.extend(indices)
+        return True
+
+    def flush(self):
+        """Ack the in-progress shard (call after a checkpoint save: its
+        consumed records are now recoverable from the checkpoint)."""
+        if self._current_task is not None and not self._indices:
+            self.report_batch_done(self._current_task.task_id)
+            self._current_task = None
