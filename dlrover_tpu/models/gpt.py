@@ -42,6 +42,11 @@ class GPTConfig:
     scan_layers: bool = True
     attn_impl: str = "xla"  # "xla" | "pallas" | "ring"
     dropout: float = 0.0
+    # MoE (0 = dense MLP). With num_experts > 0 every block's FFN becomes
+    # an expert-parallel MoEMLP and __call__ returns (logits, aux_loss).
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def ff_dim(self) -> int:
@@ -52,14 +57,21 @@ class GPTConfig:
         return self.d_model // self.num_heads
 
     def flops_per_token(self) -> float:
-        """Approx training FLOPs/token (6*N params + attention)."""
-        n = self.param_count()
+        """Approx training FLOPs/token (6*N_active params + attention)."""
+        n = self.param_count(active=True)
         attn = 12 * self.num_layers * self.d_model * self.max_seq_len
         return 6 * n + attn
 
-    def param_count(self) -> int:
+    def param_count(self, active: bool = False) -> int:
+        """Total params; ``active=True`` counts only the top-k experts a
+        token actually visits (the MoE FLOPs basis)."""
         d, f, v, l = self.d_model, self.ff_dim, self.vocab_size, self.num_layers
-        per_layer = 4 * d * d + 2 * d * f + 4 * d  # qkvo + mlp + ln
+        if self.num_experts > 0:
+            n_ffn = self.moe_top_k if active else self.num_experts
+            mlp = n_ffn * (2 * d * f + f + d) + d * self.num_experts
+        else:
+            mlp = 2 * d * f
+        per_layer = 4 * d * d + mlp + 4 * d  # qkvo + ffn/moe + ln
         return v * d + self.max_seq_len * d + l * per_layer + d
 
     @staticmethod
@@ -149,6 +161,21 @@ class Block(nn.Module):
         x = x + _dense(d, "proj", ("heads", "embed"), cfg)(attn)
 
         y = _layernorm("ln2", cfg)(x)
+        if cfg.num_experts > 0:
+            from dlrover_tpu.ops.moe import MoEMLP
+
+            y, aux = MoEMLP(
+                num_experts=cfg.num_experts,
+                ff_dim=cfg.ff_dim,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="moe",
+            )(y)
+            x = x + y
+            x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+            return x, aux
         y = _dense(cfg.ff_dim, "up", ("embed", "mlp"), cfg)(y)
         y = nn.gelu(y)
         y = nn.with_logical_constraint(y, ("batch", "seq", "mlp"))
@@ -192,23 +219,31 @@ class GPT(nn.Module):
                 policy=jax.checkpoint_policies.nothing_saveable,
             )
         if cfg.scan_layers:
-            x, _ = nn.scan(
+            x, aux = nn.scan(
                 block,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="blocks")(x)
+            aux_total = jnp.mean(aux) if aux is not None else None
         else:
+            auxes = []
             for i in range(cfg.num_layers):
-                x, _ = block(cfg, name=f"block_{i}")(x)
+                x, aux = block(cfg, name=f"block_{i}")(x)
+                if aux is not None:
+                    auxes.append(aux)
+            aux_total = jnp.mean(jnp.stack(auxes)) if auxes else None
 
         x = _layernorm("ln_f", cfg)(x)
         # Tied output head: logits via the embedding table (GPT-2 style).
         logits = embed.attend(x.astype(cfg.param_dtype))
-        return nn.with_logical_constraint(
+        logits = nn.with_logical_constraint(
             logits, ("batch", "seq", "vocab")
         )
+        if cfg.num_experts > 0:
+            return logits, aux_total
+        return logits
 
 
 def loss_fn(logits, tokens, ignore_first: bool = True):
@@ -218,3 +253,11 @@ def loss_fn(logits, tokens, ignore_first: bool = True):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def moe_loss_fn(out, tokens, aux_weight: float = 1e-2):
+    """Loss for MoE models: ``out`` is ``(logits, aux)`` from a GPT with
+    ``num_experts > 0``; adds the load-balance aux loss (Switch's 1e-2
+    default weight)."""
+    logits, aux = out
+    return loss_fn(logits, tokens) + aux_weight * aux

@@ -10,6 +10,7 @@ ports.
 """
 
 from dlrover_tpu.ops.attention import flash_attention, reference_attention
+from dlrover_tpu.ops.moe import MoEMLP, compute_dispatch, load_balance_loss
 from dlrover_tpu.ops.ring_attention import ring_attention, ring_attention_shard
 
 __all__ = [
@@ -17,4 +18,7 @@ __all__ = [
     "reference_attention",
     "ring_attention",
     "ring_attention_shard",
+    "MoEMLP",
+    "compute_dispatch",
+    "load_balance_loss",
 ]

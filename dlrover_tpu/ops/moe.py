@@ -1,0 +1,194 @@
+"""Mixture-of-Experts with expert parallelism, TPU-first.
+
+Capability parity with the reference's MoE stack
+(``atorch/atorch/modules/moe/moe_layer.py:87-161``: top-k gate, alltoall
+dispatch to experts over a process group, alltoall combine). The TPU-first
+design is the GShard/Switch *einsum dispatch* formulation instead of
+explicit alltoalls: routing builds dense dispatch/combine tensors and the
+expert computation is a batched einsum over an ``expert``-sharded weight
+stack — GSPMD lowers the contractions into exactly the all-to-all +
+grouped-matmul schedule the reference hand-writes, and the MXU sees one
+large batched matmul per projection instead of E small ones.
+
+Everything is static-shape (capacity-factor truncation instead of
+data-dependent gather), so the whole layer jits into a single XLA
+computation with no host round-trips.
+
+Components:
+- ``compute_dispatch``: top-k routing -> combine [N,E,C] / dispatch masks
+  (Switch-style position-by-cumsum, capacity-dropping, gate renorm).
+- ``load_balance_loss``: Switch aux loss (E * sum(frac_routed * mean_gate)).
+- ``MoEMLP``: drop-in flax replacement for the transformer FFN; returns
+  ``(out, aux_loss)``. Expert weights carry the ``expert`` logical axis, so
+  ``ParallelSpec(expert=K)`` shards them K-way (EP) with zero model changes.
+"""
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def compute_dispatch(gates, top_k: int, capacity: int):
+    """Top-k assignment with per-expert capacity.
+
+    gates: [N, E] router probabilities (softmax output, fp32).
+    Returns (combine [N, E, C] fp32, dispatch [N, E, C] bool). Positions
+    within an expert are assigned in token order via cumsum (deterministic,
+    jit-friendly); tokens overflowing ``capacity`` are dropped for that
+    choice. Combine weights are renormalized over the token's selected
+    gates (GShard top-2 convention), so kept routes of a token sum to <= 1.
+    """
+    n, e = gates.shape
+    remaining = gates
+    base = jnp.zeros((e,), jnp.float32)  # slots already used per expert
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    selected_sum = jnp.zeros((n,), jnp.float32)
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                    # [N]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)      # [N, E]
+        # Position this token would take in its expert's buffer.
+        pos_all = jnp.cumsum(onehot, axis=0) - onehot + base[None, :]
+        pos = jnp.sum(pos_all * onehot, axis=-1)                # [N]
+        keep = (pos < capacity).astype(jnp.float32)
+        gate_val = jnp.sum(remaining * onehot, axis=-1)         # [N]
+        pos_oh = jax.nn.one_hot(
+            pos.astype(jnp.int32), capacity, dtype=jnp.float32
+        )
+        combine = combine + (
+            (gate_val * keep)[:, None, None]
+            * onehot[:, :, None]
+            * pos_oh[:, None, :]
+        )
+        selected_sum = selected_sum + gate_val
+        base = base + jnp.sum(onehot * keep[:, None], axis=0)
+        remaining = remaining * (1.0 - onehot)
+    denom = jnp.where(selected_sum > 0, selected_sum, 1.0)
+    combine = combine / denom[:, None, None]
+    dispatch = combine > 0
+    return combine, dispatch
+
+
+def load_balance_loss(gates, top1_onehot):
+    """Switch-Transformer auxiliary loss: E * sum_e(frac_e * prob_e).
+
+    Minimized (=1) when routing is uniform. gates [N, E] fp32,
+    top1_onehot [N, E] the first-choice assignment.
+    """
+    e = gates.shape[-1]
+    frac = jnp.mean(top1_onehot, axis=0)   # fraction routed to each expert
+    prob = jnp.mean(gates, axis=0)         # mean router probability
+    return e * jnp.sum(frac * prob)
+
+
+def expert_capacity(n_tokens: int, n_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Static per-expert buffer size, rounded up to a multiple of 8 so the
+    [E, C, D] expert batches tile the MXU/VPU lanes cleanly."""
+    c = int(np.ceil(capacity_factor * top_k * n_tokens / n_experts))
+    return max(8, ((c + 7) // 8) * 8)
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel FFN: ``[B,S,D] -> ([B,S,D], aux_loss)``.
+
+    Expert weight stacks are [E, ...] with the ``expert`` logical axis
+    first; under ``ParallelSpec(expert=K)`` each device group holds E/K
+    experts and GSPMD inserts the dispatch/combine all-to-alls. With no
+    ``expert`` mesh axis the same code runs replicated (pure MoE without
+    EP), and numerics are identical either way.
+    """
+
+    num_experts: int
+    ff_dim: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[Any, Any]:
+        b, s, d = x.shape
+        n, e, f = b * s, self.num_experts, self.ff_dim
+        xf = x.reshape(n, d)
+
+        router = self.param(
+            "router",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("embed", "expert")
+            ),
+            (d, e),
+            self.param_dtype,
+        )
+        # Routing in fp32: gate ordering must not depend on bf16 rounding.
+        logits = jnp.einsum(
+            "nd,de->ne", xf.astype(jnp.float32), router.astype(jnp.float32)
+        )
+        gates = jax.nn.softmax(logits, axis=-1)
+        top1 = jax.nn.one_hot(
+            jnp.argmax(gates, axis=-1), e, dtype=jnp.float32
+        )
+        aux = load_balance_loss(gates, top1)
+
+        cap = expert_capacity(n, e, self.top_k, self.capacity_factor)
+        combine, dispatch = compute_dispatch(gates, self.top_k, cap)
+
+        # Dispatch: [N,E,C] x [N,D] -> [E,C,D]. Under EP the output is
+        # expert-sharded; the contraction over (data-sharded) N becomes
+        # the dispatch all-to-all + psum.
+        expert_in = jnp.einsum(
+            "nec,nd->ecd", dispatch.astype(self.dtype), xf
+        )
+        expert_in = nn.with_logical_constraint(
+            expert_in, ("expert", None, "embed")
+        )
+
+        w_up = self.param(
+            "w_up",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("expert", "embed", "mlp")
+            ),
+            (e, d, f),
+            self.param_dtype,
+        )
+        b_up = self.param(
+            "b_up",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("expert", "mlp")
+            ),
+            (e, f),
+            self.param_dtype,
+        )
+        w_down = self.param(
+            "w_down",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("expert", "mlp", "embed")
+            ),
+            (e, f, d),
+            self.param_dtype,
+        )
+        b_down = self.param(
+            "b_down",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("expert", "embed")
+            ),
+            (e, d),
+            self.param_dtype,
+        )
+
+        h = jnp.einsum(
+            "ecd,edf->ecf", expert_in, w_up.astype(self.dtype)
+        ) + b_up[:, None, :].astype(self.dtype)
+        h = nn.gelu(h)
+        h = nn.with_logical_constraint(h, ("expert", None, "mlp"))
+        out_e = jnp.einsum(
+            "ecf,efd->ecd", h, w_down.astype(self.dtype)
+        ) + b_down[:, None, :].astype(self.dtype)
+
+        # Combine: weighted gather back to token order.
+        out = jnp.einsum(
+            "nec,ecd->nd", combine.astype(self.dtype), out_e
+        )
+        return out.reshape(b, s, d), aux
