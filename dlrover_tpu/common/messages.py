@@ -139,6 +139,10 @@ class ShardTask:
     start: int = 0
     end: int = 0
     record_indices: Optional[List[int]] = None
+    # Set on empty answers: True when the dataset is fully consumed (todo
+    # AND doing empty, epochs done) — an empty answer with finished=False
+    # means "retry: in-flight shards may yet be re-dispatched".
+    finished: bool = False
 
     @property
     def exists(self) -> bool:

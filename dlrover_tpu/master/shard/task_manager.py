@@ -7,6 +7,7 @@ restarted master resumes mid-epoch.
 """
 
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -32,14 +33,41 @@ class DoingTask:
 class DatasetManager:
     """Todo/doing queues for one dataset."""
 
-    def __init__(self, splitter: DatasetSplitter):
+    # A shard held in `doing` longer than this is presumed abandoned (its
+    # worker hung or exited without acking) and is returned to `todo` —
+    # the liveness fallback behind the clients' block-until-finished
+    # fetch. Worker *failures* are recovered immediately via
+    # recover_worker_tasks; this only catches silent abandonment.
+    DOING_TASK_TIMEOUT = 300.0
+
+    def __init__(self, splitter: DatasetSplitter,
+                 doing_timeout: Optional[float] = None):
         self.splitter = splitter
         self.todo: Deque[ShardTask] = deque()
         self.doing: Dict[int, DoingTask] = {}
+        self.doing_timeout = (
+            doing_timeout if doing_timeout is not None
+            else self.DOING_TASK_TIMEOUT
+        )
         self._task_id = 0
         self._completed_tasks = 0
 
+    def _reclaim_stale(self):
+        now = time.time()
+        stale = [
+            tid for tid, d in self.doing.items()
+            if now - d.start_time > self.doing_timeout
+        ]
+        for tid in stale:
+            doing = self.doing.pop(tid)
+            logger.warning(
+                "shard task %s of worker %s timed out after %.0fs; "
+                "re-dispatching", tid, doing.worker_id, self.doing_timeout,
+            )
+            self.todo.appendleft(doing.task)
+
     def _refill(self):
+        self._reclaim_stale()
         if self.todo or self.splitter.epoch_finished():
             return
         for shard in self.splitter.create_shards():
@@ -60,7 +88,10 @@ class DatasetManager:
     def get_task(self, worker_id: int) -> ShardTask:
         self._refill()
         if not self.todo:
-            return ShardTask()  # no task: dataset exhausted for now
+            # Distinguish "done" from "empty for now": while shards are in
+            # `doing`, a failed worker's shards may yet be re-dispatched,
+            # so clients must keep polling rather than end the epoch.
+            return ShardTask(finished=self.completed())
         task = self.todo.popleft()
         self.doing[task.task_id] = DoingTask(task, worker_id, time.time())
         return task
@@ -145,7 +176,12 @@ class TaskManager:
                 dataset_name, dataset_size, shard_size, num_epochs, shuffle,
                 storage_type,
             )
-            self._datasets[dataset_name] = DatasetManager(splitter)
+            timeout = float(os.getenv(
+                "DLROVER_TPU_SHARD_TIMEOUT", DatasetManager.DOING_TASK_TIMEOUT
+            ))
+            self._datasets[dataset_name] = DatasetManager(
+                splitter, doing_timeout=timeout
+            )
             logger.info("registered dataset %s (size=%s shard=%s epochs=%s)",
                         dataset_name, dataset_size, shard_size, num_epochs)
 
@@ -157,7 +193,10 @@ class TaskManager:
         with self._lock:
             ds = self._datasets.get(dataset_name)
             if ds is None:
-                return ShardTask()
+                # Unknown dataset: report finished so a polling client
+                # ends instead of spinning forever (clients re-register
+                # in their constructor after a master restart).
+                return ShardTask(finished=True)
             self._worker_last_task[worker_id] = time.time()
             return ds.get_task(worker_id)
 

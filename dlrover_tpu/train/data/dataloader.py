@@ -114,13 +114,17 @@ class ElasticDataLoader:
             yield from range(len(self.dataset))
 
     def _batches(self) -> Iterator[Any]:
+        # Config reload happens at batch boundaries, not per sample: the
+        # tuned batch size changes rarely and a stat+parse per record
+        # would sit on the input hot path.
         batch = []
+        self.load_config()
         for idx in self._index_stream():
-            self.load_config()
             batch.append(self.dataset[idx])
             if len(batch) >= self.batch_size:
                 yield self.collate_fn(batch)
                 batch = []
+                self.load_config()
         if batch and not self.drop_last:
             yield self.collate_fn(batch)
 
@@ -131,25 +135,46 @@ class ElasticDataLoader:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         _END = object()
         err: list = []
+        stop = threading.Event()
+
+        def put_until_stop(item) -> bool:
+            # Bounded puts + stop checks: a consumer that abandons
+            # iteration (break / exception) must not leave the producer
+            # pinned forever on a full queue.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
                 for b in self._batches():
-                    q.put(b)
+                    if not put_until_stop(b):
+                        return
             except BaseException as e:  # surface in the consumer
                 err.append(e)
             finally:
-                q.put(_END)
-
+                put_until_stop(_END)  # the consumer blocks on q.get
         t = threading.Thread(target=producer, daemon=True,
                              name="dataloader-prefetch")
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            yield item
-        t.join(timeout=5.0)
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                yield item
+        finally:
+            stop.set()
+            while not q.empty():  # unblock a producer mid-put
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5.0)
         if err:
             raise err[0]
 

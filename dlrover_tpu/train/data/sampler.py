@@ -23,6 +23,12 @@ class ElasticSampler:
       positions r, r+world, r+2*world ...), so any prefix of the *global*
       stream maps to a consumed-count checkpoint that is world-size
       independent.
+    - Equal lengths: with ``drop_last=False`` the epoch is padded up to a
+      multiple of ``world_size`` by wrapping to the front of the order
+      (torch DistributedSampler semantics) — every rank yields the same
+      number of indices, so lock-step SPMD ranks hit the same number of
+      collectives and nobody hangs at epoch end. ``drop_last=True``
+      truncates instead.
     - Resume: ``load_state_dict`` restores the epoch + global consumed
       count; iteration continues from there under the *current* rank/world.
     """
@@ -48,21 +54,24 @@ class ElasticSampler:
         rng = np.random.default_rng(self.seed + self.epoch)
         return rng.permutation(self.size)
 
+    def _total(self) -> int:
+        """Global positions per epoch: a multiple of world_size (padded by
+        wraparound, or truncated under drop_last)."""
+        w = self.world_size
+        if self.drop_last:
+            return self.size - self.size % w
+        return ((self.size + w - 1) // w) * w
+
     def __iter__(self) -> Iterator[int]:
         order = self._epoch_order()
-        n = self.size
-        if self.drop_last:
-            n -= n % self.world_size
+        total = self._total()
         start = self._consumed + self.rank
-        for pos in range(start, n, self.world_size):
+        for pos in range(start, total, self.world_size):
             self._consumed = pos - self.rank + self.world_size
-            yield int(order[pos])
+            yield int(order[pos % self.size])
 
     def __len__(self) -> int:
-        remaining = self.size - self._consumed
-        if self.drop_last:
-            return remaining // self.world_size
-        return (remaining + self.world_size - 1 - self.rank) // self.world_size
+        return max(0, self._total() - self._consumed) // self.world_size
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch

@@ -56,15 +56,21 @@ class ShardingClient:
             storage_type=storage_type,
         )
 
-    def fetch_shard(self, retry_interval: float = 0.5,
-                    max_wait: float = 0.0) -> Optional[ShardTask]:
-        """Next shard, or None when the dataset is exhausted.
+    def fetch_shard(self, retry_interval: float = 0.2,
+                    max_wait: Optional[float] = None) -> Optional[ShardTask]:
+        """Next shard, or None when the dataset is finished.
 
-        ``max_wait > 0`` retries an empty answer for stragglers' shards to
-        be recovered (an exhausted *epoch* still returns None immediately
-        once the master reports the dataset finished).
+        An empty answer with ``finished=False`` means shards are still
+        in-flight on other workers and may be re-dispatched if they fail —
+        by default this retries until the master reports the dataset
+        *finished* (todo and doing both empty), which is what makes the
+        fleet-wide exactly-once guarantee hold without racing failure
+        detection. ``max_wait`` bounds the retry window (0 = return
+        immediately on an empty answer).
         """
-        deadline = time.monotonic() + max_wait
+        deadline = (
+            None if max_wait is None else time.monotonic() + max_wait
+        )
         while True:
             task: ShardTask = self._client.get_task(self.dataset_name)
             if task.exists:
@@ -72,7 +78,9 @@ class ShardingClient:
                     self._pending.append(task.task_id)
                     self._fetched += 1
                 return task
-            if max_wait <= 0 or time.monotonic() >= deadline:
+            if task.finished:
+                return None
+            if deadline is not None and time.monotonic() >= deadline:
                 return None
             time.sleep(retry_interval)
 
@@ -117,7 +125,6 @@ class IndexShardingClient(ShardingClient):
         super().__init__(*args, **kwargs)
         self._indices: deque = deque()
         self._current_task: Optional[ShardTask] = None
-        self._consumed_of_current = 0
 
     def fetch_sample_index(self) -> Optional[int]:
         if not self._indices:
@@ -144,8 +151,14 @@ class IndexShardingClient(ShardingClient):
         return True
 
     def flush(self):
-        """Ack the in-progress shard (call after a checkpoint save: its
-        consumed records are now recoverable from the checkpoint)."""
+        """Ack the current shard if it is fully drained.
+
+        Call before ``get_shard_checkpoint`` so a consumed shard is not
+        checkpointed as in-flight (and re-dispatched on restore). A
+        *partially*-consumed shard stays in the master's ``doing`` set on
+        purpose: re-dispatch granularity is the shard, so records consumed
+        past the last completed shard are trained again after a failure
+        (at-least-once, matching the reference's recovery semantics)."""
         if self._current_task is not None and not self._indices:
             self.report_batch_done(self._current_task.task_id)
             self._current_task = None

@@ -32,6 +32,9 @@ def main():
     parser.add_argument("--resume-marker", type=str, default="",
                         help="file to record the step resumed from")
     parser.add_argument("--expect-world", type=int, default=0)
+    parser.add_argument("--use-dataloader", action="store_true",
+                        help="consume master-dispatched shards through "
+                        "ElasticDataLoader instead of full-batch steps")
     args = parser.parse_args()
 
     dtrain.init_training()
@@ -53,9 +56,9 @@ def main():
     state = {"w": w, "opt": opt.init(w), "step": 0}
 
     @jax.jit
-    def step_fn(state):
+    def step_fn(state, bx, by):
         def loss_fn(w):
-            return jnp.mean((x @ w - y) ** 2)
+            return jnp.mean((bx @ w - by) ** 2)
 
         loss, grads = jax.value_and_grad(loss_fn)(state["w"])
         updates, opt_state = opt.update(grads, state["opt"])
@@ -64,6 +67,57 @@ def main():
             "opt": opt_state,
             "step": state["step"] + 1,
         }, loss
+
+    def batch_stream():
+        """Yield (bx, by) per training step, forever."""
+        if not args.use_dataloader:
+            while True:
+                yield x, y
+            return
+        import numpy as np
+
+        from dlrover_tpu.train.data import (
+            ElasticDataLoader,
+            ElasticSampler,
+            IndexShardingClient,
+        )
+
+        records = [
+            (np.asarray(x[i]), np.asarray(y[i])) for i in range(x.shape[0])
+        ]
+        batch = 16
+        sampler = None
+        if client is not None:
+            # Master-driven dynamic shards: elastic, recovered on worker
+            # failure. Epoch budget covers every worker's step budget.
+            world = max(1, jax.process_count())
+            epochs = args.steps * batch * world // len(records) + 2
+            sharding = IndexShardingClient(
+                "train-tiny", dataset_size=len(records), shard_size=batch,
+                num_epochs=epochs, client=client,
+            )
+            loader = ElasticDataLoader(
+                records, batch_size=batch, sharding_client=sharding
+            )
+        else:
+            sampler = ElasticSampler(
+                len(records), rank=rank, world_size=jax.process_count(),
+                shuffle=True,
+            )
+            loader = ElasticDataLoader(
+                records, batch_size=batch, sampler=sampler
+            )
+        epoch = 0
+        while True:
+            got = False
+            for bx, by in loader:
+                got = True
+                yield jnp.asarray(bx), jnp.asarray(by)
+            if sampler is not None:
+                epoch += 1
+                sampler.set_epoch(epoch)  # rewind for the next pass
+            elif not got:  # shard epochs exhausted before the step budget
+                return
 
     ckpt = None
     start = 0
@@ -78,6 +132,7 @@ def main():
             print(f"rank {rank}: resumed from flash checkpoint at step "
                   f"{start}", flush=True)
 
+    batches = batch_stream()
     for step in range(start, args.steps):
         if (
             args.crash_at >= 0
@@ -89,7 +144,13 @@ def main():
                 f.write("crashed")
             print(f"rank {rank}: injected crash at step {step}", flush=True)
             sys.exit(1)
-        state, loss = step_fn(state)
+        try:
+            bx, by = next(batches)
+        except StopIteration:
+            print(f"rank {rank}: dataset exhausted at step {step}",
+                  flush=True)
+            break
+        state, loss = step_fn(state, bx, by)
         if ckpt is not None:
             if args.persist_every and (step + 1) % args.persist_every == 0:
                 ckpt.save_checkpoint(step + 1, state, StorageType.DISK)

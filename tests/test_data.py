@@ -32,13 +32,32 @@ def make_client(master, node_id):
 
 
 class TestElasticSampler:
-    def test_partition_disjoint_and_complete(self):
+    def test_partition_complete_and_equal_length(self):
+        """103 records over 4 ranks: every record appears, every rank
+        yields the same count (padded by wraparound, so lock-step SPMD
+        ranks never diverge in collective count at epoch end)."""
         world = 4
-        seen = []
+        per_rank = []
         for r in range(world):
             s = ElasticSampler(103, rank=r, world_size=world, shuffle=True)
-            seen.extend(list(s))
-        assert sorted(seen) == list(range(103))
+            assert len(s) == 26
+            per_rank.append(list(s))
+        counts = {len(lst) for lst in per_rank}
+        assert counts == {26}
+        seen = [i for lst in per_rank for i in lst]
+        assert set(seen) == set(range(103))
+        assert len(seen) == 104  # one wraparound pad
+
+    def test_drop_last_truncates_equally(self):
+        world = 4
+        per_rank = [
+            list(ElasticSampler(103, rank=r, world_size=world,
+                                shuffle=False, drop_last=True))
+            for r in range(world)
+        ]
+        assert {len(lst) for lst in per_rank} == {25}
+        seen = sorted(i for lst in per_rank for i in lst)
+        assert seen == list(range(100))
 
     def test_same_shuffle_on_all_ranks(self):
         orders = [
@@ -132,6 +151,60 @@ class TestShardingClient:
         assert sorted(records) == list(range(50))
         c0.close(), c1.close()
 
+    def test_transient_empty_does_not_end_epoch(self, master):
+        """A dead worker's in-flight shards must not be lost when another
+        worker polls while the todo queue is transiently empty: the client
+        keeps polling until the master reports *finished*."""
+        import threading
+
+        c0, c1 = make_client(master, 0), make_client(master, 1)
+        sc0 = ShardingClient("d5", dataset_size=20, shard_size=10, client=c0)
+        t0 = sc0.fetch_shard()
+        t1 = sc0.fetch_shard()
+        assert t0 is not None and t1 is not None
+        # todo is now empty but 2 shards are in doing. Worker 1 starts
+        # consuming BEFORE the failure is reported.
+        out, done = [], threading.Event()
+
+        def consume():
+            ic = IndexShardingClient("d5", dataset_size=20, shard_size=10,
+                                     client=c1)
+            while True:
+                i = ic.fetch_sample_index()
+                if i is None:
+                    break
+                out.append(i)
+            done.set()
+
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        time.sleep(1.0)  # worker 1 is polling an empty-but-unfinished queue
+        assert not done.is_set(), "epoch ended while shards were in-flight"
+        c0.report_failure("killed", level="node_error")
+        assert done.wait(15), "consumer never finished after recovery"
+        assert sorted(out) == list(range(20))
+        c0.close(), c1.close()
+
+    def test_stale_doing_task_reclaimed(self, master, monkeypatch):
+        """Liveness fallback: a shard abandoned without ack or failure
+        report is re-dispatched after the doing-timeout."""
+        from dlrover_tpu.master.shard.task_manager import DatasetManager
+
+        c0, c1 = make_client(master, 0), make_client(master, 1)
+        monkeypatch.setenv("DLROVER_TPU_SHARD_TIMEOUT", "0.5")
+        sc0 = ShardingClient("d6", dataset_size=10, shard_size=10, client=c0)
+        assert sc0.fetch_shard() is not None  # held, never acked
+        sc1 = ShardingClient("d6", dataset_size=10, shard_size=10, client=c1)
+        t = sc1.fetch_shard(retry_interval=0.2, max_wait=5.0)
+        assert t is not None, "stale shard was never reclaimed"
+        c0.close(), c1.close()
+
+    def test_unknown_dataset_finishes_immediately(self, master):
+        c = make_client(master, 0)
+        t = c.get_task("never-registered")
+        assert not t.exists and t.finished
+        c.close()
+
     def test_index_client_streams_all(self, master):
         c = make_client(master, 0)
         ic = IndexShardingClient("d3", dataset_size=25, shard_size=10,
@@ -191,6 +264,25 @@ class TestElasticDataLoader:
         assert len(batches) == 4
         flat = sorted(int(r[0]) for b in batches for r in b)
         assert flat == list(range(12))
+
+    def test_prefetch_early_break_no_thread_leak(self):
+        import threading
+
+        loader = ElasticDataLoader(
+            self._dataset(40), batch_size=2, prefetch=1
+        )
+        for b in loader:
+            break  # abandon mid-iteration
+        deadline = time.monotonic() + 6
+        while time.monotonic() < deadline:
+            leaked = [
+                t for t in threading.enumerate()
+                if t.name == "dataloader-prefetch" and t.is_alive()
+            ]
+            if not leaked:
+                break
+            time.sleep(0.1)
+        assert not leaked, "prefetch producer thread leaked after break"
 
     def test_dict_collate(self):
         ds = [{"x": np.ones(3) * i, "y": np.int32(i)} for i in range(6)]

@@ -98,6 +98,32 @@ class TestElasticRun:
             "agent crash flush never persisted the step-7 memory snapshot"
         )
 
+    def test_crash_restart_with_dataloader(self, tmp_path):
+        """Same goodput scenario driven through the elastic data layer:
+        the worker consumes master-dispatched shards via ElasticDataLoader;
+        the crash leaves a shard in `doing`; the agent's failure report
+        recovers it, and the restarted worker trains to completion (a
+        blocking fetch would hang here if recovery were broken)."""
+        job = f"e2e-{uuid.uuid4().hex[:6]}"
+        sentinel = str(tmp_path / "crash.sentinel")
+        ckpt_dir = str(tmp_path / "ckpts")
+        marker = str(tmp_path / "resumed_from.txt")
+        result = _run_cli(
+            [
+                "--standalone", "--nproc_per_node=1", f"--job_name={job}",
+                "--monitor_interval=0.2", "--max_restarts=2",
+                SCRIPT, "--",
+                "--steps", "12", "--use-dataloader", "--crash-at", "7",
+                "--crash-sentinel", sentinel,
+                "--ckpt-dir", ckpt_dir, "--persist-every", "10",
+                "--resume-marker", marker,
+            ],
+        )
+        assert result.returncode == 0, result.stderr[-2000:]
+        assert os.path.exists(sentinel), "crash was never injected"
+        with open(marker) as f:
+            assert int(f.read()) == 7
+
     def test_two_node_world(self, tmp_path):
         """Two agents rendezvous through one master; workers form a
         2-process JAX world via jax.distributed."""
