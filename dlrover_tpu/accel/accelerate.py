@@ -39,12 +39,6 @@ class ParallelSpec:
         for name in ("data", "fsdp", "tensor", "seq", "expert", "pipe"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} degree must be >= 1")
-        if self.pipe > 1:
-            # Do not silently waste devices on an axis nothing implements.
-            raise NotImplementedError(
-                "pipeline parallelism (pipe>1) is not implemented yet; "
-                "use data/fsdp/tensor/seq"
-            )
 
     @property
     def total(self) -> int:
@@ -104,6 +98,33 @@ def choose_spec(param_count: int, n_devices: int, hbm: float,
         if f >= need:
             return ParallelSpec(data=n_devices // f, fsdp=f)
     return ParallelSpec(fsdp=n_devices)
+
+
+def _check_spec_axes_used(spec, abstract_state):
+    """Refuse degrees the model can't use: a ``pipe``/``expert`` degree
+    with no parameter carrying the matching logical axis would silently
+    replicate over those devices (round-2 weak #7 — phantom axes)."""
+    import jax
+
+    # Boxed leaves (nn.Partitioned / nn.LogicallyPartitioned) carry the
+    # logical axis names in a `.names` tuple.
+    names = set()
+    for leaf in jax.tree_util.tree_leaves(
+        abstract_state, is_leaf=lambda x: hasattr(x, "names")
+    ):
+        if hasattr(leaf, "names"):
+            names.update(n for n in leaf.names if n)
+    for degree, logical in (
+        (spec.pipe, "stage"), (spec.expert, "expert")
+    ):
+        if degree > 1 and logical not in names:
+            raise ValueError(
+                f"ParallelSpec has {logical!r}-axis degree {degree} but no "
+                f"model parameter carries the {logical!r} logical axis — "
+                "those devices would be silently wasted. Configure the "
+                "model for it (e.g. GPTConfig.pipeline_stages / "
+                "num_experts) or drop the degree."
+            )
 
 
 def make_train_step(module, optimizer, loss, mesh, rules,
@@ -185,6 +206,7 @@ def auto_accelerate(
             }
 
         abstract = jax.eval_shape(init_fn, rng)
+        _check_spec_axes_used(sp, abstract)
         shardings = state_shardings(mesh, abstract, rules)
         batch_axes = dict(rules)["batch"]
         batch_sharding = NamedSharding(

@@ -143,6 +143,9 @@ class ShardTask:
     # AND doing empty, epochs done) — an empty answer with finished=False
     # means "retry: in-flight shards may yet be re-dispatched".
     finished: bool = False
+    # True when the master does not know the dataset (e.g. it restarted
+    # and lost registrations); clients should re-register and retry.
+    unknown: bool = False
 
     @property
     def exists(self) -> bool:

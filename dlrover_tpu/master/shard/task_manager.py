@@ -52,6 +52,15 @@ class DatasetManager:
         self._task_id = 0
         self._completed_tasks = 0
 
+    def _requeue(self, task: ShardTask):
+        """Re-dispatch under a FRESH task id: a late ack from the
+        original holder must not pop the new dispatchee's doing entry
+        (it finds no matching id and is ignored)."""
+        self.todo.appendleft(self._new_task(Shard(
+            name=task.shard_name, start=task.start, end=task.end,
+            record_indices=task.record_indices,
+        )))
+
     def _reclaim_stale(self):
         now = time.time()
         stale = [
@@ -64,7 +73,7 @@ class DatasetManager:
                 "shard task %s of worker %s timed out after %.0fs; "
                 "re-dispatching", tid, doing.worker_id, self.doing_timeout,
             )
-            self.todo.appendleft(doing.task)
+            self._requeue(doing.task)
 
     def _refill(self):
         self._reclaim_stale()
@@ -103,14 +112,14 @@ class DatasetManager:
         if success:
             self._completed_tasks += 1
         else:
-            self.todo.appendleft(doing.task)
+            self._requeue(doing.task)
         return True
 
     def recover_worker_tasks(self, worker_id: int) -> int:
         """Return a failed worker's in-flight shards to the todo queue."""
         stale = [tid for tid, d in self.doing.items() if d.worker_id == worker_id]
         for tid in stale:
-            self.todo.appendleft(self.doing.pop(tid).task)
+            self._requeue(self.doing.pop(tid).task)
         return len(stale)
 
     def completed(self) -> bool:
@@ -193,10 +202,10 @@ class TaskManager:
         with self._lock:
             ds = self._datasets.get(dataset_name)
             if ds is None:
-                # Unknown dataset: report finished so a polling client
-                # ends instead of spinning forever (clients re-register
-                # in their constructor after a master restart).
-                return ShardTask(finished=True)
+                # Unknown dataset (e.g. restarted master lost the
+                # registration): tell the client to re-register instead
+                # of ending its epoch with data still undispatched.
+                return ShardTask(unknown=True)
             self._worker_last_task[worker_id] = time.time()
             return ds.get_task(worker_id)
 

@@ -47,6 +47,25 @@ class GPTConfig:
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Pipeline parallelism (0 = off). With pipeline_stages > 1 the blocks
+    # are split into equal stages run as a GPipe schedule
+    # (dlrover_tpu.accel.pipeline); pair with ParallelSpec(pipe=stages).
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0  # 0 -> = pipeline_stages
+
+    def __post_init__(self):
+        if self.pipeline_stages > 1:
+            if self.num_layers % self.pipeline_stages:
+                raise ValueError(
+                    f"num_layers {self.num_layers} not divisible by "
+                    f"pipeline_stages {self.pipeline_stages}"
+                )
+            if self.num_experts > 0:
+                raise ValueError(
+                    "pipeline_stages and num_experts are mutually "
+                    "exclusive for now (MoE aux-loss aggregation through "
+                    "the pipeline schedule is not implemented)"
+                )
 
     @property
     def ff_dim(self) -> int:
@@ -184,6 +203,36 @@ class Block(nn.Module):
         return x, None
 
 
+class _GPTStage(nn.Module):
+    """One pipeline stage: ``num_layers / pipeline_stages`` blocks.
+    Used as the ``make_stage`` body of ``accel.pipeline.Pipeline``."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        per_stage = cfg.num_layers // cfg.pipeline_stages
+        block = Block
+        if cfg.remat:
+            block = nn.remat(
+                Block, prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=per_stage,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="blocks")(x)
+        else:
+            for i in range(per_stage):
+                x, _ = block(cfg, name=f"block_{i}")(x)
+        return x
+
+
 class GPT(nn.Module):
     """Decoder-only LM. ``__call__(tokens[B,S]) -> logits[B,S,V]``."""
 
@@ -211,6 +260,22 @@ class GPT(nn.Module):
         )
         x = embed(tokens) + pos_embed[None, :s].astype(cfg.dtype)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        if cfg.pipeline_stages > 1:
+            from dlrover_tpu.accel.pipeline import Pipeline
+
+            x = Pipeline(
+                make_stage=lambda: _GPTStage(cfg, name="stage"),
+                num_stages=cfg.pipeline_stages,
+                num_microbatches=cfg.pipeline_microbatches,
+                carry_axes=("batch", "seq", "embed"),
+                name="pipeline",
+            )(x)
+            x = _layernorm("ln_f", cfg)(x)
+            logits = embed.attend(x.astype(cfg.param_dtype))
+            return nn.with_logical_constraint(
+                logits, ("batch", "seq", "vocab")
+            )
 
         block = Block
         if cfg.remat:

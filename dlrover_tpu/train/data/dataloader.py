@@ -62,6 +62,11 @@ class ElasticDataLoader:
         self.batch_size = batch_size
         self.sampler = sampler
         self.sharding_client = sharding_client
+        if sharding_client is not None:
+            # Precise crash consistency: the loader reports records as the
+            # *consumer* takes batches, so shards straddling a batch or
+            # sitting in the prefetch queue stay re-dispatchable.
+            sharding_client.auto_ack = False
         self.collate_fn = collate_fn or _default_collate
         self.drop_last = drop_last
         self.prefetch = prefetch
@@ -101,36 +106,65 @@ class ElasticDataLoader:
         self.batch_size = int(batch_size)
 
     # ------------- iteration -------------
-    def _index_stream(self) -> Iterator[int]:
-        if self.sharding_client is not None:
+    _STALL = object()  # transient shard drought: flush, keep polling
+
+    def _index_stream(self, stop=None) -> Iterator[Any]:
+        sc = self.sharding_client
+        if sc is not None:
             while True:
-                idx = self.sharding_client.fetch_sample_index()
-                if idx is None:
+                # Short bounded waits so the batcher can flush (and
+                # thereby ack) a partial batch during a drought — a
+                # blocking wait here would deadlock on our own
+                # still-unreported records at the dataset tail.
+                idx = sc.fetch_sample_index(max_wait=0.2, stop=stop)
+                if idx is not None:
+                    yield idx
+                elif sc.dataset_finished or (stop is not None and stop()):
                     return
-                yield idx
+                else:
+                    yield self._STALL
         elif self.sampler is not None:
             yield from iter(self.sampler)
         else:
             yield from range(len(self.dataset))
 
-    def _batches(self) -> Iterator[Any]:
-        # Config reload happens at batch boundaries, not per sample: the
-        # tuned batch size changes rarely and a stat+parse per record
-        # would sit on the input hot path.
+    def _batches(self, stop=None) -> Iterator[Any]:
+        """Yield ``(collated_batch, record_count)``.
+
+        Config reload happens at batch boundaries, not per sample: the
+        tuned batch size changes rarely and a stat+parse per record would
+        sit on the input hot path. With a sharding client, a shard
+        drought flushes the partial batch (undersized batches at stall /
+        tail boundaries are inherent to elastic input).
+        """
         batch = []
         self.load_config()
-        for idx in self._index_stream():
+        for idx in self._index_stream(stop):
+            if idx is self._STALL:
+                if batch:
+                    yield self.collate_fn(batch), len(batch)
+                    batch = []
+                    self.load_config()
+                continue
             batch.append(self.dataset[idx])
             if len(batch) >= self.batch_size:
-                yield self.collate_fn(batch)
+                yield self.collate_fn(batch), len(batch)
                 batch = []
                 self.load_config()
         if batch and not self.drop_last:
-            yield self.collate_fn(batch)
+            yield self.collate_fn(batch), len(batch)
+
+    def _report(self, n: int):
+        if self.sharding_client is not None:
+            self.sharding_client.report_records(n)
 
     def __iter__(self) -> Iterator[Any]:
         if self.prefetch <= 0:
-            yield from self._batches()
+            for b, n in self._batches():
+                yield b
+                # Reached when the consumer comes back for the next
+                # batch: the records of b are now trained, ack them.
+                self._report(n)
             return
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         _END = object()
@@ -151,8 +185,8 @@ class ElasticDataLoader:
 
         def producer():
             try:
-                for b in self._batches():
-                    if not put_until_stop(b):
+                for item in self._batches(stop=stop.is_set):
+                    if not put_until_stop(item):
                         return
             except BaseException as e:  # surface in the consumer
                 err.append(e)
@@ -166,7 +200,9 @@ class ElasticDataLoader:
                 item = q.get()
                 if item is _END:
                     break
-                yield item
+                b, n = item
+                yield b
+                self._report(n)  # consumed by the training loop
         finally:
             stop.set()
             while not q.empty():  # unblock a producer mid-put

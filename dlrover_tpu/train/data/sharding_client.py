@@ -4,15 +4,23 @@ Parity: reference ``dlrover/python/elastic_agent/sharding/client.py:31``
 (``ShardingClient``: register dataset, fetch/report shards) and ``:233``
 (``IndexShardingClient``: a per-sample index stream on top of shards).
 The master's TaskManager owns todo/doing bookkeeping and re-dispatches the
-in-flight shards of a failed worker (``master/shard/task_manager.py``), so
-a worker that crashes mid-shard never loses records and a record is
-consumed exactly once per epoch across the fleet.
+in-flight shards of failed/stalled workers (``master/shard/task_manager.py``).
+
+Delivery semantics: every record is consumed **at least once** per epoch —
+exactly once while workers stay healthy; after a crash or a doing-timeout
+the affected shard is re-dispatched whole, so records consumed past the
+last acked shard are trained again (the reference's recovery granularity,
+``batch_dataset_manager.py``). A shard is acked only when its records were
+*reported consumed* (``report_records``, driven by the dataloader after
+the training loop took the batch), not when its indices were merely read —
+records sitting in a half-assembled batch or a prefetch queue are still
+covered by re-dispatch.
 """
 
 import threading
 import time
 from collections import deque
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Tuple
 
 from dlrover_tpu.agent.master_client import MasterClient, build_master_client
 from dlrover_tpu.common.log import logger
@@ -24,9 +32,13 @@ class ShardingClient:
 
     The flow (reference ``sharding/client.py`` semantics):
 
-    - first caller registers the dataset (idempotent on the master);
-    - ``fetch_shard()`` pulls the next shard or None when the epoch is
-      exhausted;
+    - first caller registers the dataset (idempotent on the master; the
+      client re-registers automatically if a restarted master answers
+      ``unknown``);
+    - ``fetch_shard()`` pulls the next shard — None means the dataset is
+      exhausted *for now* (``max_wait`` bounds how long to wait for
+      in-flight shards of other workers to complete or be re-dispatched;
+      ``dataset_finished`` tells the two ends apart);
     - ``report_batch_done()`` acks the *oldest* outstanding shard — an
       unacked shard is re-dispatched by the master if this worker dies.
     """
@@ -47,7 +59,8 @@ class ShardingClient:
         self._lock = threading.Lock()
         self._fetched = 0
         self._reported = 0
-        self._client.report_dataset_shard_params(
+        self._finished = False
+        self._register_params = dict(
             dataset_name=dataset_name,
             dataset_size=dataset_size,
             shard_size=shard_size,
@@ -55,18 +68,30 @@ class ShardingClient:
             shuffle=shuffle,
             storage_type=storage_type,
         )
+        self._register()
 
-    def fetch_shard(self, retry_interval: float = 0.2,
-                    max_wait: Optional[float] = None) -> Optional[ShardTask]:
-        """Next shard, or None when the dataset is finished.
+    def _register(self):
+        self._client.report_dataset_shard_params(**self._register_params)
+
+    @property
+    def dataset_finished(self) -> bool:
+        """True once the master reported the dataset fully consumed."""
+        return self._finished
+
+    def fetch_shard(
+        self,
+        retry_interval: float = 0.2,
+        max_wait: Optional[float] = None,
+        stop: Optional[Callable[[], bool]] = None,
+    ) -> Optional[ShardTask]:
+        """Next shard, or None when none is available.
 
         An empty answer with ``finished=False`` means shards are still
-        in-flight on other workers and may be re-dispatched if they fail —
-        by default this retries until the master reports the dataset
-        *finished* (todo and doing both empty), which is what makes the
-        fleet-wide exactly-once guarantee hold without racing failure
-        detection. ``max_wait`` bounds the retry window (0 = return
-        immediately on an empty answer).
+        in-flight on other workers and may be re-dispatched if they fail;
+        ``max_wait=None`` (default) retries until the master reports the
+        dataset *finished*, ``max_wait=0`` returns immediately, anything
+        else bounds the wait. ``stop`` is polled between retries so an
+        owner (e.g. an abandoned dataloader thread) can bail out.
         """
         deadline = (
             None if max_wait is None else time.monotonic() + max_wait
@@ -78,7 +103,18 @@ class ShardingClient:
                     self._pending.append(task.task_id)
                     self._fetched += 1
                 return task
-            if task.finished:
+            if task.unknown:
+                # Restarted master lost the registration; re-register and
+                # retry (counts against the deadline like any retry).
+                logger.info(
+                    "dataset %s unknown to master; re-registering",
+                    self.dataset_name,
+                )
+                self._register()
+            elif task.finished:
+                self._finished = True
+                return None
+            if stop is not None and stop():
                 return None
             if deadline is not None and time.monotonic() >= deadline:
                 return None
@@ -117,48 +153,81 @@ class IndexShardingClient(ShardingClient):
     """Per-sample index stream (reference ``sharding/client.py:233``).
 
     ``fetch_sample_index()`` hands out one record index at a time, fetching
-    a new shard under the hood and acking the previous shard once all its
-    indices were consumed — the dataloader never sees shard boundaries.
+    new shards under the hood — the dataloader never sees shard boundaries.
+
+    Ack modes:
+
+    - ``auto_ack=True`` (default): a shard is acked as soon as all its
+      indices were *read*. Simple, but a crash loses the records read
+      ahead of actual consumption (up to one shard).
+    - ``auto_ack=False``: the consumer calls ``report_records(n)`` after
+      *training* on n records; shards are acked oldest-first once every
+      record was reported. ``ElasticDataLoader`` uses this mode so batches
+      in flight (straddling shards, prefetch queues) stay re-dispatchable.
     """
 
-    def __init__(self, *args, **kwargs):
+    def __init__(self, *args, auto_ack: bool = True, **kwargs):
         super().__init__(*args, **kwargs)
+        self.auto_ack = auto_ack
         self._indices: deque = deque()
         self._current_task: Optional[ShardTask] = None
+        # manual-ack bookkeeping: (task_id, record_count) in fetch order
+        self._task_counts: deque = deque()
+        self._unreported = 0
 
-    def fetch_sample_index(self) -> Optional[int]:
+    def fetch_sample_index(
+        self,
+        max_wait: Optional[float] = None,
+        stop: Optional[Callable[[], bool]] = None,
+    ) -> Optional[int]:
+        """Next record index, or None when no index is available within
+        ``max_wait`` (``dataset_finished`` distinguishes exhaustion from a
+        transient stall)."""
         if not self._indices:
-            if not self._advance_shard():
+            if not self._advance_shard(max_wait=max_wait, stop=stop):
                 return None
         return self._indices.popleft()
 
-    def _advance_shard(self) -> bool:
-        # Ack the fully-consumed previous shard BEFORE fetching the next:
-        # crash between shards then re-dispatches only unconsumed data.
-        if self._current_task is not None:
+    def _advance_shard(self, max_wait=None, stop=None) -> bool:
+        if self.auto_ack and self._current_task is not None:
+            # Ack the fully-read previous shard BEFORE fetching the next.
             self.report_batch_done(self._current_task.task_id)
             self._current_task = None
-        task = self.fetch_shard()
+        task = self.fetch_shard(max_wait=max_wait, stop=stop)
         if task is None:
             return False
         self._current_task = task
-        indices = (
+        indices: List[int] = list(
             task.record_indices
             if task.record_indices
             else range(task.start, task.end)
         )
+        if not self.auto_ack:
+            with self._lock:
+                self._task_counts.append((task.task_id, len(indices)))
         self._indices.extend(indices)
         return True
 
-    def flush(self):
-        """Ack the current shard if it is fully drained.
-
-        Call before ``get_shard_checkpoint`` so a consumed shard is not
-        checkpointed as in-flight (and re-dispatched on restore). A
-        *partially*-consumed shard stays in the master's ``doing`` set on
-        purpose: re-dispatch granularity is the shard, so records consumed
-        past the last completed shard are trained again after a failure
-        (at-least-once, matching the reference's recovery semantics)."""
-        if self._current_task is not None and not self._indices:
-            self.report_batch_done(self._current_task.task_id)
-            self._current_task = None
+    def report_records(self, n: int):
+        """Report n records consumed by the trainer (manual-ack mode);
+        acks every shard whose records are now fully consumed. Safe to
+        call from a different thread than the fetching one."""
+        if self.auto_ack or n <= 0:
+            return
+        to_ack: List[int] = []
+        with self._lock:
+            self._unreported += n
+            while (
+                self._task_counts
+                and self._unreported >= self._task_counts[0][1]
+            ):
+                tid, cnt = self._task_counts.popleft()
+                self._unreported -= cnt
+                to_ack.append(tid)
+        for tid in to_ack:
+            self.report_batch_done(tid)
+            if (
+                self._current_task is not None
+                and self._current_task.task_id == tid
+            ):
+                self._current_task = None

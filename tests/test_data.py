@@ -199,10 +199,27 @@ class TestShardingClient:
         assert t is not None, "stale shard was never reclaimed"
         c0.close(), c1.close()
 
-    def test_unknown_dataset_finishes_immediately(self, master):
+    def test_unknown_dataset_flagged(self, master):
         c = make_client(master, 0)
         t = c.get_task("never-registered")
-        assert not t.exists and t.finished
+        assert not t.exists and t.unknown and not t.finished
+        c.close()
+
+    def test_reregister_after_master_lost_dataset(self, master):
+        """A master that lost its registrations (restart) answers
+        `unknown`; the client re-registers and streams the dataset."""
+        c = make_client(master, 0)
+        sc = ShardingClient("d8", dataset_size=10, shard_size=5, client=c)
+        # Simulate the master losing state.
+        master.task_manager._datasets.clear()
+        spans = []
+        while True:
+            t = sc.fetch_shard()
+            if t is None:
+                break
+            spans.append((t.start, t.end))
+            sc.report_batch_done()
+        assert sorted(spans) == [(0, 5), (5, 10)]
         c.close()
 
     def test_index_client_streams_all(self, master):
@@ -264,6 +281,35 @@ class TestElasticDataLoader:
         assert len(batches) == 4
         flat = sorted(int(r[0]) for b in batches for r in b)
         assert flat == list(range(12))
+
+    def test_abandoned_batches_redispatched(self, master, monkeypatch):
+        """Crash consistency: batches handed to a consumer that never
+        trains on them (no report) are re-dispatched — a record is lost
+        only if its shard was acked, and acks now track *consumption*."""
+        monkeypatch.setenv("DLROVER_TPU_SHARD_TIMEOUT", "0.5")
+        c0, c1 = make_client(master, 0), make_client(master, 1)
+        ic0 = IndexShardingClient("d9", dataset_size=20, shard_size=4,
+                                  client=c0)
+        loader0 = ElasticDataLoader(
+            self._dataset(20), batch_size=4, sharding_client=ic0
+        )
+        first = None
+        for b in loader0:
+            first = {int(r[0]) for r in b}
+            break  # "crash" before training and before the next fetch
+        assert first is not None
+        # Worker 1 picks up everything, including the abandoned shard
+        # (after the doing-timeout reclaim).
+        ic1 = IndexShardingClient("d9", dataset_size=20, shard_size=4,
+                                  client=c1)
+        loader1 = ElasticDataLoader(
+            self._dataset(20), batch_size=4, sharding_client=ic1
+        )
+        seen = [int(r[0]) for b in loader1 for r in b]
+        assert set(seen) == set(range(20)), (
+            "abandoned batch was acked without being consumed"
+        )
+        c0.close(), c1.close()
 
     def test_prefetch_early_break_no_thread_leak(self):
         import threading
