@@ -156,7 +156,26 @@ class ElasticTrainingAgent:
                 logger.exception("flash-checkpoint crash flush failed")
 
     # ---------------- run loop ----------------
+    def _start_heartbeats(self):
+        """Agent-level liveness, independent of worker state: covers the
+        stop-workers/re-rendezvous gaps so the master's heartbeat monitor
+        never mistakes a restarting agent for a dead one."""
+        import threading
+
+        def beat():
+            while not self._stopped:
+                try:
+                    self._client.report_heartbeat()
+                except Exception:
+                    pass
+                time.sleep(self._config.monitor_interval)
+
+        threading.Thread(
+            target=beat, daemon=True, name="agent-heartbeat"
+        ).start()
+
     def run(self) -> int:
+        self._start_heartbeats()
         self._client.report_rdzv_params(
             self._config.min_nodes,
             self._config.max_nodes,
@@ -177,7 +196,7 @@ class ElasticTrainingAgent:
         while self._restart_count <= self._config.max_restarts:
             outcome = self._rendezvous()
             self._start_workers(outcome)
-            result = self._monitor_workers()
+            result = self._monitor_workers(outcome)
             self._stop_workers()
             if result == "succeeded":
                 self._client.report_node_status(NodeStatus.SUCCEEDED)
@@ -252,7 +271,7 @@ class ElasticTrainingAgent:
         self._client.report_node_status(NodeStatus.RUNNING)
         logger.info("started %s worker processes", len(self._workers))
 
-    def _monitor_workers(self) -> str:
+    def _monitor_workers(self, outcome: RendezvousOutcome) -> str:
         while not self._stopped:
             time.sleep(self._config.monitor_interval)
             codes = [p.poll() for p in self._workers]
@@ -271,11 +290,22 @@ class ElasticTrainingAgent:
             if all(c == 0 for c in codes):
                 return "succeeded"
             try:
-                self._client.report_heartbeat()
                 waiting = self._client.num_nodes_waiting(RendezvousName.TRAINING)
+                stale = self._client.world_stale(
+                    RendezvousName.TRAINING, outcome.round
+                )
             except Exception as e:
                 logger.warning("master unreachable from monitor loop: %s", e)
                 continue
+            if stale:
+                # A world member died (heartbeat/hang): flush the shm
+                # checkpoint and re-form without it.
+                logger.info(
+                    "round %s invalidated by a member death; re-forming",
+                    outcome.round,
+                )
+                self._save_shm_to_storage()
+                return "membership_changed"
             if waiting > 0:
                 self._save_shm_to_storage()
                 return "membership_changed"

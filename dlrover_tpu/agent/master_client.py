@@ -73,6 +73,13 @@ class MasterClient:
     def num_nodes_waiting(self, rdzv_name: str) -> int:
         return self._call(m.WaitingNodeNumRequest(rdzv_name=rdzv_name))
 
+    def world_stale(self, rdzv_name: str, round_: int) -> bool:
+        """True when the agent's current round was invalidated by a
+        member death and survivors must re-form."""
+        return bool(self._call(
+            m.WorldStatusRequest(rdzv_name=rdzv_name, round=round_)
+        ))
+
     def report_rdzv_params(self, min_nodes: int, max_nodes: int,
                            waiting_timeout: float, node_unit: int):
         return self._call(

@@ -22,6 +22,12 @@ class Context(Singleton):
         self.hang_detection_seconds = _env_float(
             "DLROVER_TPU_HANG_DETECTION_SECS", 1800.0
         )
+        self.heartbeat_timeout = _env_float(
+            "DLROVER_TPU_HEARTBEAT_TIMEOUT", 60.0
+        )
+        self.node_monitor_interval = _env_float(
+            "DLROVER_TPU_NODE_MONITOR_INTERVAL", 2.0
+        )
         self.relaunch_always = False
         self.max_relaunch_count = 3
         self.rdzv_waiting_timeout = 30.0

@@ -48,6 +48,15 @@ class WaitingNodeNumRequest(BaseRequest):
 
 
 @dataclass
+class WorldStatusRequest(BaseRequest):
+    """Is the round this agent is running still the live world?  Stale
+    means a member died (heartbeat/hang) and survivors must re-form."""
+
+    rdzv_name: str = ""
+    round: int = 0
+
+
+@dataclass
 class RendezvousParams(BaseRequest):
     min_nodes: int = 1
     max_nodes: int = 1

@@ -10,7 +10,7 @@ import threading
 import time
 from typing import Optional
 
-from dlrover_tpu.common.constants import JobStage, RendezvousName
+from dlrover_tpu.common.constants import JobStage, NodeStatus, RendezvousName
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.kv_store import KVStoreService
@@ -36,7 +36,9 @@ class JobMaster:
         ctx = get_context()
         self.job_name = job_name
         self.speed_monitor = SpeedMonitor(hang_seconds=ctx.hang_detection_seconds)
-        self.job_manager = job_manager or LocalJobManager(node_num=node_num)
+        self.job_manager = job_manager or LocalJobManager(
+            node_num=node_num, heartbeat_timeout=ctx.heartbeat_timeout
+        )
         self.task_manager = TaskManager(self.speed_monitor)
         self.rdzv_managers = {
             RendezvousName.TRAINING: ElasticTrainingRendezvousManager(
@@ -65,6 +67,8 @@ class JobMaster:
         self.port = self._server.port
         self.stage = JobStage.INIT
         self._stopped = threading.Event()
+        self._abort_reason: Optional[str] = None
+        self._monitor_thread: Optional[threading.Thread] = None
 
     @property
     def addr(self) -> str:
@@ -73,7 +77,57 @@ class JobMaster:
     def prepare(self):
         self._server.start()
         self.stage = JobStage.RUNNING
+        self._monitor_thread = threading.Thread(
+            target=self._node_monitor_loop, daemon=True,
+            name="node-monitor",
+        )
+        self._monitor_thread.start()
         logger.info("master %s serving on port %s", self.job_name, self.port)
+
+    # ------------- failure detection -------------
+    def _node_monitor_loop(self):
+        """Failure detection (parity: reference
+        ``master/node/dist_job_manager.py:401-533``, condensed):
+
+        - *Node death* (stale heartbeat — the agent itself is gone):
+          evict the node (scale-in; the local platform has no scheduler
+          to relaunch into) so survivors re-form a smaller world.
+        - *Training hang* (agents heartbeat but step progress stopped):
+          synchronous SPMD stalls ALL nodes at once, so eviction would
+          kill the whole job; instead invalidate the round — every agent
+          flushes its shm checkpoint, restarts its workers and
+          re-rendezvouses (restart-in-place recovery).
+        """
+        interval = get_context().node_monitor_interval
+        while not self._stopped.wait(interval):
+            try:
+                for node_id in self.job_manager.find_dead_nodes():
+                    self._evict_node(node_id, "heartbeat timeout")
+                if self.speed_monitor.worker_hang():
+                    logger.error(
+                        "training hang: no step progress for %.0fs; "
+                        "invalidating the round so agents restart",
+                        self.speed_monitor.hang_seconds,
+                    )
+                    for mgr in self.rdzv_managers.values():
+                        mgr.invalidate_round()
+                    # Restarted workers report steps again; clearing the
+                    # stale report times re-arms detection instead of
+                    # re-firing every pass.
+                    self.speed_monitor.reset_worker_reports()
+                if not self.job_manager.all_nodes():
+                    self._abort_reason = "all nodes lost"
+                    return
+            except Exception:
+                logger.exception("node monitor iteration failed")
+
+    def _evict_node(self, node_id: int, reason: str):
+        logger.error("evicting node %s: %s", node_id, reason)
+        self.job_manager.remove_node(node_id, reason)
+        for mgr in self.rdzv_managers.values():
+            mgr.remove_alive_node(node_id)
+        self.task_manager.recover_worker_tasks(node_id)
+        self.speed_monitor.remove_worker(node_id)
 
     def run(self, poll_interval: float = 1.0) -> int:
         """Block until the job finishes; returns an exit code."""
@@ -85,6 +139,10 @@ class JobMaster:
                     self.stage = (
                         JobStage.SUCCEEDED if exit_req.success else JobStage.FAILED
                     )
+                    break
+                if self._abort_reason:
+                    logger.error("aborting job: %s", self._abort_reason)
+                    self.stage = JobStage.FAILED
                     break
                 if self.job_manager.all_workers_exited():
                     self.stage = (

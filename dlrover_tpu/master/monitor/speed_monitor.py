@@ -54,6 +54,15 @@ class SpeedMonitor:
             return 0.0
         return (now[0] - window_start[0]) / (now[1] - window_start[1])
 
+    @property
+    def hang_seconds(self) -> float:
+        return self._hang_seconds
+
+    def reset_worker_reports(self):
+        """Re-arm hang detection after a recovery (stale report times
+        would otherwise re-fire on every monitor pass)."""
+        self._worker_last_report.clear()
+
     def worker_hang(self, worker_id: Optional[int] = None) -> bool:
         """True when no step progress has been reported for hang_seconds."""
         now = time.time()

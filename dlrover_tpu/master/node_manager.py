@@ -197,6 +197,21 @@ class JobManager:
                     dead.append(node.id)
         return dead
 
+    def remove_node(self, node_id: int, reason: str = "") -> bool:
+        """Scale-in a permanently-lost node: it stops counting toward
+        all_workers_exited/succeeded so survivors can finish the job.
+        (The local platform has no scheduler to bring it back; a node
+        that does come back re-registers via its next status report.)"""
+        with self._lock:
+            node = self._nodes.pop(node_id, None)
+        if node is None:
+            return False
+        logger.warning(
+            "removed node %s from the job (%s); %s nodes remain",
+            node_id, reason or "permanent loss", len(self._nodes),
+        )
+        return True
+
     def stop(self):
         self._stopped = True
 

@@ -41,6 +41,9 @@ class RendezvousManager(ABC):
         self._lastcall_time = 0.0
         self._alive_nodes: Set[int] = set()
         self._start_rdzv_time = 0.0
+        # Rounds <= this are invalidated (a member died); survivors must
+        # re-rendezvous.
+        self._stale_round = 0
 
     # ---------------- configuration ----------------
     def update_rdzv_params(
@@ -67,11 +70,31 @@ class RendezvousManager(ABC):
             if node_rank in self._waiting_nodes:
                 del self._waiting_nodes[node_rank]
             if node_rank in self._rdzv_nodes:
-                # A member of the active world died: the next join starts a
-                # fresh round and agents observe num_nodes_waiting > 0.
+                # A member of the active world died: invalidate the round
+                # so surviving agents (polling world_stale) restart their
+                # workers and re-form without the dead node.
+                del self._rdzv_nodes[node_rank]
+                self._stale_round = self._rdzv_round
                 logger.info(
-                    "rdzv %s: node %s left active world of round %s",
+                    "rdzv %s: node %s left active world; round %s is now "
+                    "stale, survivors must re-form",
                     self.name, node_rank, self._rdzv_round,
+                )
+
+    def world_stale(self, round_: int) -> bool:
+        """True when the given round was invalidated by a member death."""
+        with self._lock:
+            return round_ <= self._stale_round
+
+    def invalidate_round(self):
+        """Invalidate the current round without evicting anyone (hang
+        recovery: every member flushes, restarts and rejoins)."""
+        with self._lock:
+            if self._rdzv_nodes:
+                self._stale_round = self._rdzv_round
+                logger.info(
+                    "rdzv %s: round %s invalidated; members must re-form",
+                    self.name, self._rdzv_round,
                 )
 
     def join_rendezvous(

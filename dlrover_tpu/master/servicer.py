@@ -62,6 +62,9 @@ class MasterServicer:
     def _num_nodes_waiting(self, req: m.WaitingNodeNumRequest):
         return self._rdzv_managers[req.rdzv_name].num_nodes_waiting()
 
+    def _world_status(self, req: m.WorldStatusRequest):
+        return self._rdzv_managers[req.rdzv_name].world_stale(req.round)
+
     def _update_rdzv_params(self, req: m.RendezvousParams):
         for mgr in self._rdzv_managers.values():
             mgr.update_rdzv_params(
@@ -216,6 +219,7 @@ MasterServicer._HANDLERS = {
     m.JoinRendezvous: MasterServicer._join_rendezvous,
     m.CommWorldRequest: MasterServicer._get_comm_world,
     m.WaitingNodeNumRequest: MasterServicer._num_nodes_waiting,
+    m.WorldStatusRequest: MasterServicer._world_status,
     m.RendezvousParams: MasterServicer._update_rdzv_params,
     m.DeviceCheckResult: MasterServicer._report_check_result,
     m.FaultNodesRequest: MasterServicer._get_fault_nodes,

@@ -12,6 +12,7 @@ rides the master kv-store instead of a gloo process group.
 from dlrover_tpu.train.checkpoint.checkpointer import (  # noqa: F401
     Checkpointer,
     FlashCheckpointer,
+    ShardedCheckpointer,
     StorageType,
 )
 from dlrover_tpu.train.checkpoint.engine import CheckpointEngine  # noqa: F401

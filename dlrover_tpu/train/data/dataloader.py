@@ -63,6 +63,15 @@ class ElasticDataLoader:
         self.sampler = sampler
         self.sharding_client = sharding_client
         if sharding_client is not None:
+            if (
+                sharding_client._indices
+                or sharding_client._current_task is not None
+            ):
+                raise ValueError(
+                    "sharding client is already mid-shard; construct the "
+                    "loader before consuming indices from the client "
+                    "(mixing ack modes would mis-attribute record acks)"
+                )
             # Precise crash consistency: the loader reports records as the
             # *consumer* takes batches, so shards straddling a batch or
             # sitting in the prefetch queue stay re-dispatchable.
@@ -142,7 +151,15 @@ class ElasticDataLoader:
         for idx in self._index_stream(stop):
             if idx is self._STALL:
                 if batch:
-                    yield self.collate_fn(batch), len(batch)
+                    if self.drop_last:
+                        # drop_last guarantees uniform batch shapes (a
+                        # jitted step's contract): discard the partial
+                        # batch but ack its records so the dataset can
+                        # still finish (they are dropped deliberately,
+                        # like an epoch tail).
+                        self._report(len(batch))
+                    else:
+                        yield self.collate_fn(batch), len(batch)
                     batch = []
                     self.load_config()
                 continue

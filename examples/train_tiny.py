@@ -18,7 +18,11 @@ import optax
 
 from dlrover_tpu import train as dtrain
 from dlrover_tpu.agent.master_client import MasterClient
-from dlrover_tpu.train.checkpoint import FlashCheckpointer, StorageType
+from dlrover_tpu.train.checkpoint import (
+    FlashCheckpointer,
+    ShardedCheckpointer,
+    StorageType,
+)
 
 
 def main():
@@ -32,6 +36,11 @@ def main():
     parser.add_argument("--resume-marker", type=str, default="",
                         help="file to record the step resumed from")
     parser.add_argument("--expect-world", type=int, default=0)
+    parser.add_argument("--step-sleep", type=float, default=0.0,
+                        help="sleep per step (lets tests kill mid-run)")
+    parser.add_argument("--lockstep", action="store_true",
+                        help="barrier across processes every step (models "
+                        "real synchronous SPMD training: nobody runs ahead)")
     parser.add_argument("--use-dataloader", action="store_true",
                         help="consume master-dispatched shards through "
                         "ElasticDataLoader instead of full-batch steps")
@@ -122,7 +131,13 @@ def main():
     ckpt = None
     start = 0
     if args.ckpt_dir:
-        ckpt = FlashCheckpointer(args.ckpt_dir)
+        # Multi-process worlds store one shard per process (the commit
+        # needs every node's done-file under one tracker); single-process
+        # uses the replicated-state DDP-style checkpointer.
+        if jax.process_count() > 1:
+            ckpt = ShardedCheckpointer(args.ckpt_dir)
+        else:
+            ckpt = FlashCheckpointer(args.ckpt_dir)
         last_step, state = ckpt.load_checkpoint(state)
         start = max(0, last_step)
         if args.resume_marker and start > 0:
@@ -134,6 +149,15 @@ def main():
 
     batches = batch_stream()
     for step in range(start, args.steps):
+        if args.lockstep and jax.process_count() > 1:
+            # Real SPMD training advances in lockstep (every step ends in
+            # a gradient collective); emulate that so a crashed peer
+            # stalls this process at the same step instead of letting it
+            # run ahead — which is what makes multi-node crash flushes
+            # land a *consistent* step.
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"step-{step}")
         if (
             args.crash_at >= 0
             and step == args.crash_at
@@ -143,7 +167,10 @@ def main():
             with open(args.crash_sentinel, "w") as f:
                 f.write("crashed")
             print(f"rank {rank}: injected crash at step {step}", flush=True)
-            sys.exit(1)
+            # A real crash runs no graceful shutdown: os._exit skips the
+            # jax.distributed atexit barrier, which would otherwise
+            # deadlock against peers blocked in a training collective.
+            os._exit(1)
         try:
             bx, by = next(batches)
         except StopIteration:
@@ -151,6 +178,8 @@ def main():
                   flush=True)
             break
         state, loss = step_fn(state, bx, by)
+        if args.step_sleep:
+            time.sleep(args.step_sleep)
         if ckpt is not None:
             if args.persist_every and (step + 1) % args.persist_every == 0:
                 ckpt.save_checkpoint(step + 1, state, StorageType.DISK)

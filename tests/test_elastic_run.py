@@ -124,6 +124,90 @@ class TestElasticRun:
         with open(marker) as f:
             assert int(f.read()) == 7
 
+    def test_permanent_node_loss_survivor_reforms(self, tmp_path):
+        """Kill one of two agents (and its worker) with NO failure report:
+        the master's heartbeat monitor evicts the node, invalidates the
+        round, and the survivor re-forms a 1-node world from the flash
+        checkpoint and finishes the job."""
+        import signal
+        import subprocess as sp
+
+        job = f"e2e-{uuid.uuid4().hex[:6]}"
+        port_file = str(tmp_path / "port")
+        ckpt_dir = str(tmp_path / "ckpts")
+        marker = str(tmp_path / "resumed.txt")
+        env = _env({
+            "DLROVER_TPU_HEARTBEAT_TIMEOUT": "2",
+            "DLROVER_TPU_NODE_MONITOR_INTERVAL": "0.3",
+        })
+        master = subprocess.Popen(
+            [
+                sys.executable, "-m", "dlrover_tpu.master.main",
+                "--node_num", "2", "--job_name", job,
+                "--port_file", port_file,
+            ],
+            env=env,
+        )
+        agents = []
+        try:
+            deadline = time.monotonic() + 30
+            while not os.path.exists(port_file):
+                assert time.monotonic() < deadline, "master never started"
+                time.sleep(0.05)
+            with open(port_file) as f:
+                addr = f"127.0.0.1:{f.read().strip()}"
+
+            for rank in range(2):
+                agents.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable, "-m", "dlrover_tpu.cli",
+                            "--nnodes=1:2", "--nproc_per_node=1",
+                            f"--node_rank={rank}", f"--master_addr={addr}",
+                            f"--job_name={job}", "--monitor_interval=0.2",
+                            "--waiting_timeout=2", "--max_restarts=3",
+                            SCRIPT, "--", "--steps", "40",
+                            "--step-sleep", "0.25",
+                            "--ckpt-dir", ckpt_dir, "--persist-every", "50",
+                            "--resume-marker", marker,
+                        ],
+                        env=_env(), stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT, text=True,
+                    )
+                )
+            # Let the 2-node world train for a bit, then hard-kill agent 1
+            # and its worker children (simulated host loss — no report).
+            time.sleep(12)
+            victim = agents[1]
+            kids = sp.run(
+                ["pgrep", "-P", str(victim.pid)], capture_output=True,
+                text=True,
+            ).stdout.split()
+            victim.kill()
+            for pid in kids:
+                try:
+                    os.kill(int(pid), signal.SIGKILL)
+                except (ProcessLookupError, ValueError):
+                    pass
+            out, _ = agents[0].communicate(timeout=240)
+            assert agents[0].returncode == 0, out[-4000:]
+            assert "re-forming" in out or "membership changed" in out, (
+                out[-4000:]
+            )
+            assert os.path.exists(marker), (
+                "survivor never resumed from the flash checkpoint\n"
+                + out[-4000:]
+            )
+            master.wait(timeout=30)
+            assert master.returncode == 0, "master did not exit success"
+        finally:
+            for a in agents:
+                if a.poll() is None:
+                    a.kill()
+            if master.poll() is None:
+                master.terminate()
+                master.wait(timeout=10)
+
     def test_two_node_world(self, tmp_path):
         """Two agents rendezvous through one master; workers form a
         2-process JAX world via jax.distributed."""
@@ -165,5 +249,86 @@ class TestElasticRun:
                 out, _ = a.communicate(timeout=180)
                 assert a.returncode == 0, out[-3000:]
         finally:
+            master.terminate()
+            master.wait(timeout=10)
+
+    def test_two_node_flash_checkpoint_crash(self, tmp_path):
+        """Multi-node flash checkpoint: both nodes snapshot to their shm
+        every step; a crash on node 0 flushes, both agents restart their
+        workers, and BOTH resume from the same flushed step (the
+        step-consistency vote across nodes picks it). The step-7 dir must
+        hold done-files/shards from both nodes under one tracker."""
+        job = f"e2e-{uuid.uuid4().hex[:6]}"
+        port_file = str(tmp_path / "port")
+        ckpt_dir = str(tmp_path / "ckpts")
+        sentinel = str(tmp_path / "crash.sentinel")
+        markers = [str(tmp_path / f"resumed{r}.txt") for r in range(2)]
+        master = subprocess.Popen(
+            [
+                sys.executable, "-m", "dlrover_tpu.master.main",
+                "--node_num", "2", "--job_name", job,
+                "--port_file", port_file,
+            ],
+            env=_env(),
+        )
+        try:
+            deadline = time.monotonic() + 30
+            while not os.path.exists(port_file):
+                assert time.monotonic() < deadline, "master never started"
+                time.sleep(0.05)
+            with open(port_file) as f:
+                addr = f"127.0.0.1:{f.read().strip()}"
+
+            agents = []
+            for rank in range(2):
+                crash_args = (
+                    ["--crash-at", "7", "--crash-sentinel", sentinel]
+                    if rank == 0 else []
+                )
+                agents.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable, "-m", "dlrover_tpu.cli",
+                            "--nnodes=2", "--nproc_per_node=1",
+                            f"--node_rank={rank}", f"--master_addr={addr}",
+                            f"--job_name={job}", "--monitor_interval=0.2",
+                            "--max_restarts=2",
+                            SCRIPT, "--", "--steps", "12", "--lockstep",
+                            "--step-sleep", "0.1",
+                            "--ckpt-dir", ckpt_dir, "--persist-every", "50",
+                            "--resume-marker", markers[rank],
+                            *crash_args,
+                        ],
+                        env=_env(), stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT, text=True,
+                    )
+                )
+            outs = []
+            for a in agents:
+                out, _ = a.communicate(timeout=240)
+                outs.append(out)
+                assert a.returncode == 0, out[-4000:]
+            assert os.path.exists(sentinel), "crash was never injected"
+            for r in range(2):
+                assert os.path.exists(markers[r]), (
+                    f"rank {r} never resumed\n" + outs[r][-3000:]
+                )
+                with open(markers[r]) as f:
+                    resumed = int(f.read())
+                assert resumed == 7, (
+                    f"rank {r} resumed from {resumed}, expected the "
+                    "crash-flushed step 7"
+                )
+            # The committed step-7 dir must hold BOTH nodes' shards and
+            # done-files under one tracker (2-node commit).
+            step7 = os.path.join(ckpt_dir, "checkpoint-7")
+            for f in ("done_0", "done_1", "shard_0.bin", "shard_1.bin"):
+                assert os.path.exists(os.path.join(step7, f)), (
+                    f"missing {f} in the 2-node commit"
+                )
+        finally:
+            for a in agents:
+                if a.poll() is None:
+                    a.kill()
             master.terminate()
             master.wait(timeout=10)

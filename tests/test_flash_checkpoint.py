@@ -274,3 +274,68 @@ class TestFlashCheckpointerAPI:
             assert step == last_memory
         finally:
             ckpt.close()
+
+
+class TestStepConsistencyVote:
+    """Multi-process restore must agree on one step (kv-store vote —
+    the reference allgathers on gloo, reference ``engine.py:64``)."""
+
+    def _vote(self, master, tmp_path, monkeypatch, steps):
+        import threading
+
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.common.constants import NodeEnv
+
+        monkeypatch.setenv(NodeEnv.MASTER_ADDR, master.addr)
+        monkeypatch.setenv(NodeEnv.NUM_PROCESSES, str(len(steps)))
+        MasterClient.reset()
+        engines = []
+        for rank in range(len(steps)):
+            monkeypatch.setenv(NodeEnv.PROCESS_ID, str(rank))
+            engines.append(CheckpointEngine(str(tmp_path / "ck")))
+        results = [None] * len(steps)
+
+        def vote(i):
+            results[i] = engines[i]._consistent_memory_step(steps[i])
+
+        threads = [
+            threading.Thread(target=vote, args=(i,))
+            for i in range(len(steps))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        for e in engines:
+            e.close()
+        MasterClient.reset()
+        return results
+
+    @pytest.fixture
+    def master(self):
+        from dlrover_tpu.master.master import JobMaster
+
+        master = JobMaster(port=0, node_num=2, job_name="vote-test")
+        master.prepare()
+        yield master
+        master.stop()
+
+    def test_agreement_restores_memory(self, master, tmp_path, monkeypatch,
+                                       job_name):
+        assert self._vote(master, tmp_path, monkeypatch, [7, 7]) == [
+            True, True,
+        ]
+
+    def test_disagreement_falls_back_to_storage(self, master, tmp_path,
+                                                monkeypatch, job_name):
+        """A torn flush (nodes at different steps) must NOT memory-restore
+        anywhere — every rank falls back to committed storage."""
+        assert self._vote(master, tmp_path, monkeypatch, [7, 9]) == [
+            False, False,
+        ]
+
+    def test_missing_snapshot_votes_minus_one(self, master, tmp_path,
+                                              monkeypatch, job_name):
+        assert self._vote(master, tmp_path, monkeypatch, [7, -1]) == [
+            False, False,
+        ]

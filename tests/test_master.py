@@ -75,6 +75,94 @@ class TestRendezvousManager:
         assert mgr.num_nodes_waiting() > 0
 
 
+class TestFailureDetection:
+    """Heartbeat death / training hang -> eviction -> stale world ->
+    survivors re-form (SURVEY §5 failure detection; round-2 weak #5/#6)."""
+
+    def _fast_master(self):
+        from dlrover_tpu.common.global_context import get_context
+
+        ctx = get_context()
+        old = (ctx.heartbeat_timeout, ctx.node_monitor_interval,
+               ctx.hang_detection_seconds)
+        ctx.heartbeat_timeout = 0.6
+        ctx.node_monitor_interval = 0.1
+        master = JobMaster(port=0, node_num=2, job_name="test-failure")
+        master.prepare()
+        return master, ctx, old
+
+    def _restore(self, ctx, old):
+        (ctx.heartbeat_timeout, ctx.node_monitor_interval,
+         ctx.hang_detection_seconds) = old
+
+    def test_heartbeat_death_evicts_and_stales_world(self):
+        master, ctx, old = self._fast_master()
+        try:
+            c0 = MasterClient(master.addr, node_id=0)
+            c1 = MasterClient(master.addr, node_id=1)
+            for rank, c in ((0, c0), (1, c1)):
+                c.join_rendezvous(RendezvousName.TRAINING, rank, 1)
+            round_, _, world = c0.get_comm_world(RendezvousName.TRAINING, 0)
+            assert len(world) == 2
+            c0.report_node_status(NodeStatus.RUNNING)
+            c1.report_node_status(NodeStatus.RUNNING)
+            # Both heartbeat, then node 1 goes silent.
+            deadline = time.monotonic() + 5
+            c1.report_heartbeat()
+            while time.monotonic() < deadline:
+                c0.report_heartbeat()
+                if c0.world_stale(RendezvousName.TRAINING, round_):
+                    break
+                time.sleep(0.1)
+            assert c0.world_stale(RendezvousName.TRAINING, round_), (
+                "dead node never invalidated the world"
+            )
+            # Node 1 is gone from the job: the survivor alone can finish.
+            assert master.job_manager.get_node(1) is None
+            assert master.job_manager.get_node(0) is not None
+            c0.close(), c1.close()
+        finally:
+            self._restore(ctx, old)
+            master.stop()
+
+    def test_hang_invalidates_round_without_eviction(self):
+        """A synchronous-training hang stalls ALL nodes: the master must
+        NOT evict anyone (that would abort the job) — it invalidates the
+        round so every agent restarts in place."""
+        master, ctx, old = self._fast_master()
+        master.speed_monitor._hang_seconds = 0.5
+        try:
+            c0 = MasterClient(master.addr, node_id=0)
+            c1 = MasterClient(master.addr, node_id=1)
+            c0.join_rendezvous(RendezvousName.TRAINING, 0, 1)
+            c1.join_rendezvous(RendezvousName.TRAINING, 1, 1)
+            round_, _, world = c0.get_comm_world(RendezvousName.TRAINING, 0)
+            assert len(world) == 2 and round_ >= 1
+            c0.report_node_status(NodeStatus.RUNNING)
+            c1.report_node_status(NodeStatus.RUNNING)
+            c0.report_global_step(5, time.time())
+            # Both keep heartbeating (agents alive) but no further steps
+            # are reported (workers hung in a collective).
+            deadline = time.monotonic() + 5
+            stale = False
+            while time.monotonic() < deadline:
+                c0.report_heartbeat()
+                c1.report_heartbeat()
+                if c0.world_stale(RendezvousName.TRAINING, round_):
+                    stale = True
+                    break
+                time.sleep(0.1)
+            assert stale, "hang never invalidated the round"
+            assert master.job_manager.get_node(0) is not None, (
+                "hang recovery must not evict nodes"
+            )
+            assert master.job_manager.get_node(1) is not None
+            c0.close(), c1.close()
+        finally:
+            self._restore(ctx, old)
+            master.stop()
+
+
 class TestDeviceCheckManager:
     def _form(self, mgr, n):
         mgr.update_rdzv_params(n, n, waiting_timeout=5)
