@@ -160,22 +160,46 @@ class ElasticTrainingAgent:
         """Agent-level liveness, independent of worker state: covers the
         stop-workers/re-rendezvous gaps so the master's heartbeat monitor
         never mistakes a restarting agent for a dead one."""
-        import threading
+        from dlrover_tpu.common.periodic import PeriodicTask
 
-        def beat():
-            while not self._stopped:
-                try:
-                    self._client.report_heartbeat()
-                except Exception:
-                    pass
-                time.sleep(self._config.monitor_interval)
+        self._heartbeat_task = PeriodicTask(
+            self._client.report_heartbeat,
+            self._config.monitor_interval,
+            "agent-heartbeat",
+        )
+        self._heartbeat_task.start()
 
-        threading.Thread(
-            target=beat, daemon=True, name="agent-heartbeat"
-        ).start()
+    def _start_monitors(self):
+        from dlrover_tpu.agent.monitor import ResourceMonitor, TrainingMonitor
+        from dlrover_tpu.common.constants import ConfigPath
+        from dlrover_tpu.common.global_context import get_context
+
+        interval = get_context().reporting_interval
+        self._resource_monitor = ResourceMonitor(
+            self._client, interval=interval
+        )
+        self._resource_monitor.start()
+        metrics_path = os.getenv(ConfigPath.ENV_RUNTIME_METRICS, "")
+        self._training_monitor = None
+        if metrics_path:
+            self._training_monitor = TrainingMonitor(
+                metrics_path, self._client
+            )
+            self._training_monitor.start()
+        # The tuner loop only runs when auto-tuning is enabled (same gate
+        # as the master's strategy generator): with it off, polling every
+        # few seconds and pointing workers at a never-written file would
+        # be pure overhead.
+        self._config_tuner = None
+        if get_context().auto_paral_tuning:
+            from dlrover_tpu.agent.config_tuner import ParalConfigTuner
+
+            self._config_tuner = ParalConfigTuner(self._client)
+            self._config_tuner.start()
 
     def run(self) -> int:
         self._start_heartbeats()
+        self._start_monitors()
         self._client.report_rdzv_params(
             self._config.min_nodes,
             self._config.max_nodes,
@@ -231,8 +255,14 @@ class ElasticTrainingAgent:
         return outcome
 
     def _worker_env(self, outcome: RendezvousOutcome, local_rank: int) -> Dict:
+        from dlrover_tpu.common.constants import ConfigPath
+
         env = dict(os.environ)
         env.update(self._config.worker_env)
+        if getattr(self, "_config_tuner", None) is not None:
+            # Workers hot-reload the tuned parallel config from this file
+            # (ElasticDataLoader.load_config).
+            env[ConfigPath.ENV_PARAL_CONFIG] = self._config_tuner.path
         env.update(
             {
                 NodeEnv.JOB_NAME: self._config.job_name,
@@ -333,6 +363,11 @@ class ElasticTrainingAgent:
 
     def stop(self):
         self._stopped = True
+        for attr in ("_heartbeat_task", "_resource_monitor",
+                     "_training_monitor", "_config_tuner"):
+            task = getattr(self, attr, None)
+            if task is not None:
+                task.stop()
         self._stop_workers()
 
 

@@ -1,0 +1,64 @@
+"""Agent-side parallel-config tuner.
+
+Parity: reference
+``dlrover/python/elastic_agent/config/paral_config_tuner.py:31``
+(``ParalConfigTuner``: poll the master's tuned config, drop it into the
+file workers watch). The worker side is already wired: the agent exports
+``ConfigPath.ENV_PARAL_CONFIG`` to every worker and
+``ElasticDataLoader.load_config`` hot-reloads batch size at batch
+boundaries when the file's version advances.
+"""
+
+import json
+import os
+from typing import Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import ConfigPath, NodeEnv
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.periodic import PeriodicTask
+
+
+class ParalConfigTuner:
+    def __init__(self, client: Optional[MasterClient] = None,
+                 path: Optional[str] = None, interval: float = 5.0):
+        self._client = client or MasterClient.singleton_instance()
+        job = os.getenv(NodeEnv.JOB_NAME, "local-job")
+        node = os.getenv(NodeEnv.NODE_RANK, "0")
+        self.path = path or os.path.join(
+            ConfigPath.ROOT, f"paral_config_{job}_n{node}.json"
+        )
+        self._version = 0
+        self._task = PeriodicTask(
+            self._poll_quiet, interval, "paral-config-tuner"
+        )
+
+    def poll_once(self) -> bool:
+        """Fetch the master's config; write the worker file when its
+        version advanced. Returns True when a new config landed."""
+        config = self._client.get_paral_config()
+        if config is None or config.version <= self._version:
+            return False
+        self._version = config.version
+        payload = {
+            "version": config.version,
+            "dataloader": dict(config.dataloader),
+            "mesh": dict(config.mesh),
+        }
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self.path)  # atomic: workers never read half a file
+        logger.info("tuned parallel config v%s -> %s",
+                    config.version, self.path)
+        return True
+
+    def _poll_quiet(self):
+        self.poll_once()
+
+    def start(self):
+        self._task.start()
+
+    def stop(self):
+        self._task.stop()

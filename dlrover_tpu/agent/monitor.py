@@ -1,0 +1,135 @@
+"""Agent-side monitors: node resources + training progress.
+
+Parity: reference ``dlrover/python/elastic_agent/monitor/resource.py:90``
+(``ResourceMonitor``: psutil CPU/memory + GPU stats reported to the
+master on a timer) and ``monitor/training.py:79`` (``TorchTrainingMonitor``:
+reads the per-step metrics file workers drop and reports the global step).
+TPU specifics: device stats come from the *worker's* JAX client (the agent
+process holds no TPU), so workers append them to the metrics file and the
+training monitor forwards them with the step report.
+"""
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.periodic import PeriodicTask
+
+
+class ResourceMonitor:
+    """Report the node's resource usage to the master on a timer."""
+
+    def __init__(self, client: Optional[MasterClient] = None,
+                 interval: float = 15.0):
+        self._client = client or MasterClient.singleton_instance()
+        self._pid = os.getpid()
+        # psutil Process objects must be CACHED: cpu_percent(interval=None)
+        # diffs against per-instance state, so a fresh instance always
+        # reports 0.0.
+        self._procs: Dict[int, object] = {}
+        self._task = PeriodicTask(
+            self.report_once, interval, "resource-monitor"
+        )
+        self._tree_stats()  # prime the CPU counters
+
+    def start(self):
+        self._task.start()
+
+    def stop(self):
+        self._task.stop()
+
+    def _tree_stats(self) -> Dict:
+        """CPU% and RSS of the agent's process tree (agent + workers)."""
+        import psutil
+
+        try:
+            root = self._procs.get(self._pid)
+            if root is None:
+                root = psutil.Process(self._pid)
+                self._procs[self._pid] = root
+            current = {self._pid: root}
+            for child in root.children(recursive=True):
+                current[child.pid] = self._procs.get(child.pid, child)
+        except psutil.Error:
+            return {"cpu_percent": 0.0, "used_memory_mb": 0}
+        self._procs = current
+        cpu = 0.0
+        rss = 0
+        for p in current.values():
+            try:
+                cpu += p.cpu_percent(interval=None)
+                rss += p.memory_info().rss
+            except psutil.Error:
+                continue
+        return {"cpu_percent": cpu, "used_memory_mb": rss // (1024 * 1024)}
+
+    def report_once(self):
+        stats = self._tree_stats()
+        self._client.report_resource_stats(
+            cpu_percent=stats["cpu_percent"],
+            used_memory_mb=stats["used_memory_mb"],
+            device_stats=self._device_stats(),
+        )
+
+    def _device_stats(self) -> List[Dict]:
+        """Host-visible accelerator stats, best effort: the agent process
+        does not own the TPU client, so this only reports what the
+        platform exposes without initializing a backend."""
+        return []
+
+
+class TrainingMonitor:
+    """Forward worker-dropped training metrics to the master.
+
+    Workers append JSON lines ``{"step": N, "timestamp": T, ...}`` to the
+    metrics file (``ConfigPath.ENV_RUNTIME_METRICS``, written via
+    :func:`dlrover_tpu.train.report_training_metrics`); this monitor tails
+    it and reports the newest step — so trainers that never link the
+    master client still feed the speed monitor and hang detection.
+
+    Every batch of new records triggers a report, even when the step did
+    not advance past a previous incarnation's (a worker restarted from a
+    checkpoint replays earlier steps): the report is a *liveness* signal
+    for hang detection first, a progress counter second.
+    """
+
+    def __init__(self, metrics_path: str,
+                 client: Optional[MasterClient] = None,
+                 interval: float = 5.0):
+        self._path = metrics_path
+        self._client = client or MasterClient.singleton_instance()
+        self._offset = 0
+        self._task = PeriodicTask(
+            self.report_once, interval, "training-monitor"
+        )
+
+    def start(self):
+        self._task.start()
+
+    def stop(self):
+        self._task.stop()
+
+    def report_once(self):
+        if not os.path.exists(self._path):
+            return
+        with open(self._path) as f:
+            f.seek(self._offset)
+            lines = f.readlines()
+            self._offset = f.tell()
+        newest = None
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "step" in rec:
+                newest = rec
+        if newest is not None:
+            self._client.report_global_step(
+                int(newest["step"]), float(newest.get("timestamp", 0.0))
+            )

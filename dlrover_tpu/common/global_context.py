@@ -38,6 +38,11 @@ class Context(Singleton):
         self.straggler_time_ratio = 2.0
         self.auto_scale_enabled = False
         self.checkpoint_gc_keep = 3
+        # Opt-in: let the master push tuned dataloader configs to workers
+        # (reference gates auto-tuning the same way).
+        self.auto_paral_tuning = (
+            os.getenv("DLROVER_TPU_AUTO_PARAL", "") in ("1", "true", "True")
+        )
 
 
 def get_context() -> Context:

@@ -1,0 +1,62 @@
+"""Runtime hyperparameter strategy generation on the master.
+
+Parity: reference
+``dlrover/python/master/hyperparams/simple_strategy_generator.py`` — derive
+a tuned dataloader config from the job's collected resource stats (the
+reference tunes torch dataloader ``batch_size``/``num_workers`` from
+CPU/memory usage). TPU-first cut: the lever that matters is the *global
+batch* fed to the jitted step; the generator scales the dataloader batch
+size toward a target host-memory utilization by doubling/halving (shapes
+change rarely, so recompilation is rare), bounded to a fixed multiple of
+the trainer-reported batch size.
+"""
+
+from typing import Dict, Optional
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.log import logger
+
+# Available host memory we aim to use; above the band we shrink, far
+# below it we grow.
+_TARGET_UTIL = 0.6
+_GROW_BELOW = 0.3
+# The recommendation is open-loop (workers hot-reload asynchronously and
+# do not re-report), so it is bounded to [1/MAX_SCALE, MAX_SCALE] x the
+# batch size the trainer actually reported — runaway doubling is capped
+# even if the tuned config is never applied.
+_MAX_SCALE = 4
+
+
+class SimpleStrategyGenerator:
+    """Stats in, ParallelConfig out (None = no change recommended)."""
+
+    def __init__(self, metric_collector, host_memory_mb: Optional[int] = None):
+        self._collector = metric_collector
+        self._host_memory_mb = host_memory_mb or _host_memory_mb()
+        self._last_batch: Optional[int] = None
+
+    def generate(self) -> Optional[m.ParallelConfig]:
+        summary: Dict = self._collector.summary()
+        info = summary.get("model_info")
+        if not summary["nodes"] or not info or not info.get("batch_size"):
+            return None  # nothing reported yet
+        used = summary["used_memory_mb_max"]
+        if used <= 0:
+            return None
+        base = int(info["batch_size"])
+        cur_batch = self._last_batch or base
+        util = used / self._host_memory_mb
+        if util < _GROW_BELOW:
+            new_batch = min(cur_batch * 2, base * _MAX_SCALE)
+        elif util > _TARGET_UTIL:
+            new_batch = max(cur_batch // 2, max(1, base // _MAX_SCALE))
+        else:
+            return None
+        if new_batch == cur_batch:
+            return None
+        self._last_batch = new_batch
+        logger.info(
+            "strategy generator: host mem util %.0f%% -> dataloader "
+            "batch %s -> %s", util * 100, cur_batch, new_batch,
+        )
+        return m.ParallelConfig(dataloader={"batch_size": new_batch})

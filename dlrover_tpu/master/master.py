@@ -10,7 +10,7 @@ import threading
 import time
 from typing import Optional
 
-from dlrover_tpu.common.constants import JobStage, NodeStatus, RendezvousName
+from dlrover_tpu.common.constants import JobStage, RendezvousName
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.kv_store import KVStoreService
@@ -22,6 +22,7 @@ from dlrover_tpu.master.rendezvous import (
 )
 from dlrover_tpu.master.servicer import MasterServicer, create_master_service
 from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.stats import JobMetricCollector
 from dlrover_tpu.master.sync_service import SyncService
 
 
@@ -55,6 +56,7 @@ class JobMaster:
             )
         self.kv_store = KVStoreService()
         self.sync_service = SyncService(self.job_manager)
+        self.metric_collector = JobMetricCollector()
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             kv_store=self.kv_store,
@@ -62,6 +64,7 @@ class JobMaster:
             job_manager=self.job_manager,
             speed_monitor=self.speed_monitor,
             sync_service=self.sync_service,
+            metric_collector=self.metric_collector,
         )
         self._server = create_master_service(port, self.servicer)
         self.port = self._server.port
@@ -98,9 +101,31 @@ class JobMaster:
           flushes its shm checkpoint, restarts its workers and
           re-rendezvouses (restart-in-place recovery).
         """
-        interval = get_context().node_monitor_interval
+        ctx = get_context()
+        interval = ctx.node_monitor_interval
+        strategy_gen = None
+        if ctx.auto_paral_tuning:
+            from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
+
+            strategy_gen = SimpleStrategyGenerator(self.metric_collector)
+        last_summary = time.monotonic()
         while not self._stopped.wait(interval):
             try:
+                if time.monotonic() - last_summary >= ctx.reporting_interval:
+                    last_summary = time.monotonic()
+                    s = self.metric_collector.summary()
+                    if s["nodes"]:
+                        logger.info(
+                            "job stats: %s nodes, avg cpu %.0f%%, peak mem "
+                            "%s MB, %.2f steps/s",
+                            s["nodes"], s["cpu_percent_avg"],
+                            s["used_memory_mb_max"],
+                            self.speed_monitor.running_speed(),
+                        )
+                    if strategy_gen is not None:
+                        tuned = strategy_gen.generate()
+                        if tuned is not None:
+                            self.servicer.set_paral_config(tuned)
                 for node_id in self.job_manager.find_dead_nodes():
                     self._evict_node(node_id, "heartbeat timeout")
                 if self.speed_monitor.worker_hang():

@@ -1,0 +1,3 @@
+from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+
+__all__ = ["JobMetricCollector"]

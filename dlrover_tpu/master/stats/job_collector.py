@@ -1,0 +1,116 @@
+"""Job-level metric collection on the master.
+
+Parity: reference ``dlrover/python/master/stats/job_collector.py``
+(``JobMetricCollector``: node resource reports, model/runtime info,
+training hyperparams — the inputs to the Brain/resource optimizer) +
+``stats/reporter.py`` (periodic summaries). The TPU version stores the
+same feeds in-process and exposes a ``summary()`` the auto-scaler and the
+local resource optimizer consume; a Brain-service reporter can subscribe
+via ``add_sink``.
+"""
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+
+
+@dataclass
+class ResourceSample:
+    timestamp: float
+    cpu_percent: float
+    used_memory_mb: int
+    device_stats: List[Dict] = field(default_factory=list)
+
+
+class JobMetricCollector:
+    """Aggregate per-node resource usage + model info for one job."""
+
+    def __init__(self, history: int = 256):
+        self._lock = threading.Lock()
+        self._history = history
+        self._node_samples: Dict[int, Deque[ResourceSample]] = {}
+        self._model_info: Optional[Dict] = None
+        self._custom: Dict[str, Any] = {}
+        self._sinks: List[Callable[[str, Dict], None]] = []
+
+    # ------------- intake (servicer-driven) -------------
+    def collect_node_resource(self, req) -> None:
+        sample = ResourceSample(
+            timestamp=time.time(),
+            cpu_percent=float(req.cpu_percent),
+            used_memory_mb=int(req.used_memory_mb),
+            device_stats=list(req.device_stats or []),
+        )
+        with self._lock:
+            q = self._node_samples.setdefault(
+                req.node_id, deque(maxlen=self._history)
+            )
+            q.append(sample)
+        self._emit("node_resource", {"node_id": req.node_id,
+                                     "cpu": sample.cpu_percent,
+                                     "memory_mb": sample.used_memory_mb})
+
+    def collect_model_info(self, req) -> None:
+        info = {
+            "params_count": int(req.params_count),
+            "flops_per_step": float(req.flops_per_step),
+            "batch_size": int(req.batch_size),
+            "seq_len": int(req.seq_len),
+            "extra": dict(req.extra or {}),
+        }
+        with self._lock:
+            self._model_info = info
+        logger.info("model info collected: %s params, %.2e flops/step",
+                    info["params_count"], info["flops_per_step"])
+        self._emit("model_info", info)
+
+    def collect_custom(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._custom[key] = value
+
+    # ------------- outputs -------------
+    def node_resource(self, node_id: int) -> Optional[ResourceSample]:
+        with self._lock:
+            q = self._node_samples.get(node_id)
+            return q[-1] if q else None
+
+    @property
+    def model_info(self) -> Optional[Dict]:
+        with self._lock:
+            return dict(self._model_info) if self._model_info else None
+
+    def summary(self) -> Dict:
+        """One job-level snapshot (consumed by the auto-scaler / resource
+        optimizer and logged periodically)."""
+        with self._lock:
+            latest = {
+                nid: q[-1] for nid, q in self._node_samples.items() if q
+            }
+            return {
+                "nodes": len(latest),
+                "cpu_percent_avg": (
+                    sum(s.cpu_percent for s in latest.values()) / len(latest)
+                    if latest else 0.0
+                ),
+                "used_memory_mb_max": max(
+                    (s.used_memory_mb for s in latest.values()), default=0
+                ),
+                "model_info": dict(self._model_info) if self._model_info
+                else None,
+                "custom": dict(self._custom),
+            }
+
+    def add_sink(self, sink: Callable[[str, Dict], None]):
+        """Subscribe to metric events (e.g. a Brain-service reporter)."""
+        self._sinks.append(sink)
+
+    def _emit(self, kind: str, payload: Dict):
+        for sink in self._sinks:
+            try:
+                sink(kind, payload)
+            except Exception:
+                logger.exception("metric sink failed for %s", kind)

@@ -52,3 +52,25 @@ def local_rank() -> int:
 
 def restart_count() -> int:
     return int(os.getenv(NodeEnv.RESTART_COUNT, "0"))
+
+
+def report_training_metrics(step: int, **extra):
+    """Append a metrics record for the agent's TrainingMonitor to forward
+    (parity: the reference's per-step metrics file the torch training
+    monitor tails, ``monitor/training.py:79``). A no-op unless the agent
+    exported ``ConfigPath.ENV_RUNTIME_METRICS``."""
+    import json
+    import time as _time
+
+    from dlrover_tpu.common.constants import ConfigPath
+
+    path = os.getenv(ConfigPath.ENV_RUNTIME_METRICS, "")
+    if not path:
+        return
+    rec = {"step": int(step), "timestamp": _time.time(), **extra}
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as e:
+        logger.warning("failed to write training metrics: %s", e)
