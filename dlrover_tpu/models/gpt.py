@@ -39,8 +39,14 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # "nothing": recompute everything (min memory); "dots": save matmul
+    # outputs (recompute only cheap elementwise — the usual best
+    # throughput/memory point when activations almost fit).
+    remat_policy: str = "nothing"
     scan_layers: bool = True
     attn_impl: str = "xla"  # "xla" | "pallas" | "ring"
+    attn_block_q: int = 512  # pallas kernel tile sizes
+    attn_block_k: int = 512
     dropout: float = 0.0
     # MoE (0 = dense MLP). With num_experts > 0 every block's FFN becomes
     # an expert-parallel MoEMLP and __call__ returns (logits, aux_loss).
@@ -141,7 +147,10 @@ def _attention(q, k, v, cfg: GPTConfig):
     if cfg.attn_impl == "pallas":
         from dlrover_tpu.ops.attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(
+            q, k, v, causal=True,
+            block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+        )
     if cfg.attn_impl == "ring":
         from dlrover_tpu.ops.ring_attention import ring_attention
 
@@ -203,6 +212,13 @@ class Block(nn.Module):
         return x, None
 
 
+
+def _remat_policy(cfg: "GPTConfig"):
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.checkpoint_dots
+    return jax.checkpoint_policies.nothing_saveable
+
+
 class _GPTStage(nn.Module):
     """One pipeline stage: ``num_layers / pipeline_stages`` blocks.
     Used as the ``make_stage`` body of ``accel.pipeline.Pipeline``."""
@@ -217,7 +233,7 @@ class _GPTStage(nn.Module):
         if cfg.remat:
             block = nn.remat(
                 Block, prevent_cse=False,
-                policy=jax.checkpoint_policies.nothing_saveable,
+                policy=_remat_policy(cfg),
             )
         if cfg.scan_layers:
             x, _ = nn.scan(
@@ -272,7 +288,7 @@ class GPT(nn.Module):
                 name="pipeline",
             )(x)
             x = _layernorm("ln_f", cfg)(x)
-            logits = embed.attend(x.astype(cfg.param_dtype))
+            logits = embed.attend(x)  # module dtype (bf16): full MXU rate
             return nn.with_logical_constraint(
                 logits, ("batch", "seq", "vocab")
             )
@@ -281,7 +297,7 @@ class GPT(nn.Module):
         if cfg.remat:
             block = nn.remat(
                 Block, prevent_cse=False,
-                policy=jax.checkpoint_policies.nothing_saveable,
+                policy=_remat_policy(cfg),
             )
         if cfg.scan_layers:
             x, aux = nn.scan(
@@ -302,7 +318,7 @@ class GPT(nn.Module):
 
         x = _layernorm("ln_f", cfg)(x)
         # Tied output head: logits via the embedding table (GPT-2 style).
-        logits = embed.attend(x.astype(cfg.param_dtype))
+        logits = embed.attend(x)  # module dtype (bf16): full MXU rate
         logits = nn.with_logical_constraint(
             logits, ("batch", "seq", "vocab")
         )
@@ -312,12 +328,17 @@ class GPT(nn.Module):
 
 
 def loss_fn(logits, tokens, ignore_first: bool = True):
-    """Next-token cross entropy; logits[B,S,V], tokens[B,S]."""
+    """Next-token cross entropy; logits[B,S,V], tokens[B,S].
+
+    Computed as logsumexp - target_logit so no [B,S,V] f32 log-prob
+    tensor is materialized (the logsumexp reduction streams over the
+    vocab axis — at GPT-2 vocab size the full logp would be the largest
+    activation in the model)."""
     targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    logits = logits[:, :-1].astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt)
 
 
 def moe_loss_fn(out, tokens, aux_weight: float = 1e-2):

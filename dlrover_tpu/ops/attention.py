@@ -112,7 +112,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         l = l_s[:, 0]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc[:] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = m_s[:, 0] + jnp.log(l_safe)
+        # lse blocks span the full row (TPU tiling forbids a (1, block_q)
+        # block over [B*H, S]); each qi writes its slice.
+        lse_ref[0, 0, pl.dslice(qi * block_q, block_q)] = (
+            m_s[:, 0] + jnp.log(l_safe)
+        )
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
@@ -157,11 +161,11 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, 1, sq), lambda bh, qi, ki: (bh, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ],
         scratch_shapes=scratch,
         interpret=interpret,
@@ -203,14 +207,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 jnp.int32, (block_q, block_k), 1
             ) + ki * block_k
             logits = jnp.where(rows >= cols, logits, _NEG_INF)
-        p = jnp.exp(logits - lse_ref[0][:, None])
+        lse = lse_ref[0, 0, pl.dslice(qi * block_q, block_q)]
+        p = jnp.exp(logits - lse[:, None])
         if causal:
             p = jnp.where(logits <= _NEG_INF / 2, 0.0, p)
         dp = jax.lax.dot_general(
             do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0][:, None])
+        delta = delta_ref[0, 0, pl.dslice(qi * block_q, block_q)]
+        ds = p * (dp - delta[:, None])
         acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -254,7 +260,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1
             ) + ki * block_k
             logits = jnp.where(rows >= cols, logits, _NEG_INF)
-        p = jnp.exp(logits - lse_ref[0][:, None])
+        lse = lse_ref[0, 0, pl.dslice(qi * block_q, block_q)]
+        p = jnp.exp(logits - lse[:, None])
         if causal:
             p = jnp.where(logits <= _NEG_INF / 2, 0.0, p)
         do = do_ref[0].astype(jnp.float32)
@@ -267,7 +274,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0][:, None])
+        delta = delta_ref[0, 0, pl.dslice(qi * block_q, block_q)]
+        ds = p * (dp - delta[:, None])
         # dk += ds^T @ (q * scale)  — q already carries the scale
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -298,7 +306,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     # delta = rowsum(do * o): cheap elementwise — XLA fuses it fine.
     delta = jnp.sum(
         dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
-    )
+    )[:, None, :]  # [B*H, 1, S] — matches the lse layout
 
     try:
         from jax.experimental.pallas import tpu as pltpu
@@ -318,8 +326,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, 1, sq), lambda bh, qi, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda bh, qi, ki: (bh, 0, 0)),
         ],
         out_specs=pl.BlockSpec(
             (1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)
@@ -340,8 +348,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, 1, sq), lambda bh, ki, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda bh, ki, qi: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
