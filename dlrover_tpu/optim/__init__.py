@@ -1,0 +1,16 @@
+"""TPU-native optimizers (optax-style GradientTransformations).
+
+Capability parity with the reference's optimizer library
+(``atorch/atorch/optimizers/``): AGD (``agd.py``), WeightedSAM
+(``wsam.py``), bf16 master-weight optimization (``bf16_optimizer.py``) and
+low-bit (8-bit blockwise) Adam (``low_bit/``). Not ports: each is a pure
+functional transform — state is a pytree, updates jit/GSPMD-shard like any
+other computation, and the low-bit kernels are XLA-fused instead of CUDA.
+"""
+
+from dlrover_tpu.optim.agd import agd
+from dlrover_tpu.optim.bf16 import bf16_master_weights
+from dlrover_tpu.optim.low_bit import adam8bit
+from dlrover_tpu.optim.wsam import WeightedSAM
+
+__all__ = ["agd", "WeightedSAM", "bf16_master_weights", "adam8bit"]

@@ -1,0 +1,124 @@
+"""AGD — Auto-switchable optimizer preconditioned by the stepwise
+gradient difference (Yue et al., KDD'23).
+
+Capability parity with the reference implementation
+(``atorch/atorch/optimizers/agd.py:73-155``), re-derived as an optax
+``GradientTransformation``:
+
+- first moment ``m`` as in Adam; the *preconditioner* ``v`` is an EMA of
+  the squared **difference of bias-corrected first moments** between
+  consecutive steps (step 1 uses the moment itself) — the "gradient
+  difference" that lets AGD auto-switch between SGD-like and
+  adaptive behavior;
+- denominator clamped from below by ``delta * sqrt(bc2)``;
+- effective lr ``lr * sqrt(bc2) / bc1``; optional AMSGrad max-tracking,
+  update clipping and (decoupled) weight decay.
+
+The ``win`` variant is not implemented.
+"""
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class AGDState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: optax.Updates
+    exp_avg_sq: optax.Updates
+    max_exp_avg_sq: Optional[optax.Updates]
+
+
+def agd(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    delta: float = 1e-5,
+    weight_decay: float = 0.0,
+    weight_decouple: bool = True,
+    fixed_decay: bool = False,
+    amsgrad: bool = False,
+    clip: Optional[float] = None,
+) -> optax.GradientTransformation:
+    if learning_rate <= 0:
+        raise ValueError(f"invalid learning rate {learning_rate}")
+    if not 0 <= b1 < 1 or not 0 <= b2 < 1:
+        raise ValueError(f"invalid betas ({b1}, {b2})")
+
+    def init(params):
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return AGDState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=zeros,
+            exp_avg_sq=jax.tree_util.tree_map(jnp.zeros_like, params),
+            max_exp_avg_sq=(
+                jax.tree_util.tree_map(jnp.zeros_like, params)
+                if amsgrad else None
+            ),
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("agd requires params (weight decay / update)")
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        bc1 = 1 - b1 ** stepf
+        bc1_old = 1 - b1 ** (stepf - 1)
+        bc2 = 1 - b2 ** stepf
+
+        if not weight_decouple and weight_decay:
+            grads = jax.tree_util.tree_map(
+                lambda g, p: g + weight_decay * p, grads, params
+            )
+
+        m_new = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g, state.exp_avg, grads
+        )
+        # Stepwise moment difference; step 1 has no previous moment.
+        def precond(mn, mo):
+            diff = mn / bc1 - mo / jnp.where(bc1_old == 0, 1.0, bc1_old)
+            return jnp.where(step == 1, mn / bc1, diff)
+
+        d = jax.tree_util.tree_map(precond, m_new, state.exp_avg)
+        v_new = jax.tree_util.tree_map(
+            lambda v, u: b2 * v + (1 - b2) * u * u, state.exp_avg_sq, d
+        )
+        if amsgrad:
+            max_v = jax.tree_util.tree_map(
+                jnp.maximum, state.max_exp_avg_sq, v_new
+            )
+            denom_src = max_v
+        else:
+            max_v = None
+            denom_src = v_new
+
+        delta_adjust = delta * jnp.sqrt(bc2)
+        lr_adjust = learning_rate * jnp.sqrt(bc2) / bc1
+
+        def direction(m, v):
+            den = jnp.maximum(jnp.sqrt(v), delta_adjust)
+            u = m / den
+            if clip is not None:
+                u = jnp.clip(u, -clip, clip)
+            return u
+
+        updates = jax.tree_util.tree_map(direction, m_new, denom_src)
+        decay = (
+            weight_decay if fixed_decay else learning_rate * weight_decay
+        )
+
+        def apply(u, p):
+            out = -lr_adjust * u
+            if weight_decouple and weight_decay:
+                out = out - decay * p
+            return out
+
+        updates = jax.tree_util.tree_map(apply, updates, params)
+        return updates, AGDState(
+            step=step, exp_avg=m_new, exp_avg_sq=v_new,
+            max_exp_avg_sq=max_v,
+        )
+
+    return optax.GradientTransformation(init, update)

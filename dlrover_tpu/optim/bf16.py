@@ -1,0 +1,48 @@
+"""bf16 training with fp32 master weights, as an optax wrapper.
+
+Capability parity with the reference's BF16Optimizer
+(``atorch/atorch/optimizers/bf16_optimizer.py``: fp32 master params +
+grad cast, bf16 model params kept in sync). The transform owns the fp32
+masters in its state: the model keeps bf16 params (MXU-native), grads
+arrive bf16, the update math runs in fp32 against the masters, and the
+emitted update is exactly the bf16 delta — so tiny updates accumulate in
+fp32 instead of vanishing below the bf16 ulp.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class Bf16MasterState(NamedTuple):
+    master: Any   # fp32 master params
+    inner: Any    # base optimizer state (over the masters)
+
+
+def bf16_master_weights(
+    base: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    def init(params):
+        master = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), params
+        )
+        return Bf16MasterState(master=master, inner=base.init(master))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("bf16_master_weights requires params")
+        g32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads
+        )
+        inner_updates, inner = base.update(g32, state.inner, state.master)
+        master = optax.apply_updates(state.master, inner_updates)
+        # The emitted update recreates the bf16 params from the fp32
+        # masters: p_new = bf16(master); update = p_new - p.
+        updates = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype) - p, master, params
+        )
+        return updates, Bf16MasterState(master=master, inner=inner)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,125 @@
+"""8-bit blockwise-quantized Adam.
+
+Capability parity with the reference's low-bit optimizer family
+(``atorch/atorch/optimizers/low_bit/``: 4/8-bit quantized Adam states
+with CUDA dequant/quant kernels). The TPU-first design stores both Adam
+moments as int8 with per-block fp32 absmax scales and runs
+dequantize → update → requantize as plain XLA ops — the compiler fuses
+the whole chain into the update, so no custom kernels are needed and the
+state pytree shards under GSPMD like any other (blocks are contiguous
+slices of the flattened param, so an even sharding keeps scale blocks
+device-local).
+
+Memory: 2 x int8 + 2 x fp32/block ≈ 2.03 bytes/param for the moments vs
+8 bytes for fp32 Adam.
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class _QTensor(NamedTuple):
+    q: jnp.ndarray       # int8 payload, padded to a block multiple
+    scale: jnp.ndarray   # fp32 absmax per block
+
+
+class Adam8bitState(NamedTuple):
+    step: jnp.ndarray
+    m: Any               # pytree of _QTensor (linear domain)
+    v: Any               # pytree of _QTensor (SQRT domain — see below)
+
+
+def _quantize(x: jnp.ndarray, block: int) -> _QTensor:
+    flat = x.reshape(-1)
+    pad = (-flat.size) % block
+    flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, block)
+    scale = jnp.max(jnp.abs(blocks), axis=1)
+    safe = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(
+        jnp.round(blocks / safe[:, None] * 127.0), -127, 127
+    ).astype(jnp.int8)
+    return _QTensor(q=q, scale=scale.astype(jnp.float32))
+
+
+def _dequantize(qt: _QTensor, shape, size) -> jnp.ndarray:
+    blocks = qt.q.astype(jnp.float32) * (qt.scale[:, None] / 127.0)
+    return blocks.reshape(-1)[:size].reshape(shape)
+
+
+def adam8bit(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    block_size: int = 256,
+) -> optax.GradientTransformation:
+    """Adam with int8 blockwise-quantized moments (8-bit optimizer)."""
+
+    def init(params):
+        def qzero(p):
+            return _quantize(jnp.zeros_like(p, jnp.float32), block_size)
+
+        zeros = jax.tree_util.tree_map(qzero, params)
+        return Adam8bitState(
+            step=jnp.zeros((), jnp.int32),
+            m=zeros,
+            v=jax.tree_util.tree_map(qzero, params),
+        )
+
+    def update(grads, state, params=None):
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        bc1 = 1 - b1 ** stepf
+        bc2 = 1 - b2 ** stepf
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_m = treedef.flatten_up_to(state.m)
+        flat_v = treedef.flatten_up_to(state.v)
+        flat_p = treedef.flatten_up_to(params) if params is not None else [
+            None
+        ] * len(flat_g)
+
+        new_updates, new_m, new_v = [], [], []
+        for g, qm, qv, p in zip(flat_g, flat_m, flat_v, flat_p):
+            g = g.astype(jnp.float32)
+            m = b1 * _dequantize(qm, g.shape, g.size) + (1 - b1) * g
+            # v is stored as sqrt(v): linear int8 of the squares loses
+            # small-|g| entries to a block's absmax quadratically faster
+            # than m does, and a v that underflows to 0 under a live m
+            # turns the Adam step into m/eps — divergence. In the sqrt
+            # domain both moments share the same relative resolution.
+            s_prev = _dequantize(qv, g.shape, g.size)
+            v = b2 * s_prev * s_prev + (1 - b2) * g * g
+            s = jnp.sqrt(v)
+            mhat = m / bc1
+            denom = s / jnp.sqrt(bc2)
+            # Floor the denominator at half a quantization step of s so a
+            # moment that will round to zero can never amplify m by 1/eps.
+            qs = _quantize(s, block_size)
+            floor = jnp.repeat(
+                qs.scale / (127.0 * 2.0), block_size
+            )[: g.size].reshape(g.shape) / jnp.sqrt(bc2)
+            u = -learning_rate * mhat / (
+                jnp.maximum(denom, floor) + eps
+            )
+            if weight_decay and p is not None:
+                u = u - learning_rate * weight_decay * p
+            new_updates.append(u.astype(g.dtype))
+            new_m.append(_quantize(m, block_size))
+            new_v.append(qs)
+
+        return (
+            jax.tree_util.tree_unflatten(treedef, new_updates),
+            Adam8bitState(
+                step=step,
+                m=jax.tree_util.tree_unflatten(treedef, new_m),
+                v=jax.tree_util.tree_unflatten(treedef, new_v),
+            ),
+        )
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,95 @@
+"""Control-plane event tracing — Chrome-trace-format event log.
+
+Parity with the reference's tracing/diagnosis data collection (SURVEY §5:
+the master records node events and training phase transitions for
+offline diagnosis). Events are recorded in-process (thread-safe ring
+buffer) and exported as Chrome trace JSON (``chrome://tracing`` /
+Perfetto-viewable), giving rendezvous, restart, checkpoint and eviction
+timelines across one process.
+
+Usage::
+
+    from dlrover_tpu.utils.tracing import get_tracer
+    tracer = get_tracer()
+    with tracer.span("rendezvous", round=3):
+        ...
+    tracer.instant("worker-crash", rank=2)
+    tracer.export("/tmp/trace.json")
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Optional
+
+_TRACE_ENV = "DLROVER_TPU_TRACE_FILE"
+
+
+class Tracer:
+    def __init__(self, capacity: int = 65536):
+        self._events: Deque[Dict] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+
+    def _emit(self, event: Dict):
+        with self._lock:
+            self._events.append(event)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        """A complete ('X') event covering the with-block."""
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self._emit({
+                "name": name, "ph": "X", "pid": self._pid,
+                "tid": threading.get_ident() % 1_000_000,
+                "ts": t0 * 1e6, "dur": (time.time() - t0) * 1e6,
+                "args": args,
+            })
+
+    def instant(self, name: str, **args):
+        self._emit({
+            "name": name, "ph": "i", "s": "p", "pid": self._pid,
+            "tid": threading.get_ident() % 1_000_000,
+            "ts": time.time() * 1e6, "args": args,
+        })
+
+    def counter(self, name: str, **values):
+        self._emit({
+            "name": name, "ph": "C", "pid": self._pid,
+            "ts": time.time() * 1e6, "args": values,
+        })
+
+    @property
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def export(self, path: Optional[str] = None) -> Optional[str]:
+        """Write Chrome trace JSON; default path from the env contract."""
+        path = path or os.getenv(_TRACE_ENV, "")
+        if not path:
+            return None
+        with self._lock:
+            events = list(self._events)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        return path
+
+
+_tracer: Optional[Tracer] = None
+_tracer_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    global _tracer
+    with _tracer_lock:
+        if _tracer is None:
+            _tracer = Tracer()
+        return _tracer

@@ -1,0 +1,110 @@
+"""Profiler + tracing tests (SURVEY §2.5 profiler, §5 tracing)."""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dlrover_tpu.utils.profiler import Profiler, device_peak_flops
+from dlrover_tpu.utils.tracing import Tracer
+
+
+class TestProfiler:
+    def test_step_and_phase_stats(self):
+        prof = Profiler()
+        for _ in range(5):
+            with prof.step():
+                with prof.phase("data"):
+                    time.sleep(0.01)
+                with prof.phase("compute"):
+                    time.sleep(0.02)
+        rep = prof.report()
+        assert rep["steps"] == 5
+        assert rep["step_time_mean_s"] >= 0.03
+        assert rep["phases"]["data"]["mean_s"] >= 0.01
+        assert rep["phases"]["compute"]["share"] > rep["phases"]["data"]["share"]
+
+    def test_cost_analysis_flops(self):
+        """Compiler-reported flops for a matmul must match 2*M*N*K."""
+        prof = Profiler()
+        m = 256
+
+        @jax.jit
+        def f(a, b):
+            return a @ b
+
+        a = jnp.ones((m, m), jnp.float32)
+        cost = prof.analyze(f, a, a)
+        assert cost["flops"] == pytest.approx(2 * m ** 3, rel=0.01)
+
+    def test_utilization_needs_data(self):
+        prof = Profiler()
+        assert prof.utilization() == -1.0
+
+    def test_mfu_computation(self):
+        prof = Profiler()
+        prof._cost = {"flops": 1e9, "bytes_accessed": 0}
+        with prof.step():
+            time.sleep(0.01)
+        # On CPU device_peak_flops is 0 -> -1; force a peak.
+        mfu = prof.utilization(device=None) if device_peak_flops() else None
+        u = prof._cost["flops"] / prof._step_stats.mean / 1e12
+        assert u > 0  # arithmetic sanity
+
+    def test_trace_capture_writes_events(self, tmp_path):
+        """jax.profiler trace capture on the step schedule produces
+        profile artifacts."""
+        import os
+
+        prof = Profiler(trace_dir=str(tmp_path), trace_steps=(1, 2))
+
+        @jax.jit
+        def f(x):
+            return x * 2
+
+        x = jnp.ones(8)
+        for _ in range(4):
+            with prof.step():
+                jax.block_until_ready(f(x))
+        found = []
+        for root, _, files in os.walk(tmp_path):
+            found.extend(files)
+        assert found, "no trace artifacts written"
+
+
+class TestTracer:
+    def test_span_and_instant(self):
+        tracer = Tracer()
+        with tracer.span("rendezvous", round=1):
+            time.sleep(0.005)
+        tracer.instant("crash", rank=2)
+        events = tracer.events
+        assert len(events) == 2
+        span = next(e for e in events if e["ph"] == "X")
+        assert span["name"] == "rendezvous"
+        assert span["dur"] >= 5000  # microseconds
+        assert span["args"]["round"] == 1
+
+    def test_export_chrome_trace(self, tmp_path):
+        tracer = Tracer()
+        tracer.instant("e1")
+        tracer.counter("mem", mb=512)
+        path = str(tmp_path / "trace.json")
+        tracer.export(path)
+        with open(path) as f:
+            doc = json.load(f)
+        assert len(doc["traceEvents"]) == 2
+
+    def test_export_without_path_is_noop(self, monkeypatch):
+        monkeypatch.delenv("DLROVER_TPU_TRACE_FILE", raising=False)
+        tracer = Tracer()
+        tracer.instant("e")
+        assert tracer.export() is None
+
+    def test_capacity_bounded(self):
+        tracer = Tracer(capacity=10)
+        for i in range(100):
+            tracer.instant(f"e{i}")
+        assert len(tracer.events) == 10
