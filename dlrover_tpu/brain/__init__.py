@@ -1,0 +1,4 @@
+from dlrover_tpu.brain.client import BrainClient, BrainResourceOptimizer
+from dlrover_tpu.brain.service import BrainService
+
+__all__ = ["BrainService", "BrainClient", "BrainResourceOptimizer"]

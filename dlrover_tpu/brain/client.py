@@ -1,0 +1,70 @@
+"""Brain client + the Brain-backed resource optimizer.
+
+Parity: reference ``dlrover/python/brain/client.py`` (persist metrics /
+fetch optimization plans) and ``master/resource/brain_optimizer.py``
+(the ResourceOptimizer that asks the Brain instead of local heuristics).
+``JobMetricCollector.add_sink(BrainReporter(...))`` streams a master's
+stats to the service with no master-side coupling.
+"""
+
+from typing import Dict, Optional
+
+from dlrover_tpu.brain.service import BrainOptimizeRequest, BrainPersist
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RpcClient
+from dlrover_tpu.master.scaling import ResourcePlan
+
+
+class BrainClient:
+    def __init__(self, addr: str):
+        self._rpc = RpcClient(addr)
+
+    def persist_metrics(self, job_name: str, kind: str, payload: Dict):
+        return self._rpc.call(
+            BrainPersist(job_name=job_name, kind=kind, payload=payload)
+        )
+
+    def get_optimization_plan(self, job_name: str) -> Dict:
+        return self._rpc.call(BrainOptimizeRequest(job_name=job_name))
+
+    def close(self):
+        self._rpc.close()
+
+
+class BrainReporter:
+    """A JobMetricCollector sink forwarding stats to the Brain."""
+
+    def __init__(self, client: BrainClient, job_name: str):
+        self._client = client
+        self._job = job_name
+
+    def __call__(self, kind: str, payload: Dict):
+        if kind == "node_resource":
+            self._client.persist_metrics(self._job, kind, {
+                "memory_mb": payload.get("memory_mb", 0),
+                "cpu": payload.get("cpu", 0.0),
+            })
+        elif kind == "model_info":
+            self._client.persist_metrics(self._job, kind, payload)
+
+
+class BrainResourceOptimizer:
+    """Drop-in for LocalResourceOptimizer, backed by the service."""
+
+    def __init__(self, client: BrainClient, job_name: str):
+        self._client = client
+        self._job = job_name
+
+    def generate_plan(self, current_workers: int) -> ResourcePlan:
+        try:
+            plan = self._client.get_optimization_plan(self._job)
+        except Exception as e:
+            logger.warning("brain optimize failed: %s", e)
+            return ResourcePlan()
+        if not plan:
+            return ResourcePlan()
+        return ResourcePlan(
+            worker_cpu=float(plan.get("worker_cpu", 0.0)),
+            worker_memory_mb=int(plan.get("worker_memory_mb", 0)),
+            worker_num=current_workers,
+        )

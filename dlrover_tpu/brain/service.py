@@ -1,0 +1,119 @@
+"""Brain service — the offline resource-optimization backend.
+
+Capability parity with the reference's Brain (``dlrover/brain/`` Go
+service + ``dlrover/python/brain/client``): jobs persist their runtime
+metrics to a store; an optimize endpoint turns a job's history into
+resource plans that outlive any single master (new jobs of the same name
+start from the last job's observed needs — the cross-job learning the
+Brain exists for).
+
+Condensed TPU-first cut: same RPC transport as the control plane, an
+in-process/on-disk store instead of MySQL, and the optimizer strategy is
+percentile-over-history sizing (the reference's simplest strategy) —
+pluggable for anything smarter.
+"""
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RpcServer
+
+
+@dataclass
+class BrainPersist(m.BaseRequest):
+    job_name: str = ""
+    kind: str = ""            # "node_resource" | "model_info" | custom
+    payload: Dict = field(default_factory=dict)
+
+
+@dataclass
+class BrainOptimizeRequest(m.BaseRequest):
+    job_name: str = ""
+
+
+class BrainService:
+    """Metrics store + optimize endpoint over the shared RPC transport."""
+
+    HISTORY = 2048
+
+    def __init__(self, port: int = 0, store_path: str = ""):
+        self._lock = threading.Lock()
+        self._store: Dict[str, Deque[Dict]] = defaultdict(
+            lambda: deque(maxlen=self.HISTORY)
+        )
+        self._store_path = store_path
+        if store_path and os.path.exists(store_path):
+            self._load()
+        self._server = RpcServer(port, self._handle)
+        self.port = self._server.port
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self):
+        self._server.start()
+        logger.info("brain service on port %s", self.port)
+
+    def stop(self):
+        if self._store_path:
+            self._save()
+        self._server.stop()
+
+    # ------------- persistence -------------
+    def _save(self):
+        with self._lock:
+            doc = {job: list(q) for job, q in self._store.items()}
+        tmp = f"{self._store_path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self._store_path)
+
+    def _load(self):
+        try:
+            with open(self._store_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        with self._lock:
+            for job, records in doc.items():
+                self._store[job].extend(records)
+
+    # ------------- rpc -------------
+    def _handle(self, req):
+        if isinstance(req, BrainPersist):
+            with self._lock:
+                self._store[req.job_name].append(
+                    {"kind": req.kind, "ts": time.time(), **req.payload}
+                )
+            return True
+        if isinstance(req, BrainOptimizeRequest):
+            return self.optimize(req.job_name)
+        raise ValueError(f"brain: unknown request {type(req).__name__}")
+
+    # ------------- strategy -------------
+    def optimize(self, job_name: str) -> Dict:
+        """Resource plan from the job's history: p95 of observed usage
+        with headroom (reference's percentile sizing strategy)."""
+        with self._lock:
+            records = [
+                r for r in self._store.get(job_name, ())
+                if r.get("kind") == "node_resource"
+            ]
+        if not records:
+            return {}
+        mems = sorted(r.get("memory_mb", 0) for r in records)
+        cpus = sorted(r.get("cpu", 0.0) for r in records)
+        p95 = max(0, int(0.95 * len(mems)) - 1)
+        return {
+            "worker_memory_mb": int(mems[p95] * 1.2),
+            "worker_cpu": round(cpus[p95] / 100 * 1.2, 2),
+            "samples": len(records),
+        }

@@ -33,6 +33,7 @@ class JobMaster:
         node_num: int = 1,
         job_name: str = "local-job",
         job_manager: Optional[JobManager] = None,
+        scaler=None,
     ):
         ctx = get_context()
         self.job_name = job_name
@@ -72,6 +73,22 @@ class JobMaster:
         self._stopped = threading.Event()
         self._abort_reason: Optional[str] = None
         self._monitor_thread: Optional[threading.Thread] = None
+        # Opt-in auto-scaling: needs a platform scaler backend (the local
+        # platform default is agent-side supervision, no scaler).
+        self.auto_scaler = None
+        if scaler is not None and ctx.auto_scale_enabled:
+            from dlrover_tpu.master.scaling import (
+                AllreduceAutoScaler,
+                LocalResourceOptimizer,
+            )
+
+            self.auto_scaler = AllreduceAutoScaler(
+                self.job_manager, scaler,
+                resource_optimizer=LocalResourceOptimizer(
+                    self.metric_collector
+                ),
+                target_worker_num=node_num,
+            )
 
     @property
     def addr(self) -> str:
@@ -85,6 +102,8 @@ class JobMaster:
             name="node-monitor",
         )
         self._monitor_thread.start()
+        if self.auto_scaler is not None:
+            self.auto_scaler.start()
         logger.info("master %s serving on port %s", self.job_name, self.port)
 
     # ------------- failure detection -------------
@@ -147,12 +166,16 @@ class JobMaster:
                 logger.exception("node monitor iteration failed")
 
     def _evict_node(self, node_id: int, reason: str):
+        from dlrover_tpu.utils.tracing import get_tracer
+
+        get_tracer().instant("evict-node", node_id=node_id, reason=reason)
         logger.error("evicting node %s: %s", node_id, reason)
         self.job_manager.remove_node(node_id, reason)
         for mgr in self.rdzv_managers.values():
             mgr.remove_alive_node(node_id)
         self.task_manager.recover_worker_tasks(node_id)
         self.speed_monitor.remove_worker(node_id)
+        self.metric_collector.remove_node(node_id)
 
     def run(self, poll_interval: float = 1.0) -> int:
         """Block until the job finishes; returns an exit code."""
@@ -183,6 +206,8 @@ class JobMaster:
 
     def stop(self):
         self._stopped.set()
+        if self.auto_scaler is not None:
+            self.auto_scaler.stop()
         self._server.stop()
 
 

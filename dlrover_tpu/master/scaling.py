@@ -1,0 +1,283 @@
+"""Scaling stack: scaler backends, node watchers, the job auto-scaler
+and the local resource optimizer.
+
+Parity map (all condensed to the TPU/local platform model):
+
+- Scaler backends — reference ``master/scaler/pod_scaler.py:71,143`` /
+  ``elasticjob_scaler.py``: realize a ScalePlan against the platform.
+  ``ProcessScaler`` is the local backend (spawns/kills agent processes —
+  what a single-host elastic job actually scales);
+  ``ElasticJobScaler`` emits the ScalePlan as a CRD-style patch through
+  an injected client, the k8s-operator integration point (no cluster in
+  this environment, so the client is pluggable and faked in tests).
+- ``ProcessWatcher`` — reference ``watcher/k8s_watcher.py:151``: turns
+  platform state (here: child process liveness) into NodeEvents for the
+  job manager.
+- ``AllreduceAutoScaler`` — reference ``node/job_auto_scaler.py:254``
+  (``AllreduceTrainingAutoScaler``): periodically reconciles alive
+  workers against the target count and executes relaunch plans.
+- ``LocalResourceOptimizer`` — reference
+  ``resource/local_optimizer.py:66``: turns collected runtime stats into
+  a per-worker resource plan (the Brain-less local strategy).
+"""
+
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.common.periodic import PeriodicTask
+from dlrover_tpu.master.node_manager import ScalePlan, Scaler
+
+
+# ---------------------------------------------------------------- scalers
+
+
+class ProcessScaler(Scaler):
+    """Local platform backend: one agent process per node.
+
+    ``command_fn(node) -> argv`` builds the launch command (tests inject
+    trivial commands; the CLI integration passes a ``dlrover_tpu.cli``
+    invocation with the node's rank).
+    """
+
+    def __init__(self, command_fn: Callable[[Node], List[str]]):
+        self._command_fn = command_fn
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def scale(self, plan: ScalePlan):
+        for node in plan.remove_nodes:
+            self._kill(node.id)
+        for node in plan.launch_nodes:
+            self._launch(node)
+
+    def _launch(self, node: Node):
+        with self._lock:
+            existing = self._procs.get(node.id)
+        if existing is not None and existing.poll() is None:
+            logger.warning(
+                "scaler: node %s already running (pid %s); not relaunching",
+                node.id, existing.pid,
+            )
+            return
+        cmd = self._command_fn(node)
+        proc = subprocess.Popen(cmd, start_new_session=True)
+        with self._lock:
+            self._procs[node.id] = proc
+        logger.info("scaler launched node %s (pid %s)", node.id, proc.pid)
+
+    def _kill(self, node_id: int):
+        with self._lock:
+            proc = self._procs.pop(node_id, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            logger.info("scaler stopped node %s", node_id)
+
+    def alive_nodes(self) -> List[int]:
+        with self._lock:
+            return [
+                nid for nid, p in self._procs.items() if p.poll() is None
+            ]
+
+    def stop(self):
+        with self._lock:
+            ids = list(self._procs)
+        for nid in ids:
+            self._kill(nid)
+
+
+class ElasticJobScaler(Scaler):
+    """Operator integration point: a ScalePlan becomes a patch to the
+    ElasticJob resource (reference ``scaler/elasticjob_scaler.py``). The
+    ``client`` is any object with ``patch(body: dict)`` — the real k8s
+    client on a cluster, a fake in tests."""
+
+    def __init__(self, client, job_name: str):
+        self._client = client
+        self._job_name = job_name
+
+    def scale(self, plan: ScalePlan):
+        body = {
+            "job": self._job_name,
+            "replicas": {
+                group: {
+                    "replicas": res.count,
+                    "resource": {
+                        "cpu": res.node_resource.cpu,
+                        "memory_mb": res.node_resource.memory_mb,
+                    },
+                }
+                for group, res in plan.node_group_resources.items()
+            },
+            "launch": [n.id for n in plan.launch_nodes],
+            "remove": [n.id for n in plan.remove_nodes],
+        }
+        self._client.patch(body)
+        logger.info("elasticjob scaler patched: %s", body)
+
+
+# ---------------------------------------------------------------- watcher
+
+
+class ProcessWatcher:
+    """Turn local process liveness into node events (reference
+    ``watcher/k8s_watcher.py``: pod events -> NodeEvents)."""
+
+    def __init__(self, scaler: ProcessScaler, job_manager,
+                 interval: float = 1.0):
+        self._scaler = scaler
+        self._job_manager = job_manager
+        self._known_alive: set = set()
+        self._task = PeriodicTask(self._poll, interval, "process-watcher")
+
+    def _poll(self):
+        alive = set(self._scaler.alive_nodes())
+        for died in self._known_alive - alive:
+            logger.info("watcher: node %s process exited", died)
+            self._job_manager.update_node_status(died, "failed",
+                                                 "process-exit")
+        self._known_alive = alive
+
+    def list(self) -> List[int]:
+        return self._scaler.alive_nodes()
+
+    def start(self):
+        self._task.start()
+
+    def stop(self):
+        self._task.stop()
+
+
+# ------------------------------------------------------------- optimizer
+
+
+@dataclass
+class ResourcePlan:
+    """Per-worker resource suggestion (reference ResourcePlan, lean)."""
+
+    worker_cpu: float = 0.0
+    worker_memory_mb: int = 0
+    worker_num: int = 0
+
+    def empty(self) -> bool:
+        return not (self.worker_cpu or self.worker_memory_mb
+                    or self.worker_num)
+
+
+class LocalResourceOptimizer:
+    """Stats -> resource plan, no external service (reference
+    ``resource/local_optimizer.py``; the Brain-backed variant plugs in
+    through the same ``generate_plan`` interface)."""
+
+    # Headroom over observed peaks, matching the reference's factor-based
+    # sizing.
+    CPU_FACTOR = 1.5
+    MEM_FACTOR = 1.3
+
+    def __init__(self, metric_collector):
+        self._collector = metric_collector
+
+    def generate_plan(self, current_workers: int) -> ResourcePlan:
+        summary = self._collector.summary()
+        if not summary["nodes"]:
+            return ResourcePlan()
+        return ResourcePlan(
+            worker_cpu=round(summary["cpu_percent_avg"] / 100
+                             * self.CPU_FACTOR, 2),
+            worker_memory_mb=int(
+                summary["used_memory_mb_max"] * self.MEM_FACTOR
+            ),
+            worker_num=current_workers,
+        )
+
+
+# ------------------------------------------------------------ auto-scaler
+
+
+class AllreduceAutoScaler:
+    """Keep the worker fleet at target size; apply resource plans.
+
+    Reference ``node/job_auto_scaler.py:254-316``
+    (``AllreduceTrainingAutoScaler``): a periodic loop counting alive
+    workers and relaunching the difference through the scaler. Hang- and
+    death-driven *shrink* lives in the master's node monitor (scale-in
+    is membership removal); this loop owns *grow* and resource sizing.
+    """
+
+    # A freshly-launched node gets this long to register before it is
+    # presumed failed and relaunched (prevents duplicate launches while
+    # an agent is still rendezvousing).
+    LAUNCH_GRACE_S = 120.0
+
+    def __init__(self, job_manager, scaler: Scaler,
+                 resource_optimizer: Optional[LocalResourceOptimizer] = None,
+                 target_worker_num: Optional[int] = None,
+                 interval: float = 10.0):
+        self._job_manager = job_manager
+        self._scaler = scaler
+        self._optimizer = resource_optimizer
+        self._target = target_worker_num
+        self._pending_launches: Dict[int, float] = {}  # node id -> time
+        self._last_resource_plan: Optional[ResourcePlan] = None
+        self._task = PeriodicTask(self._reconcile, interval, "auto-scaler")
+
+    def start(self):
+        self._task.start()
+
+    def stop(self):
+        self._task.stop()
+
+    def _reconcile(self):
+        now = time.time()
+        nodes = {n.id: n for n in self._job_manager.all_nodes()}
+        # A pending launch counts toward the target until it registers or
+        # its grace expires — otherwise every tick relaunches the same
+        # slot and orphans the still-rendezvousing process.
+        self._pending_launches = {
+            nid: t for nid, t in self._pending_launches.items()
+            if nid not in nodes and now - t < self.LAUNCH_GRACE_S
+        }
+        target = self._target if self._target is not None else len(nodes)
+        alive = [n for n in nodes.values() if not n.exited()]
+        missing = target - len(alive) - len(self._pending_launches)
+        if missing > 0:
+            used = set(nodes) | set(self._pending_launches)
+            launch = []
+            next_id = 0
+            for _ in range(missing):
+                while next_id in used:
+                    next_id += 1
+                used.add(next_id)
+                launch.append(Node("worker", next_id))
+                self._pending_launches[next_id] = now
+            plan = ScalePlan(launch_nodes=launch)
+            logger.info("auto-scaler: %s alive < target %s; launching %s",
+                        len(alive), target, [n.id for n in launch])
+            self._scaler.scale(plan)
+        if self._optimizer is not None:
+            rplan = self._optimizer.generate_plan(target)
+            if not rplan.empty() and rplan != self._last_resource_plan:
+                self._last_resource_plan = rplan
+                self.execute_resource_plan(rplan)
+
+    def execute_resource_plan(self, rplan: ResourcePlan):
+        from dlrover_tpu.common.node import NodeGroupResource
+
+        plan = ScalePlan(node_group_resources={
+            "worker": NodeGroupResource(
+                count=rplan.worker_num,
+                node_resource=NodeResource(
+                    cpu=rplan.worker_cpu,
+                    memory_mb=rplan.worker_memory_mb,
+                ),
+            )
+        })
+        self._scaler.scale(plan)

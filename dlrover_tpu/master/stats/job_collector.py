@@ -72,6 +72,12 @@ class JobMetricCollector:
         with self._lock:
             self._custom[key] = value
 
+    def remove_node(self, node_id: int):
+        """Forget an evicted node: its peaks must not skew the strategy
+        generator / resource optimizer forever."""
+        with self._lock:
+            self._node_samples.pop(node_id, None)
+
     # ------------- outputs -------------
     def node_resource(self, node_id: int) -> Optional[ResourceSample]:
         with self._lock:

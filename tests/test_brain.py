@@ -1,0 +1,76 @@
+"""Brain service tests (SURVEY §2.7 / Lx offline optimizer)."""
+
+import pytest
+
+from dlrover_tpu.brain import BrainClient, BrainResourceOptimizer, BrainService
+from dlrover_tpu.brain.client import BrainReporter
+from dlrover_tpu.common.messages import NodeResourceStats
+from dlrover_tpu.master.stats import JobMetricCollector
+
+
+@pytest.fixture
+def brain(tmp_path):
+    svc = BrainService(port=0, store_path=str(tmp_path / "brain.json"))
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+class TestBrainService:
+    def test_persist_and_optimize(self, brain):
+        client = BrainClient(brain.addr)
+        for mem in (1000, 1100, 1200, 5000):
+            client.persist_metrics(
+                "job-a", "node_resource", {"memory_mb": mem, "cpu": 150.0}
+            )
+        plan = client.get_optimization_plan("job-a")
+        # p95 over [1000,1100,1200,5000] -> 1200 * 1.2
+        assert plan["worker_memory_mb"] == 1440
+        assert plan["samples"] == 4
+        assert client.get_optimization_plan("unknown-job") == {}
+        client.close()
+
+    def test_store_survives_restart(self, brain, tmp_path):
+        client = BrainClient(brain.addr)
+        client.persist_metrics(
+            "job-b", "node_resource", {"memory_mb": 2000, "cpu": 100.0}
+        )
+        client.close()
+        brain.stop()  # saves
+
+        revived = BrainService(port=0, store_path=str(tmp_path / "brain.json"))
+        revived.start()
+        try:
+            c2 = BrainClient(revived.addr)
+            plan = c2.get_optimization_plan("job-b")
+            assert plan["worker_memory_mb"] == 2400
+            c2.close()
+        finally:
+            revived.stop()
+
+    def test_collector_sink_feeds_brain(self, brain):
+        collector = JobMetricCollector()
+        client = BrainClient(brain.addr)
+        collector.add_sink(BrainReporter(client, "job-c"))
+        collector.collect_node_resource(
+            NodeResourceStats(node_id=0, cpu_percent=80.0,
+                              used_memory_mb=512)
+        )
+        plan = client.get_optimization_plan("job-c")
+        assert plan["samples"] == 1
+        assert plan["worker_memory_mb"] == int(512 * 1.2)
+        client.close()
+
+    def test_brain_resource_optimizer(self, brain):
+        client = BrainClient(brain.addr)
+        client.persist_metrics(
+            "job-d", "node_resource", {"memory_mb": 4096, "cpu": 200.0}
+        )
+        opt = BrainResourceOptimizer(client, "job-d")
+        plan = opt.generate_plan(current_workers=3)
+        assert plan.worker_num == 3
+        assert plan.worker_memory_mb == int(4096 * 1.2)
+        # Unreachable brain degrades to an empty plan, not a crash.
+        client.close()
+        dead = BrainResourceOptimizer(BrainClient("127.0.0.1:1"), "job-d")
+        assert dead.generate_plan(1).empty()

@@ -1,0 +1,155 @@
+"""Scaling stack tests: scalers, watcher, auto-scaler, resource
+optimizer (SURVEY §2.2 scalers/watchers/auto-scaler/optimizer)."""
+
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.common.messages import NodeResourceStats
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.node_manager import LocalJobManager, ScalePlan
+from dlrover_tpu.master.scaling import (
+    AllreduceAutoScaler,
+    ElasticJobScaler,
+    LocalResourceOptimizer,
+    ProcessScaler,
+    ProcessWatcher,
+    ResourcePlan,
+)
+from dlrover_tpu.master.stats import JobMetricCollector
+
+
+def sleep_cmd(node):
+    return [sys.executable, "-c", "import time; time.sleep(60)"]
+
+
+class TestProcessScaler:
+    def test_launch_and_remove(self):
+        scaler = ProcessScaler(sleep_cmd)
+        try:
+            scaler.scale(ScalePlan(launch_nodes=[Node("worker", 0),
+                                                 Node("worker", 1)]))
+            assert sorted(scaler.alive_nodes()) == [0, 1]
+            scaler.scale(ScalePlan(remove_nodes=[Node("worker", 0)]))
+            assert scaler.alive_nodes() == [1]
+        finally:
+            scaler.stop()
+        assert scaler.alive_nodes() == []
+
+
+class TestProcessWatcher:
+    def test_death_reported_to_job_manager(self):
+        scaler = ProcessScaler(
+            lambda n: [sys.executable, "-c", "pass"]  # exits immediately
+        )
+        jm = LocalJobManager(node_num=1)
+        watcher = ProcessWatcher(scaler, jm, interval=0.1)
+        try:
+            scaler.scale(ScalePlan(launch_nodes=[Node("worker", 0)]))
+            watcher._poll()  # sees it alive (or already dead)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                watcher._poll()
+                node = jm.get_node(0)
+                if node is not None and node.status == "failed":
+                    break
+                time.sleep(0.05)
+            assert jm.get_node(0).status == "failed"
+        finally:
+            watcher.stop()
+            scaler.stop()
+
+
+class RecordingScaler:
+    def __init__(self):
+        self.plans = []
+
+    def scale(self, plan):
+        self.plans.append(plan)
+
+
+class TestAutoScaler:
+    def test_relaunches_missing_workers(self):
+        jm = LocalJobManager(node_num=3)
+        jm.update_node_status(2, "failed", "oom")
+        jm.get_node(2).relaunchable = False
+        scaler = RecordingScaler()
+        auto = AllreduceAutoScaler(jm, scaler, target_worker_num=3,
+                                   interval=60)
+        auto._reconcile()
+        launch_plans = [p for p in scaler.plans if p.launch_nodes]
+        assert launch_plans, "no relaunch plan produced"
+        # A fresh id (not colliding with 0..2) is assigned.
+        assert launch_plans[0].launch_nodes[0].id == 3
+
+    def test_no_plan_when_at_target(self):
+        jm = LocalJobManager(node_num=2)
+        scaler = RecordingScaler()
+        auto = AllreduceAutoScaler(jm, scaler, target_worker_num=2,
+                                   interval=60)
+        auto._reconcile()
+        assert not [p for p in scaler.plans if p.launch_nodes]
+
+    def test_resource_plan_executed(self):
+        collector = JobMetricCollector()
+        collector.collect_node_resource(
+            NodeResourceStats(node_id=0, cpu_percent=200.0,
+                              used_memory_mb=1000)
+        )
+        jm = LocalJobManager(node_num=1)
+        scaler = RecordingScaler()
+        auto = AllreduceAutoScaler(
+            jm, scaler, resource_optimizer=LocalResourceOptimizer(collector),
+            target_worker_num=1, interval=60,
+        )
+        auto._reconcile()
+        res_plans = [p for p in scaler.plans if p.node_group_resources]
+        assert res_plans
+        group = res_plans[0].node_group_resources["worker"]
+        assert group.node_resource.memory_mb == 1300  # peak * 1.3
+
+
+class TestLocalResourceOptimizer:
+    def test_empty_without_stats(self):
+        opt = LocalResourceOptimizer(JobMetricCollector())
+        assert opt.generate_plan(2).empty()
+
+    def test_plan_from_stats(self):
+        collector = JobMetricCollector()
+        collector.collect_node_resource(
+            NodeResourceStats(node_id=0, cpu_percent=150.0,
+                              used_memory_mb=2048)
+        )
+        plan = LocalResourceOptimizer(collector).generate_plan(4)
+        assert plan.worker_num == 4
+        assert plan.worker_cpu == pytest.approx(2.25)  # 1.5 cores * 1.5
+        assert plan.worker_memory_mb == int(2048 * 1.3)
+
+
+class TestElasticJobScaler:
+    def test_patch_body(self):
+        class FakeClient:
+            def __init__(self):
+                self.bodies = []
+
+            def patch(self, body):
+                self.bodies.append(body)
+
+        client = FakeClient()
+        scaler = ElasticJobScaler(client, "job-x")
+        from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+
+        scaler.scale(ScalePlan(
+            node_group_resources={
+                "worker": NodeGroupResource(
+                    count=4,
+                    node_resource=NodeResource(cpu=2.0, memory_mb=8192),
+                )
+            },
+            launch_nodes=[Node("worker", 5)],
+        ))
+        body = client.bodies[0]
+        assert body["job"] == "job-x"
+        assert body["replicas"]["worker"]["replicas"] == 4
+        assert body["launch"] == [5]
