@@ -128,24 +128,68 @@ def _check_spec_axes_used(spec, abstract_state):
 
 
 def make_train_step(module, optimizer, loss, mesh, rules,
-                    shardings, batch_sharding, donate: bool = True):
-    """Assemble the jitted SPMD train step for a given strategy."""
+                    shardings, batch_sharding, donate: bool = True,
+                    grad_accum: int = 1):
+    """Assemble the jitted SPMD train step for a given strategy.
+
+    ``grad_accum > 1`` splits the leading batch dim into that many
+    microbatches and accumulates gradients over a ``lax.scan`` before the
+    optimizer update — one compiled computation, activation memory of a
+    single microbatch (the ElasticTrainer's world-size-change lever).
+    """
     import jax
     import flax.linen as nn
+
+    def grads_of(params, batch):
+        def scalar_loss(p):
+            return loss(module, p, batch)
+
+        return jax.value_and_grad(scalar_loss)(params)
 
     def step(state, batch):
         # The mesh context makes the mesh discoverable at trace time
         # (thread_resources) — ops like ring attention shard_map over it.
         with mesh, nn.logical_axis_rules(list(rules)):
-            def scalar_loss(params):
-                return loss(module, params, batch)
+            import optax
 
-            lv, grads = jax.value_and_grad(scalar_loss)(state["params"])
+            if grad_accum > 1:
+                import jax.numpy as jnp
+
+                b = batch.shape[0]
+                if b % grad_accum:
+                    raise ValueError(
+                        f"batch {b} not divisible by grad_accum "
+                        f"{grad_accum}"
+                    )
+                micro = batch.reshape(
+                    grad_accum, b // grad_accum, *batch.shape[1:]
+                )
+
+                def body(carry, mb):
+                    loss_sum, g_sum = carry
+                    lv, g = grads_of(state["params"], mb)
+                    return (
+                        loss_sum + lv,
+                        jax.tree_util.tree_map(
+                            lambda a, c: a + c, g_sum, g
+                        ),
+                    ), None
+
+                zero = jax.tree_util.tree_map(
+                    jnp.zeros_like, state["params"]
+                )
+                (loss_sum, g_sum), _ = jax.lax.scan(
+                    body, (jnp.zeros(()), zero), micro
+                )
+                lv = loss_sum / grad_accum
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / grad_accum, g_sum
+                )
+            else:
+                lv, grads = grads_of(state["params"], batch)
             updates, opt_state = optimizer.update(
                 grads, state["opt"], state["params"]
             )
-            import optax
-
             params = optax.apply_updates(state["params"], updates)
             new_state = {
                 "params": params, "opt": opt_state,
@@ -172,6 +216,7 @@ def auto_accelerate(
     profile: bool = False,
     profile_steps: int = 3,
     allow_tensor: bool = False,
+    grad_accum: int = 1,
 ) -> AccelerateResult:
     """Analyze → choose strategy → build sharded state + train step.
 
@@ -217,7 +262,8 @@ def auto_accelerate(
         )
         state = materialize(rng)
         train_step = make_train_step(
-            module, optimizer, loss, mesh, rules, shardings, batch_sharding
+            module, optimizer, loss, mesh, rules, shardings,
+            batch_sharding, grad_accum=grad_accum,
         )
         return AccelerateResult(
             spec=sp, mesh=mesh, rules=rules, state=state,

@@ -147,11 +147,13 @@ class ElasticTrainingAgent:
 
     def _save_shm_to_storage(self):
         from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+        from dlrover_tpu.utils.tracing import get_tracer
 
         saver = AsyncCheckpointSaver.get_ckpt_saver()
         if saver is not None:
             try:
-                saver.save_shm_to_storage()
+                with get_tracer().span("ckpt-crash-flush"):
+                    saver.save_shm_to_storage()
             except Exception:
                 logger.exception("flash-checkpoint crash flush failed")
 
@@ -179,13 +181,20 @@ class ElasticTrainingAgent:
             self._client, interval=interval
         )
         self._resource_monitor.start()
-        metrics_path = os.getenv(ConfigPath.ENV_RUNTIME_METRICS, "")
-        self._training_monitor = None
-        if metrics_path:
-            self._training_monitor = TrainingMonitor(
-                metrics_path, self._client
+        # Workers drop per-step metrics here (train.report_training_metrics)
+        # and the monitor forwards them — a job-unique default so stock
+        # deployments get the liveness channel without any configuration.
+        self._metrics_path = os.getenv(ConfigPath.ENV_RUNTIME_METRICS) or (
+            os.path.join(
+                ConfigPath.ROOT,
+                f"runtime_metrics_{self._config.job_name}"
+                f"_n{self._config.node_rank}.jsonl",
             )
-            self._training_monitor.start()
+        )
+        self._training_monitor = TrainingMonitor(
+            self._metrics_path, self._client
+        )
+        self._training_monitor.start()
         # The tuner loop only runs when auto-tuning is enabled (same gate
         # as the master's strategy generator): with it off, polling every
         # few seconds and pointing workers at a never-written file would
@@ -235,10 +244,18 @@ class ElasticTrainingAgent:
                 logger.info("membership changed; re-forming rendezvous")
             elif result == "stopped":
                 return 1
+            from dlrover_tpu.utils.tracing import get_tracer
+
+            get_tracer().instant(
+                f"workers-{result}", restart=self._restart_count
+            )
+            get_tracer().export()  # no-op unless DLROVER_TPU_TRACE_FILE
         self._client.report_node_status(NodeStatus.FAILED, "fatal-error")
         return 1
 
     def _rendezvous(self) -> RendezvousOutcome:
+        from dlrover_tpu.utils.tracing import get_tracer
+
         handler = MasterRendezvousHandler(
             self._client,
             RendezvousName.TRAINING,
@@ -246,7 +263,11 @@ class ElasticTrainingAgent:
             self._config.nproc_per_node,
             self._config.rdzv_timeout,
         )
-        outcome = handler.next_rendezvous()
+        with get_tracer().span(
+            "rendezvous", node_rank=self._config.node_rank,
+            restart=self._restart_count,
+        ):
+            outcome = handler.next_rendezvous()
         logger.info(
             "rendezvous round %s: %s nodes, world size %s, coordinator %s",
             outcome.round, outcome.num_nodes, outcome.world_size,
@@ -263,6 +284,8 @@ class ElasticTrainingAgent:
             # Workers hot-reload the tuned parallel config from this file
             # (ElasticDataLoader.load_config).
             env[ConfigPath.ENV_PARAL_CONFIG] = self._config_tuner.path
+        if getattr(self, "_metrics_path", ""):
+            env[ConfigPath.ENV_RUNTIME_METRICS] = self._metrics_path
         env.update(
             {
                 NodeEnv.JOB_NAME: self._config.job_name,

@@ -42,7 +42,10 @@ class ResourceMonitor:
 
     def _tree_stats(self) -> Dict:
         """CPU% and RSS of the agent's process tree (agent + workers)."""
-        import psutil
+        try:
+            import psutil
+        except ImportError:  # monitoring is best-effort, never fatal
+            return {"cpu_percent": 0.0, "used_memory_mb": 0}
 
         try:
             root = self._procs.get(self._pid)
@@ -114,6 +117,8 @@ class TrainingMonitor:
     def report_once(self):
         if not os.path.exists(self._path):
             return
+        if os.path.getsize(self._path) < self._offset:
+            self._offset = 0  # file was rotated: re-tail from the start
         with open(self._path) as f:
             f.seek(self._offset)
             lines = f.readlines()
@@ -133,3 +138,10 @@ class TrainingMonitor:
             self._client.report_global_step(
                 int(newest["step"]), float(newest.get("timestamp", 0.0))
             )
+            # Workers may attach device stats (the agent process holds no
+            # TPU client, so this is the only channel for them).
+            if newest.get("device_stats"):
+                self._client.report_resource_stats(
+                    cpu_percent=0.0, used_memory_mb=0,
+                    device_stats=newest["device_stats"],
+                )

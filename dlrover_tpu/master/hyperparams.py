@@ -27,6 +27,12 @@ _GROW_BELOW = 0.3
 _MAX_SCALE = 4
 
 
+def _host_memory_mb() -> int:
+    import psutil
+
+    return psutil.virtual_memory().total // (1024 * 1024)
+
+
 class SimpleStrategyGenerator:
     """Stats in, ParallelConfig out (None = no change recommended)."""
 

@@ -1,0 +1,3 @@
+from dlrover_tpu.sparse.kv_variable import KvVariable, SparseAdam
+
+__all__ = ["KvVariable", "SparseAdam"]

@@ -1,0 +1,213 @@
+"""KvVariable — dynamically-growing sparse embedding storage.
+
+Capability parity with tfplus's KvVariable
+(``tfplus/tfplus/kv_variable/python/ops/kv_variable_ops.py``: a
+hash-table-backed embedding variable — arbitrary int64 keys, lazy
+allocation, growth, per-key optimizer slots, full export/import for
+checkpoints). The tfplus version is a C++ custom op around a concurrent
+hash map; that design cannot work on TPU, where every device computation
+needs static shapes.
+
+TPU-first split of the same capability:
+
+- **device**: one dense ``[capacity, dim]`` table (plus same-shape
+  optimizer slot tables). Lookups are gathers and updates are scatters
+  with *slot indices* — static-shape ops that jit and shard like any
+  other array (shard the capacity dim over ``data``/``fsdp`` for a
+  distributed embedding).
+- **host**: the id -> slot hash map (a plain dict — the control-plane
+  side of the hash table). Unseen ids allocate slots at lookup time;
+  when capacity runs out the table *grows* by doubling: a host-side
+  re-pad, after which the jitted gather/scatter recompile once for the
+  new capacity (amortized O(log n) recompiles over a job's life).
+
+Checkpoint: ``export()`` returns ``(ids, values)`` of live rows only;
+``import_()`` rebuilds the map — world-size independent, so a restore
+can reshard/repartition keys freely.
+"""
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+
+
+class KvVariable:
+    """Sparse embedding: arbitrary int ids -> [dim] rows, grow-on-demand."""
+
+    def __init__(
+        self,
+        dim: int,
+        capacity: int = 1024,
+        dtype=jnp.float32,
+        initializer: Optional[Callable] = None,
+        seed: int = 0,
+    ):
+        if capacity <= 0 or dim <= 0:
+            raise ValueError("capacity and dim must be positive")
+        self.dim = dim
+        self.dtype = dtype
+        self._initializer = initializer or (
+            lambda key, shape, dtype: jax.random.normal(key, shape, dtype)
+            * 0.01
+        )
+        self._key = jax.random.PRNGKey(seed)
+        self._capacity = capacity
+        self._slots: Dict[int, int] = {}     # id -> slot
+        self._next_slot = 0
+        self.table = self._init_rows(capacity)
+
+    # ------------- internals -------------
+    def _init_rows(self, n: int):
+        self._key, sub = jax.random.split(self._key)
+        return self._initializer(sub, (n, self.dim), self.dtype)
+
+    def _grow(self, need: int):
+        new_cap = self._capacity
+        while new_cap < need:
+            new_cap *= 2
+        fresh = self._init_rows(new_cap - self._capacity)
+        self.table = jnp.concatenate([self.table, fresh], axis=0)
+        logger.info("KvVariable grew %s -> %s slots",
+                    self._capacity, new_cap)
+        self._capacity = new_cap
+
+    # ------------- lookup / update -------------
+    def to_slots(self, ids, allocate: bool = True) -> np.ndarray:
+        """Map ids -> slot indices (host side). ``allocate=True`` admits
+        unseen ids (training); ``False`` maps them to slot 0 with a
+        zero-mask expectation (inference on unknown keys returns the
+        default row)."""
+        ids = np.asarray(ids).reshape(-1)
+        out = np.empty(ids.shape, np.int32)
+        for i, raw in enumerate(ids):
+            key = int(raw)
+            slot = self._slots.get(key)
+            if slot is None:
+                if not allocate:
+                    out[i] = 0
+                    continue
+                if self._next_slot >= self._capacity:
+                    self._grow(self._next_slot + 1)
+                slot = self._next_slot
+                self._slots[key] = slot
+                self._next_slot += 1
+            out[i] = slot
+        return out
+
+    def lookup(self, ids, allocate: bool = True):
+        """Gather rows for ids; shape ``ids.shape + (dim,)``."""
+        ids = np.asarray(ids)
+        slots = self.to_slots(ids, allocate=allocate)
+        rows = jnp.take(self.table, jnp.asarray(slots), axis=0)
+        return rows.reshape(*ids.shape, self.dim)
+
+    def scatter_update(self, ids, rows):
+        """Overwrite the rows of ids (ids must be known)."""
+        slots = self.to_slots(ids, allocate=True)
+        self.table = self.table.at[jnp.asarray(slots)].set(
+            jnp.asarray(rows).reshape(len(slots), self.dim)
+        )
+
+    def apply_row_grads(self, ids, grads, lr: float):
+        """SGD on the touched rows only: duplicate ids accumulate
+        (scatter-add semantics, matching dense embedding gradients)."""
+        slots = jnp.asarray(self.to_slots(ids, allocate=True))
+        g = jnp.asarray(grads).reshape(len(slots), self.dim)
+        self.table = self.table.at[slots].add(-lr * g)
+
+    # ------------- introspection / checkpoint -------------
+    @property
+    def size(self) -> int:
+        return len(self._slots)
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def export(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(ids, values) of live rows — the checkpoint payload."""
+        if not self._slots:
+            return np.zeros(0, np.int64), np.zeros(
+                (0, self.dim), np.dtype(self.table.dtype)
+            )
+        ids = np.fromiter(self._slots.keys(), np.int64, len(self._slots))
+        slots = np.fromiter(self._slots.values(), np.int64,
+                            len(self._slots))
+        values = np.asarray(jnp.take(
+            self.table, jnp.asarray(slots), axis=0
+        ))
+        return ids, values
+
+    def import_(self, ids, values):
+        """Rebuild from an export (capacity re-derived, map rebuilt)."""
+        ids = np.asarray(ids).reshape(-1)
+        values = np.asarray(values).reshape(len(ids), self.dim)
+        self._slots = {int(k): i for i, k in enumerate(ids)}
+        self._next_slot = len(ids)
+        cap = self._capacity
+        while cap < max(1, len(ids)):
+            cap *= 2
+        self._capacity = cap
+        self.table = self._init_rows(cap)
+        if len(ids):
+            self.table = self.table.at[jnp.arange(len(ids))].set(
+                jnp.asarray(values, self.table.dtype)
+            )
+
+
+class SparseAdam:
+    """Adam over a KvVariable's touched rows (per-key optimizer slots —
+    the tfplus slot-variable analog; m/v live in same-capacity tables)."""
+
+    def __init__(self, var: KvVariable, lr: float = 1e-3, b1: float = 0.9,
+                 b2: float = 0.999, eps: float = 1e-8):
+        self.var = var
+        self.lr, self.b1, self.b2, self.eps = lr, b1, b2, eps
+        self._m = jnp.zeros_like(var.table)
+        self._v = jnp.zeros_like(var.table)
+        self._counts = jnp.zeros((var.capacity,), jnp.int32)
+
+    def _sync_capacity(self):
+        cap = self.var.capacity
+        if self._m.shape[0] < cap:
+            pad = cap - self._m.shape[0]
+            self._m = jnp.concatenate(
+                [self._m, jnp.zeros((pad, self.var.dim), self._m.dtype)]
+            )
+            self._v = jnp.concatenate(
+                [self._v, jnp.zeros((pad, self.var.dim), self._v.dtype)]
+            )
+            self._counts = jnp.concatenate(
+                [self._counts, jnp.zeros((pad,), jnp.int32)]
+            )
+
+    def update(self, ids, grads):
+        """Per-key bias-corrected Adam step on the touched rows.
+
+        Duplicate ids in a batch are first segment-summed into one
+        gradient per key (dense-embedding semantics); each key then takes
+        exactly one Adam step."""
+        slots_np = self.var.to_slots(ids, allocate=True)
+        self._sync_capacity()
+        g = jnp.asarray(grads).reshape(len(slots_np), self.var.dim)
+        uniq, inverse = np.unique(slots_np, return_inverse=True)
+        g = jax.ops.segment_sum(
+            g, jnp.asarray(inverse), num_segments=len(uniq)
+        )
+        slots = jnp.asarray(uniq)
+        # Per-key step counts drive per-key bias correction (sparse keys
+        # are each on their own schedule — the kv-optimizer semantic).
+        self._counts = self._counts.at[slots].add(1)
+        t = self._counts[slots].astype(jnp.float32)[:, None]
+        m = self.b1 * self._m[slots] + (1 - self.b1) * g
+        v = self.b2 * self._v[slots] + (1 - self.b2) * g * g
+        self._m = self._m.at[slots].set(m)
+        self._v = self._v.at[slots].set(v)
+        mhat = m / (1 - self.b1 ** t)
+        vhat = v / (1 - self.b2 ** t)
+        delta = -self.lr * mhat / (jnp.sqrt(vhat) + self.eps)
+        self.var.table = self.var.table.at[slots].add(delta)

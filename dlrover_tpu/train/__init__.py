@@ -70,7 +70,19 @@ def report_training_metrics(step: int, **extra):
     rec = {"step": int(step), "timestamp": _time.time(), **extra}
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # Rotate: the monitor tails by offset and resets on shrink, so a
+        # multi-million-step job must not grow the file without bound.
+        try:
+            if os.path.getsize(path) > 16 * 1024 * 1024:
+                with open(path, "w") as f:
+                    f.write(json.dumps(rec) + "\n")
+                return
+        except OSError:
+            pass
         with open(path, "a") as f:
             f.write(json.dumps(rec) + "\n")
     except OSError as e:
         logger.warning("failed to write training metrics: %s", e)
+
+
+from dlrover_tpu.train.elastic_trainer import ElasticTrainer  # noqa: E402,F401

@@ -1,0 +1,80 @@
+"""ElasticTrainer — fixed global batch under world-size changes.
+
+Parity: reference ``dlrover/trainer/torch/elastic/trainer.py``
+(``ElasticTrainer``: wraps model/optimizer, tracks
+``gradient_accumulation_steps = global_batch / (micro_batch * world)`` so
+a job restarted at a different world size keeps the same effective batch
+and learning dynamics). The torch version intercepts optimizer.step and
+no_sync windows; the TPU version compiles the accumulation INTO the jitted
+train step (``lax.scan`` over microbatches in
+``accel.make_train_step(grad_accum=...)``), so one call = one optimizer
+update at the full global batch regardless of world size.
+
+Usage::
+
+    trainer = ElasticTrainer(global_batch_size=512, micro_batch_size=8)
+    result = trainer.prepare(model, optimizer, sample_micro_batch,
+                             token_loss, spec=ParallelSpec(data=8))
+    # per call: feed accum_steps * micro_batch_size samples
+    state, metrics = result.train_step(state, local_batch)
+"""
+
+import os
+from typing import Any, Callable, Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import logger
+
+
+class ElasticTrainer:
+    def __init__(self, global_batch_size: int,
+                 micro_batch_size: int,
+                 world_size: Optional[int] = None):
+        if global_batch_size % micro_batch_size:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"micro batch {micro_batch_size}"
+            )
+        self.global_batch_size = global_batch_size
+        self.micro_batch_size = micro_batch_size
+        self.world_size = world_size or int(
+            os.getenv(NodeEnv.NUM_PROCESSES, "1")
+        )
+        per_world = global_batch_size // self.world_size
+        if per_world % micro_batch_size:
+            raise ValueError(
+                f"per-process batch {per_world} not divisible by "
+                f"micro batch {micro_batch_size} at world size "
+                f"{self.world_size}"
+            )
+        self.accum_steps = max(1, per_world // micro_batch_size)
+        logger.info(
+            "elastic trainer: global batch %s = micro %s x world %s x "
+            "accum %s", global_batch_size, micro_batch_size,
+            self.world_size, self.accum_steps,
+        )
+
+    @property
+    def local_batch_size(self) -> int:
+        """Samples this process feeds per train-step call."""
+        return self.micro_batch_size * self.accum_steps
+
+    def prepare(self, module, optimizer, sample_micro_batch,
+                loss: Callable, spec: Any = "auto", **accel_kwargs):
+        """Build the accumulating sharded train step via auto_accelerate.
+
+        ``sample_micro_batch`` is ONE microbatch; the returned
+        ``result.train_step`` takes ``local_batch_size`` samples.
+        """
+        import numpy as np
+
+        from dlrover_tpu.accel import auto_accelerate
+
+        sample_local = np.repeat(
+            np.asarray(sample_micro_batch),
+            self.accum_steps, axis=0,
+        ) if self.accum_steps > 1 else sample_micro_batch
+        return auto_accelerate(
+            module, optimizer, sample_local, loss, spec=spec,
+            grad_accum=self.accum_steps, **accel_kwargs,
+        )

@@ -1,0 +1,110 @@
+"""ElasticTrainer + gradient-accumulation tests (SURVEY §2.4)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.accel import ParallelSpec, auto_accelerate
+from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+from dlrover_tpu.train.elastic_trainer import ElasticTrainer
+
+
+def tiny_cfg():
+    return dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)
+
+
+def token_loss(module, params, batch):
+    return loss_fn(module.apply({"params": params}, batch), batch)
+
+
+class TestGradAccum:
+    def test_accum_matches_full_batch(self):
+        """grad_accum=4 over a 16-sample batch must train identically to
+        one full-batch step (mean-of-means == full mean)."""
+        cfg = tiny_cfg()
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (16, 16), 0, cfg.vocab_size
+        )
+
+        def run(accum):
+            res = auto_accelerate(
+                GPT(cfg), optax.adamw(1e-3), tokens, token_loss,
+                spec=ParallelSpec(), grad_accum=accum,
+            )
+            state = res.state
+            losses = []
+            for _ in range(3):
+                state, m = res.train_step(state, tokens)
+                losses.append(float(m["loss"]))
+            return losses
+
+        np.testing.assert_allclose(run(1), run(4), rtol=2e-5, atol=2e-5)
+
+    def test_bad_divisibility_raises(self):
+        cfg = tiny_cfg()
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (6, 16), 0, cfg.vocab_size
+        )
+        res = auto_accelerate(
+            GPT(cfg), optax.adamw(1e-3), tokens, token_loss,
+            spec=ParallelSpec(), grad_accum=4,
+        )
+        with pytest.raises(Exception):
+            jax.block_until_ready(res.train_step(res.state, tokens))
+
+
+class TestElasticTrainer:
+    def test_accum_retunes_with_world_size(self):
+        """The invariant: global batch stays fixed across world sizes."""
+        for world, expect_accum in ((1, 8), (2, 4), (4, 2), (8, 1)):
+            t = ElasticTrainer(
+                global_batch_size=64, micro_batch_size=8, world_size=world
+            )
+            assert t.accum_steps == expect_accum
+            assert (
+                t.local_batch_size * world == 64
+            ), "global batch drifted on resize"
+
+    def test_world_from_env(self, monkeypatch):
+        from dlrover_tpu.common.constants import NodeEnv
+
+        monkeypatch.setenv(NodeEnv.NUM_PROCESSES, "2")
+        t = ElasticTrainer(global_batch_size=32, micro_batch_size=4)
+        assert t.world_size == 2 and t.accum_steps == 4
+
+    def test_invalid_configs_raise(self):
+        with pytest.raises(ValueError):
+            ElasticTrainer(global_batch_size=10, micro_batch_size=3)
+        with pytest.raises(ValueError):
+            ElasticTrainer(global_batch_size=16, micro_batch_size=3,
+                           world_size=2)
+
+    def test_prepare_trains(self):
+        cfg = tiny_cfg()
+        micro = jax.random.randint(
+            jax.random.PRNGKey(2), (4, 16), 0, cfg.vocab_size
+        )
+        trainer = ElasticTrainer(
+            global_batch_size=16, micro_batch_size=4, world_size=1
+        )
+        assert trainer.accum_steps == 4
+        res = trainer.prepare(
+            GPT(cfg), optax.adamw(1e-3), micro, token_loss,
+            spec=ParallelSpec(data=2),
+        )
+        batch = jax.random.randint(
+            jax.random.PRNGKey(3), (trainer.local_batch_size, 16), 0,
+            cfg.vocab_size,
+        )
+        state = res.state
+        losses = []
+        for _ in range(4):
+            state, m = res.train_step(
+                state, jax.device_put(batch, res.batch_sharding)
+            )
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]

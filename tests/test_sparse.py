@@ -1,0 +1,97 @@
+"""KvVariable sparse embedding tests (SURVEY §2.6)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.sparse import KvVariable, SparseAdam
+
+
+class TestKvVariable:
+    def test_lookup_allocates_and_is_stable(self):
+        var = KvVariable(dim=4, capacity=8, seed=1)
+        ids = np.array([1001, 42, 1001])
+        rows = np.asarray(var.lookup(ids))
+        assert rows.shape == (3, 4)
+        np.testing.assert_array_equal(rows[0], rows[2])  # same id, same row
+        assert var.size == 2
+        # A second lookup returns identical rows.
+        np.testing.assert_array_equal(
+            np.asarray(var.lookup(np.array([42]))), rows[1:2]
+        )
+
+    def test_growth_beyond_capacity(self):
+        var = KvVariable(dim=2, capacity=4)
+        var.lookup(np.arange(100))
+        assert var.size == 100
+        assert var.capacity >= 100
+        assert var.table.shape[0] == var.capacity
+
+    def test_unknown_id_without_allocate(self):
+        var = KvVariable(dim=2, capacity=4)
+        var.lookup(np.array([7]))
+        before = var.size
+        var.lookup(np.array([8, 9]), allocate=False)
+        assert var.size == before  # inference never grows the table
+
+    def test_batched_shape(self):
+        var = KvVariable(dim=3, capacity=16)
+        out = var.lookup(np.arange(6).reshape(2, 3))
+        assert out.shape == (2, 3, 3)
+
+    def test_row_grads_accumulate_duplicates(self):
+        var = KvVariable(
+            dim=2, capacity=4,
+            initializer=lambda k, s, d: jnp.zeros(s, d),
+        )
+        ids = np.array([5, 5])
+        grads = np.ones((2, 2))
+        var.apply_row_grads(ids, grads, lr=0.1)
+        row = np.asarray(var.lookup(np.array([5])))[0]
+        np.testing.assert_allclose(row, -0.2 * np.ones(2), atol=1e-6)
+
+    def test_export_import_roundtrip(self):
+        var = KvVariable(dim=3, capacity=4, seed=3)
+        var.lookup(np.array([10, 20, 30, 40, 50]))  # forces growth too
+        ids, values = var.export()
+        assert len(ids) == 5
+
+        fresh = KvVariable(dim=3, capacity=2, seed=99)
+        fresh.import_(ids, values)
+        for i in ids:
+            np.testing.assert_allclose(
+                np.asarray(fresh.lookup(np.array([i]))),
+                np.asarray(var.lookup(np.array([i]))),
+                rtol=1e-6,
+            )
+        assert fresh.size == 5
+
+
+class TestSparseAdam:
+    def test_converges_per_key(self):
+        """Each key's row converges to its own target; untouched keys
+        never move."""
+        var = KvVariable(
+            dim=2, capacity=8,
+            initializer=lambda k, s, d: jnp.zeros(s, d),
+        )
+        opt = SparseAdam(var, lr=0.05)
+        targets = {7: np.array([1.0, -1.0]), 13: np.array([0.5, 2.0])}
+        untouched = np.asarray(var.lookup(np.array([99])))  # allocate 99
+        for _ in range(300):
+            ids = np.array([7, 13])
+            rows = np.asarray(var.lookup(ids))
+            grads = 2 * (rows - np.stack([targets[7], targets[13]]))
+            opt.update(ids, grads)
+        for key, tgt in targets.items():
+            got = np.asarray(var.lookup(np.array([key])))[0]
+            np.testing.assert_allclose(got, tgt, atol=5e-2)
+        np.testing.assert_array_equal(
+            np.asarray(var.lookup(np.array([99]))), untouched
+        )
+
+    def test_state_grows_with_table(self):
+        var = KvVariable(dim=2, capacity=2)
+        opt = SparseAdam(var)
+        opt.update(np.arange(10), np.ones((10, 2)))
+        assert opt._m.shape[0] == var.capacity
