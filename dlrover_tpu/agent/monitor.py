@@ -139,9 +139,12 @@ class TrainingMonitor:
                 int(newest["step"]), float(newest.get("timestamp", 0.0))
             )
             # Workers may attach device stats (the agent process holds no
-            # TPU client, so this is the only channel for them).
+            # TPU client, so this is the only channel for them). They ride
+            # their own report — a zeroed cpu/mem report would stomp the
+            # ResourceMonitor's real numbers, so the servicer routes
+            # device-only reports to the collector's device channel.
             if newest.get("device_stats"):
                 self._client.report_resource_stats(
-                    cpu_percent=0.0, used_memory_mb=0,
+                    cpu_percent=-1.0, used_memory_mb=-1,
                     device_stats=newest["device_stats"],
                 )

@@ -8,22 +8,135 @@ vs ~9 GB/s with 8 threads), so every copy > one chunk is split across a
 shared thread pool. The reference hits the same wall with torch tensors and
 solves it with the same trick implicitly (torch.Tensor.copy_ is itself
 multithreaded); numpy needs it spelled out.
+
+When the native engine (``dlrover_tpu/ops/csrc/libdtfastcopy.so``, built
+on first use) is available, the whole task list is handed to C++ in one
+call — raw std::threads over an atomic chunk cursor, no per-chunk Python
+dispatch. Fallback is the pure-numpy pool; behavior is identical.
 """
 
+import ctypes
 import os
+import subprocess
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common.log import logger
+
 _CHUNK = 64 << 20  # 64 MB per task: large enough to amortize, small enough to balance
 _POOL: Optional[ThreadPoolExecutor] = None
+
+
+# ---------------------------------------------------------------- native
+
+
+class _DtCopyTask(ctypes.Structure):
+    _fields_ = [
+        ("dst", ctypes.c_void_p),
+        ("src", ctypes.c_void_p),
+        ("size", ctypes.c_uint64),
+    ]
+
+
+_NATIVE: Optional[object] = None
+_NATIVE_TRIED = False
+_THREADS: Optional[int] = None
+
+
+def _threads() -> int:
+    """Copy parallelism, calibrated once per process: cgroup-throttled
+    hosts gain ~60x from 8 threads, while unthrottled hosts lose ~30% to
+    bus contention — so measure instead of guessing."""
+    global _THREADS
+    if _THREADS is not None:
+        return _THREADS
+    env = os.getenv("DLROVER_TPU_COPY_THREADS", "")
+    if env:
+        _THREADS = max(1, int(env))
+        return _THREADS
+    lib = _native()
+    try:
+        import time
+
+        src = np.ones(64 << 20, dtype=np.uint8)
+        dst = np.empty_like(src)
+        dst[:] = 0  # pre-fault so neither timing pays page faults
+        t0 = time.perf_counter()
+        dst[:] = src
+        single = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        if lib is not None:
+            task = (_DtCopyTask * 1)()
+            task[0].dst = dst.ctypes.data
+            task[0].src = src.ctypes.data
+            task[0].size = dst.nbytes
+            lib.dt_copy_many(task, 1, 8 << 20, 8)
+        else:
+            list(_pool().map(
+                lambda off: dst.__setitem__(
+                    slice(off, off + (8 << 20)),
+                    src[off:off + (8 << 20)],
+                ),
+                range(0, dst.nbytes, 8 << 20),
+            ))
+        parallel = time.perf_counter() - t0
+        _THREADS = 8 if parallel < single else 1
+        logger.info(
+            "fastcopy calibration: single %.2f GB/s, 8-thread %.2f GB/s "
+            "-> %s thread(s)",
+            0.064 / single, 0.064 / parallel, _THREADS,
+        )
+    except Exception:
+        _THREADS = 8
+    return _THREADS
+
+
+def _csrc_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ops", "csrc",
+    )
+
+
+def _native():
+    """The C++ engine, built on first use; None when unavailable."""
+    global _NATIVE, _NATIVE_TRIED
+    if _NATIVE_TRIED:
+        return _NATIVE
+    _NATIVE_TRIED = True
+    if os.getenv("DLROVER_TPU_DISABLE_NATIVE_COPY"):
+        return None
+    so = os.path.join(_csrc_dir(), "libdtfastcopy.so")
+    if not os.path.exists(so):
+        try:
+            subprocess.run(
+                ["make", "-C", _csrc_dir()], check=True,
+                capture_output=True, timeout=120,
+            )
+        except Exception as e:
+            logger.info("native copy engine unavailable (%s); using the "
+                        "numpy pool", e)
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+        lib.dt_copy_many.argtypes = [
+            ctypes.POINTER(_DtCopyTask), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32,
+        ]
+        lib.dt_copy_many.restype = None
+        _NATIVE = lib
+        logger.info("native copy engine loaded: %s", so)
+    except OSError as e:
+        logger.info("native copy engine failed to load (%s)", e)
+    return _NATIVE
 
 
 def _pool() -> ThreadPoolExecutor:
     global _POOL
     if _POOL is None:
-        workers = int(os.getenv("DLROVER_TPU_COPY_THREADS", "8"))
+        workers = int(os.getenv("DLROVER_TPU_COPY_THREADS", "8") or 8)
         _POOL = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="fastcopy"
         )
@@ -61,19 +174,38 @@ _INLINE = 1 << 20  # copies below 1 MB aren't worth a pool dispatch
 def copy_many(pairs: Sequence[Tuple[np.ndarray, np.ndarray]]):
     """Copy src -> dst for each (dst, src) pair of equal-size flat uint8
     views. Small pairs run inline (pytrees have hundreds of scalar-sized
-    leaves); large ones are chunked across the shared pool."""
-    tasks: List[Tuple[np.ndarray, np.ndarray, int, int]] = []
+    leaves); large ones go to the native engine in one call (or are
+    chunked across the shared numpy pool as the fallback)."""
+    large: List[Tuple[np.ndarray, np.ndarray]] = []
     for dst, src in pairs:
         n = dst.nbytes
         if src.nbytes != n:
             raise ValueError(f"size mismatch {src.nbytes} != {n}")
         if n <= _INLINE:
             dst[:n] = src[:n]
-            continue
+        else:
+            large.append((dst, src))
+    if not large:
+        return
+
+    lib = _native()
+    if lib is not None:
+        threads = _threads()
+        arr = (_DtCopyTask * len(large))()
+        for i, (dst, src) in enumerate(large):
+            # Sources may be non-contiguous fallbacks from as_bytes_view;
+            # they were made contiguous there, so .ctypes.data is valid.
+            arr[i].dst = dst.ctypes.data
+            arr[i].src = src.ctypes.data
+            arr[i].size = dst.nbytes
+        lib.dt_copy_many(arr, len(large), _CHUNK, threads)
+        return
+
+    tasks: List[Tuple[np.ndarray, np.ndarray, int, int]] = []
+    for dst, src in large:
+        n = dst.nbytes
         for off in range(0, n, _CHUNK):
             tasks.append((dst, src, off, min(_CHUNK, n - off)))
-    if not tasks:
-        return
     if len(tasks) == 1:
         dst, src, off, ln = tasks[0]
         dst[off:off + ln] = src[off:off + ln]

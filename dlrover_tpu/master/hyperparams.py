@@ -28,9 +28,19 @@ _MAX_SCALE = 4
 
 
 def _host_memory_mb() -> int:
-    import psutil
+    try:
+        import psutil
 
-    return psutil.virtual_memory().total // (1024 * 1024)
+        return psutil.virtual_memory().total // (1024 * 1024)
+    except ImportError:  # degrade like the monitors do, never crash
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        return int(line.split()[1]) // 1024
+        except OSError:
+            pass
+        return 16 * 1024
 
 
 class SimpleStrategyGenerator:

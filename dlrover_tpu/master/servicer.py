@@ -144,12 +144,21 @@ class MasterServicer:
         return m.Response()
 
     def _report_resource(self, req: m.NodeResourceStats):
+        # Device-only reports (cpu/mem < 0, e.g. forwarded TPU stats from
+        # the training monitor) must not stomp the resource monitor's
+        # real host numbers.
+        device_only = req.cpu_percent < 0 or req.used_memory_mb < 0
         node = self._job_manager.get_node(req.node_id) if self._job_manager else None
-        if node is not None:
+        if node is not None and not device_only:
             node.used_resource.cpu = req.cpu_percent
             node.used_resource.memory_mb = req.used_memory_mb
         if self._metric_collector:
-            self._metric_collector.collect_node_resource(req)
+            if device_only:
+                self._metric_collector.collect_device_stats(
+                    req.node_id, req.device_stats
+                )
+            else:
+                self._metric_collector.collect_node_resource(req)
         return m.Response()
 
     def _report_model_info(self, req: m.ModelInfo):

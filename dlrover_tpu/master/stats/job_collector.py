@@ -33,6 +33,7 @@ class JobMetricCollector:
         self._lock = threading.Lock()
         self._history = history
         self._node_samples: Dict[int, Deque[ResourceSample]] = {}
+        self._device_stats: Dict[int, List[Dict]] = {}
         self._model_info: Optional[Dict] = None
         self._custom: Dict[str, Any] = {}
         self._sinks: List[Callable[[str, Dict], None]] = []
@@ -68,6 +69,12 @@ class JobMetricCollector:
                     info["params_count"], info["flops_per_step"])
         self._emit("model_info", info)
 
+    def collect_device_stats(self, node_id: int, device_stats) -> None:
+        """Per-node accelerator stats (forwarded from workers' metric
+        records; host cpu/mem arrive separately via the resource loop)."""
+        with self._lock:
+            self._device_stats[node_id] = list(device_stats or [])
+
     def collect_custom(self, key: str, value: Any) -> None:
         with self._lock:
             self._custom[key] = value
@@ -77,6 +84,7 @@ class JobMetricCollector:
         generator / resource optimizer forever."""
         with self._lock:
             self._node_samples.pop(node_id, None)
+            self._device_stats.pop(node_id, None)
 
     # ------------- outputs -------------
     def node_resource(self, node_id: int) -> Optional[ResourceSample]:

@@ -78,9 +78,9 @@ class KvVariable:
     # ------------- lookup / update -------------
     def to_slots(self, ids, allocate: bool = True) -> np.ndarray:
         """Map ids -> slot indices (host side). ``allocate=True`` admits
-        unseen ids (training); ``False`` maps them to slot 0 with a
-        zero-mask expectation (inference on unknown keys returns the
-        default row)."""
+        unseen ids (training); ``False`` marks them -1 (lookup returns a
+        zero row for them — inference on unknown keys must not leak some
+        other key's trained embedding)."""
         ids = np.asarray(ids).reshape(-1)
         out = np.empty(ids.shape, np.int32)
         for i, raw in enumerate(ids):
@@ -88,7 +88,7 @@ class KvVariable:
             slot = self._slots.get(key)
             if slot is None:
                 if not allocate:
-                    out[i] = 0
+                    out[i] = -1
                     continue
                 if self._next_slot >= self._capacity:
                     self._grow(self._next_slot + 1)
@@ -99,10 +99,16 @@ class KvVariable:
         return out
 
     def lookup(self, ids, allocate: bool = True):
-        """Gather rows for ids; shape ``ids.shape + (dim,)``."""
+        """Gather rows for ids; shape ``ids.shape + (dim,)``. Unknown ids
+        under ``allocate=False`` return zero rows."""
         ids = np.asarray(ids)
-        slots = self.to_slots(ids, allocate=allocate)
-        rows = jnp.take(self.table, jnp.asarray(slots), axis=0)
+        slots_np = self.to_slots(ids, allocate=allocate)
+        slots = jnp.asarray(np.maximum(slots_np, 0))
+        rows = jnp.take(self.table, slots, axis=0)
+        if (slots_np < 0).any():
+            rows = jnp.where(
+                jnp.asarray(slots_np < 0)[:, None], 0.0, rows
+            )
         return rows.reshape(*ids.shape, self.dim)
 
     def scatter_update(self, ids, rows):

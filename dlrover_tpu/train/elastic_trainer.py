@@ -40,14 +40,18 @@ class ElasticTrainer:
         self.world_size = world_size or int(
             os.getenv(NodeEnv.NUM_PROCESSES, "1")
         )
-        per_world = global_batch_size // self.world_size
-        if per_world % micro_batch_size:
+        # The class exists to HOLD the global batch fixed; any remainder
+        # would silently change it, so reject instead of rounding.
+        if global_batch_size % (micro_batch_size * self.world_size):
             raise ValueError(
-                f"per-process batch {per_world} not divisible by "
-                f"micro batch {micro_batch_size} at world size "
-                f"{self.world_size}"
+                f"global batch {global_batch_size} is not micro batch "
+                f"{micro_batch_size} x world {self.world_size} x an "
+                "integer accumulation count — adjust micro batch or "
+                "global batch for this world size"
             )
-        self.accum_steps = max(1, per_world // micro_batch_size)
+        self.accum_steps = global_batch_size // (
+            micro_batch_size * self.world_size
+        )
         logger.info(
             "elastic trainer: global batch %s = micro %s x world %s x "
             "accum %s", global_batch_size, micro_batch_size,
