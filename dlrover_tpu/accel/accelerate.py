@@ -217,6 +217,7 @@ def auto_accelerate(
     profile_steps: int = 3,
     allow_tensor: bool = False,
     grad_accum: int = 1,
+    registry=None,
 ) -> AccelerateResult:
     """Analyze → choose strategy → build sharded state + train step.
 
@@ -251,6 +252,21 @@ def auto_accelerate(
             }
 
         abstract = jax.eval_shape(init_fn, rng)
+        from dlrover_tpu.accel.registry import (
+            default_registry,
+            has_annotations,
+        )
+
+        if not has_annotations(abstract["params"]) and sp.total > 1:
+            # Plain model (no logical-axis metadata): the registry's
+            # path/shape rules make FSDP (and registered TP) work anyway.
+            logger.info(
+                "model carries no logical axes; auto-annotating via the "
+                "sharding registry"
+            )
+            abstract = (registry or default_registry).annotate_state(
+                abstract
+            )
         _check_spec_axes_used(sp, abstract)
         shardings = state_shardings(mesh, abstract, rules)
         batch_axes = dict(rules)["batch"]

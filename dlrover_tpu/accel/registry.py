@@ -1,0 +1,150 @@
+"""Sharding registry — annotate arbitrary models with logical axes.
+
+Parity: the reference's distributed-modules registry
+(``atorch/atorch/modules/distributed_modules/modules_registry.py``, 1325
+LoC of per-torch-module replacement tables mapping nn.Linear/attention
+classes to their TP shards). GSPMD needs no module swapping — sharding a
+model is purely a matter of *naming axes* on its params — so the TPU
+registry maps **param paths/shapes to logical axis names** instead of
+modules to replacement classes:
+
+- built-in defaults give any plain flax model working FSDP: the largest
+  dim of every >=2D kernel becomes ``embed`` (the fsdp-sharded axis) and
+  embedding-like tables get ``("vocab", "embed")``;
+- ``register(pattern, axes)`` adds model-specific TP knowledge the same
+  way the reference registers custom modules (e.g.
+  ``register(r".*attn.*/kernel", ("embed", "heads"))``);
+- optimizer state whose pytree structure mirrors the params (optax
+  moments) inherits the params' axes, so ZeRO-style optimizer sharding
+  keeps working for auto-annotated models too.
+
+``auto_accelerate`` applies the default registry automatically when a
+model carries no logical-axis metadata of its own.
+"""
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+
+from dlrover_tpu.common.log import logger
+
+
+def _default_axes(path: str, shape) -> Tuple:
+    """Shape/name heuristics: FSDP-ready out of the box."""
+    if len(shape) == 0:
+        return ()
+    lowered = path.lower()
+    if len(shape) >= 2 and (
+        "embedding" in lowered or "embed" in lowered.rsplit("/", 1)[-1]
+    ):
+        return ("vocab", "embed") + (None,) * (len(shape) - 2)
+    if len(shape) == 1:
+        return (None,)
+    # Shard the largest dim (ties: the last) over the fsdp axis.
+    largest = max(range(len(shape)), key=lambda i: (shape[i], i))
+    return tuple(
+        "embed" if i == largest else None for i in range(len(shape))
+    )
+
+
+class ShardingRegistry:
+    def __init__(self):
+        self._rules: List[Tuple[re.Pattern, Sequence]] = []
+
+    def register(self, pattern: str, axes: Sequence):
+        """Axes for params whose ``/``-joined path matches ``pattern``
+        (first registered match wins; falls back to the defaults)."""
+        self._rules.append((re.compile(pattern), tuple(axes)))
+        return self
+
+    def axes_for(self, path: str, shape) -> Tuple:
+        for pat, axes in self._rules:
+            if pat.search(path):
+                if len(axes) != len(shape):
+                    raise ValueError(
+                        f"registered axes {axes} rank-mismatch param "
+                        f"{path} of shape {tuple(shape)}"
+                    )
+                return axes
+        return _default_axes(path, shape)
+
+    # ------------- tree annotation -------------
+    def annotate_params(self, abstract_params):
+        """Box every leaf with logical names derived from its path."""
+        import flax.linen as nn
+
+        flat = jax.tree_util.tree_flatten_with_path(abstract_params)[0]
+        treedef = jax.tree_util.tree_structure(abstract_params)
+        boxed = []
+        for path, leaf in flat:
+            name = "/".join(
+                str(getattr(p, "key", getattr(p, "name", p)))
+                for p in path
+            )
+            boxed.append(nn.LogicallyPartitioned(
+                value=leaf, names=self.axes_for(name, leaf.shape),
+            ))
+        return jax.tree_util.tree_unflatten(treedef, boxed)
+
+    def annotate_state(self, abstract_state):
+        """Annotate a {params, opt, ...} train state: params by path;
+        any opt subtree that structurally mirrors the params (optax
+        moments) inherits the params' axes."""
+        params = abstract_state["params"]
+        boxed_params = self.annotate_params(params)
+        params_def = jax.tree_util.tree_structure(params)
+        boxed_leaves = jax.tree_util.tree_leaves(
+            boxed_params, is_leaf=_is_box
+        )
+
+        def fix_opt(node):
+            try:
+                if jax.tree_util.tree_structure(node) == params_def:
+                    return jax.tree_util.tree_unflatten(
+                        params_def,
+                        [
+                            type(b)(value=leaf, names=b.names)
+                            for b, leaf in zip(
+                                boxed_leaves,
+                                jax.tree_util.tree_leaves(node),
+                            )
+                        ],
+                    )
+            except Exception:
+                pass
+            return None
+
+        def walk(node):
+            fixed = fix_opt(node)
+            if fixed is not None:
+                return fixed
+            if isinstance(node, dict):
+                return {k: walk(v) for k, v in node.items()}
+            if isinstance(node, (list, tuple)):
+                walked = [walk(v) for v in node]
+                if hasattr(node, "_fields"):  # NamedTuple (optax states)
+                    return type(node)(*walked)
+                return type(node)(walked)
+            return node
+
+        out = dict(abstract_state)
+        out["params"] = boxed_params
+        if "opt" in out:
+            out["opt"] = walk(out["opt"])
+        return out
+
+
+def _is_box(x) -> bool:
+    return hasattr(x, "names") and hasattr(x, "value")
+
+
+default_registry = ShardingRegistry()
+
+
+def has_annotations(tree) -> bool:
+    """Does any leaf carry logical-axis metadata already?"""
+    for leaf in jax.tree_util.tree_leaves(tree, is_leaf=_is_box):
+        if _is_box(leaf):
+            return True
+    return False

@@ -44,6 +44,10 @@ _NATIVE: Optional[object] = None
 _NATIVE_TRIED = False
 _THREADS: Optional[int] = None
 
+import threading as _threading
+
+_INIT_LOCK = _threading.Lock()
+
 
 def _threads() -> int:
     """Copy parallelism, calibrated once per process: cgroup-throttled
@@ -52,11 +56,19 @@ def _threads() -> int:
     global _THREADS
     if _THREADS is not None:
         return _THREADS
+    with _INIT_LOCK:
+        if _THREADS is not None:
+            return _THREADS
+        return _threads_locked()
+
+
+def _threads_locked() -> int:
+    global _THREADS
     env = os.getenv("DLROVER_TPU_COPY_THREADS", "")
     if env:
         _THREADS = max(1, int(env))
         return _THREADS
-    lib = _native()
+    lib = _native_locked()
     try:
         import time
 
@@ -105,6 +117,14 @@ def _native():
     global _NATIVE, _NATIVE_TRIED
     if _NATIVE_TRIED:
         return _NATIVE
+    with _INIT_LOCK:
+        return _native_locked()
+
+
+def _native_locked():
+    global _NATIVE, _NATIVE_TRIED
+    if _NATIVE_TRIED:
+        return _NATIVE
     _NATIVE_TRIED = True
     if os.getenv("DLROVER_TPU_DISABLE_NATIVE_COPY"):
         return None
@@ -131,6 +151,23 @@ def _native():
     except OSError as e:
         logger.info("native copy engine failed to load (%s)", e)
     return _NATIVE
+
+
+def prime(background: bool = True):
+    """Warm the engine (toolchain build + thread calibration) OUTSIDE
+    the checkpoint critical section — engines call this at init so the
+    first snapshot never stalls behind a compiler invocation."""
+    def _run():
+        _native()
+        _threads()
+
+    if not background:
+        _run()
+        return
+    import threading
+
+    threading.Thread(target=_run, daemon=True,
+                     name="fastcopy-prime").start()
 
 
 def _pool() -> ThreadPoolExecutor:
@@ -193,8 +230,13 @@ def copy_many(pairs: Sequence[Tuple[np.ndarray, np.ndarray]]):
         threads = _threads()
         arr = (_DtCopyTask * len(large))()
         for i, (dst, src) in enumerate(large):
-            # Sources may be non-contiguous fallbacks from as_bytes_view;
-            # they were made contiguous there, so .ctypes.data is valid.
+            # memcpy of a base pointer silently reads/writes the wrong
+            # bytes for strided views — refuse loudly instead.
+            if not (dst.flags.c_contiguous and src.flags.c_contiguous):
+                raise ValueError(
+                    "native copy requires C-contiguous arrays "
+                    "(route through as_bytes_view)"
+                )
             arr[i].dst = dst.ctypes.data
             arr[i].src = src.ctypes.data
             arr[i].size = dst.nbytes

@@ -1,0 +1,159 @@
+"""Per-role node group management.
+
+Parity: reference ``master/node/job_manager``'s per-type managers
+(``ps_manager``/``worker_manager``/``evaluator_manager`` etc. inside
+``dist_job_manager.py``): each node role has its own target count,
+relaunch policy and completion semantics. TPU jobs are allreduce-shaped
+(one homogeneous ``worker`` role doing SPMD), but the control plane still
+has real roles — TPU-host workers, CPU evaluators, a chief — and job
+completion logic differs per role (evaluators may finish early; the job
+succeeds when the *worker* group does).
+
+The ``worker`` role delegates to the existing :class:`JobManager` (the
+heartbeat/eviction machinery lives there); auxiliary roles are tracked
+here with their own lifecycle.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node
+
+
+@dataclass
+class RolePolicy:
+    """Per-role behavior knobs (reference: per-type manager settings)."""
+
+    target: int = 0
+    max_relaunch: int = 3
+    # Does this role gate job success? (workers yes; evaluators no)
+    critical: bool = True
+    # May the job keep running after this role fully exits?
+    may_finish_early: bool = False
+
+
+class RoleAwareJobManager:
+    """Role registry + job-level completion semantics.
+
+    The single-role (pure worker) path is the existing JobManager
+    behavior; extra roles (evaluator, chief, ...) add their own targets,
+    nodes and policies.
+    """
+
+    WORKER = NodeType.WORKER
+
+    def __init__(self, job_manager,
+                 roles: Optional[Dict[str, RolePolicy]] = None):
+        self._jm = job_manager
+        self._policies: Dict[str, RolePolicy] = {}
+        self._extra: Dict[Tuple[str, int], Node] = {}
+        for role, policy in (roles or {}).items():
+            self.add_role(role, policy)
+
+    def add_role(self, role: str, policy: RolePolicy):
+        self._policies[role] = policy
+        logger.info("role %s registered: target=%s critical=%s",
+                    role, policy.target, policy.critical)
+        return self
+
+    @property
+    def roles(self) -> List[str]:
+        return list(self._policies)
+
+    def policy(self, role: str) -> Optional[RolePolicy]:
+        return self._policies.get(role)
+
+    # ------------- node tracking -------------
+    def register_node(self, role: str, node_id: int,
+                      status: str = NodeStatus.PENDING) -> Node:
+        """Track an auxiliary-role node (workers register through the
+        JobManager's normal status-report path)."""
+        if role == self.WORKER:
+            raise ValueError(
+                "worker nodes register via JobManager status reports"
+            )
+        node = Node(role, node_id)
+        node.update_status(status)
+        self._extra[(role, node_id)] = node
+        return node
+
+    def update_node_status(self, role: str, node_id: int, status: str,
+                           exit_reason: str = ""):
+        if role == self.WORKER:
+            return self._jm.update_node_status(node_id, status, exit_reason)
+        node = self._extra.get((role, node_id))
+        if node is None:
+            node = self.register_node(role, node_id, status)
+        node.update_status(status)
+        if exit_reason:
+            node.exit_reason = exit_reason
+
+    def nodes(self, role: str) -> List[Node]:
+        if role == self.WORKER:
+            return self._jm.all_nodes()
+        return [n for (r, _), n in self._extra.items() if r == role]
+
+    def alive(self, role: str) -> List[Node]:
+        return [n for n in self.nodes(role) if not n.exited()]
+
+    def missing(self, role: str) -> int:
+        policy = self._policies.get(role)
+        if policy is None:
+            return 0
+        filled = len(self.alive(role))
+        if policy.may_finish_early:
+            # A finish-early role's completed nodes still count as
+            # filled: relaunching a successfully-finished evaluator in a
+            # loop is exactly what this knob exists to prevent.
+            filled += sum(
+                1 for n in self.nodes(role)
+                if n.status == NodeStatus.SUCCEEDED
+            )
+        return max(0, policy.target - filled)
+
+    # ------------- job-level semantics -------------
+    def _critical_roles(self) -> List[str]:
+        return [
+            r for r, p in self._policies.items() if p.critical
+        ]
+
+    def _role_exited(self, role: str) -> bool:
+        ns = self.nodes(role)
+        return bool(ns) and all(n.exited() for n in ns)
+
+    def _role_succeeded(self, role: str) -> bool:
+        ns = self.nodes(role)
+        return bool(ns) and all(
+            n.status == NodeStatus.SUCCEEDED for n in ns
+        )
+
+    def job_succeeded(self) -> bool:
+        """Every critical role fully succeeded (non-critical roles —
+        evaluators — never gate)."""
+        critical = self._critical_roles()
+        return bool(critical) and all(
+            self._role_succeeded(r) for r in critical
+        )
+
+    def job_finished(self) -> bool:
+        critical = self._critical_roles()
+        return bool(critical) and all(
+            self._role_exited(r) for r in critical
+        )
+
+    def job_failed(self) -> bool:
+        """Any critical role holds an unrecoverable failed node."""
+        for role in self._critical_roles():
+            for n in self.nodes(role):
+                if n.status == NodeStatus.FAILED and not n.relaunchable:
+                    return True
+        return False
+
+    def scale_deficits(self) -> Dict[str, int]:
+        """role -> missing node count (the auto-scaler's per-role feed)."""
+        return {
+            role: self.missing(role) for role in self._policies
+            if self.missing(role) > 0
+        }

@@ -72,8 +72,14 @@ class JobMetricCollector:
     def collect_device_stats(self, node_id: int, device_stats) -> None:
         """Per-node accelerator stats (forwarded from workers' metric
         records; host cpu/mem arrive separately via the resource loop)."""
+        stats = list(device_stats or [])
         with self._lock:
-            self._device_stats[node_id] = list(device_stats or [])
+            self._device_stats[node_id] = stats
+        self._emit("device_stats", {"node_id": node_id, "stats": stats})
+
+    def device_stats(self, node_id: int) -> List[Dict]:
+        with self._lock:
+            return list(self._device_stats.get(node_id, ()))
 
     def collect_custom(self, key: str, value: Any) -> None:
         with self._lock:
@@ -115,6 +121,9 @@ class JobMetricCollector:
                 ),
                 "model_info": dict(self._model_info) if self._model_info
                 else None,
+                "device_stats": {
+                    nid: list(s) for nid, s in self._device_stats.items()
+                },
                 "custom": dict(self._custom),
             }
 

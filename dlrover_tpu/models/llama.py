@@ -1,0 +1,220 @@
+"""LLaMA-family decoder (RoPE + RMSNorm + SwiGLU + GQA), TPU-first.
+
+Second flagship family — the reference's other headline workload class
+(ATorch's GLM/LLaMA recipes drive the same Megatron-style TP modules,
+``atorch/atorch/modules/distributed_modules/transformer.py``; HF LLaMA
+is its standard demo model). Same design as :mod:`.gpt`: every
+parallelism is logical-axis metadata + GSPMD, layers stack under
+``nn.scan``, and the attention hot path plugs the Pallas flash / ring
+kernels via ``attn_impl``.
+
+Family-defining pieces, implemented TPU-first:
+- RoPE applied to q/k at fp32 (precision of the rotation matters more
+  than its FLOPs; XLA fuses it into the projection);
+- RMSNorm (no mean subtraction, fp32 accumulation);
+- SwiGLU MLP (gate/up/down, ``mlp`` axis for TP);
+- grouped-query attention: ``num_kv_heads <= num_heads`` with kv heads
+  repeated to query heads before the kernel (static-shape repeat — the
+  MXU sees full-width matmuls; HBM holds only the small kv projection).
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.models.gpt import _attention, loss_fn  # shared kernel path
+
+__all__ = ["LlamaConfig", "Llama", "loss_fn"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 2048
+    num_layers: int = 16
+    num_heads: int = 16
+    num_kv_heads: int = 0  # 0 -> = num_heads (MHA); < heads = GQA
+    d_model: int = 1024
+    d_ff: int = 0  # 0 -> the LLaMA 8/3 * d_model rounded to 128
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    remat_policy: str = "nothing"
+    scan_layers: bool = True
+    attn_impl: str = "xla"  # "xla" | "pallas" | "ring"
+    attn_block_q: int = 512
+    attn_block_k: int = 512
+
+    def __post_init__(self):
+        if self.kv_heads > self.num_heads or self.num_heads % self.kv_heads:
+            raise ValueError(
+                f"num_kv_heads {self.kv_heads} must divide num_heads "
+                f"{self.num_heads}"
+            )
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff:
+            return self.d_ff
+        raw = int(8 * self.d_model / 3)
+        return (raw + 127) // 128 * 128
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    def param_count(self) -> int:
+        d, f, v, l = self.d_model, self.ff_dim, self.vocab_size, self.num_layers
+        kv = self.kv_heads * self.head_dim
+        per_layer = d * d + 2 * d * kv + d * d + 3 * d * f + 2 * d
+        return 2 * v * d + l * per_layer + d
+
+    def flops_per_token(self) -> float:
+        attn = 12 * self.num_layers * self.d_model * self.max_seq_len
+        return 6 * self.param_count() + attn
+
+    @staticmethod
+    def tiny():
+        return LlamaConfig(vocab_size=256, max_seq_len=64, num_layers=2,
+                           num_heads=4, num_kv_heads=2, d_model=32)
+
+
+def _rms_norm(name: str, cfg: LlamaConfig):
+    return nn.RMSNorm(
+        epsilon=1e-5,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        scale_init=nn.with_logical_partitioning(
+            nn.initializers.ones_init(), ("embed",)
+        ),
+        name=name,
+    )
+
+
+def _dense(features, name, kernel_axes, cfg: LlamaConfig):
+    return nn.Dense(
+        features,
+        use_bias=False,  # LLaMA projections carry no biases
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), kernel_axes
+        ),
+        name=name,
+    )
+
+
+def rope(x, positions, theta: float = 10000.0):
+    """Rotary embedding over [B, S, H, D] (D even), positions [S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]  # [1, S, 1, D/2]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., 0::2], x32[..., 1::2]
+    out = jnp.stack(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, _=None):
+        cfg = self.cfg
+        b, s, d = x.shape
+        h, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+
+        y = _rms_norm("attn_norm", cfg)(x)
+        q = _dense(h * hd, "q_proj", ("embed", "heads"), cfg)(y)
+        k = _dense(kvh * hd, "k_proj", ("embed", "heads"), cfg)(y)
+        v = _dense(kvh * hd, "v_proj", ("embed", "heads"), cfg)(y)
+        q = q.reshape(b, s, h, hd)
+        k = k.reshape(b, s, kvh, hd)
+        v = v.reshape(b, s, kvh, hd)
+        positions = jnp.arange(s)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if kvh != h:
+            # GQA: repeat kv heads up to query width (static shape; the
+            # small kv projection is what saves HBM, not the repeat).
+            k = jnp.repeat(k, h // kvh, axis=2)
+            v = jnp.repeat(v, h // kvh, axis=2)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
+        attn = _attention(q, k, v, cfg).reshape(b, s, d)
+        x = x + _dense(d, "o_proj", ("heads", "embed"), cfg)(attn)
+
+        y = _rms_norm("mlp_norm", cfg)(x)
+        gate = _dense(cfg.ff_dim, "gate_proj", ("embed", "mlp"), cfg)(y)
+        up = _dense(cfg.ff_dim, "up_proj", ("embed", "mlp"), cfg)(y)
+        y = nn.silu(gate) * up
+        y = nn.with_logical_constraint(y, ("batch", "seq", "mlp"))
+        x = x + _dense(d, "down_proj", ("mlp", "embed"), cfg)(y)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        return x, None
+
+
+class Llama(nn.Module):
+    """Decoder-only LM. ``__call__(tokens[B,S]) -> logits[B,S,V]``."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        b, s = tokens.shape
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="embed",
+        )
+        x = embed(tokens)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        block = LlamaBlock
+        if cfg.remat:
+            policy = (
+                jax.checkpoint_policies.checkpoint_dots
+                if cfg.remat_policy == "dots"
+                else jax.checkpoint_policies.nothing_saveable
+            )
+            block = nn.remat(LlamaBlock, prevent_cse=False, policy=policy)
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")(x)
+        else:
+            for i in range(cfg.num_layers):
+                x, _ = block(cfg, name=f"layer_{i}")(x)
+
+        x = _rms_norm("final_norm", cfg)(x)
+        # Untied LM head (LLaMA convention).
+        logits = _dense(
+            cfg.vocab_size, "lm_head", ("embed", "vocab"), cfg
+        )(x)
+        return nn.with_logical_constraint(
+            logits, ("batch", "seq", "vocab")
+        )

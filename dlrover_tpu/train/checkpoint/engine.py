@@ -136,6 +136,9 @@ class CheckpointEngine:
         keep_latest: int = 3,
         job: str = "",
     ):
+        # Warm the copy engine off the critical path: the first snapshot
+        # must not stall behind a toolchain build or calibration.
+        fastcopy.prime()
         self.checkpoint_dir = checkpoint_dir
         self.global_shard_id = global_shard_id
         self.global_shard_num = global_shard_num

@@ -1,0 +1,145 @@
+"""High-level training orchestration — the AtorchTrainer analog.
+
+Parity: reference ``atorch/atorch/trainer/atorch_trainer.py`` (a
+HF-Trainer-shaped loop wiring accelerate, checkpointing, logging and
+resume into one object). The TPU version composes the framework's own
+pieces — ``auto_accelerate`` (or ``ElasticTrainer`` for grad accum), the
+flash-checkpoint engines, the elastic data layer, the profiler and the
+master metric reports — into a ``fit()`` loop, so the per-user training
+script shrinks to model + loss + data.
+
+The loop is crash-safe by construction: MEMORY snapshots every step
+(async, ~ms), DISK persists on a cadence, and a restart resumes from
+whatever the agent flushed.
+"""
+
+import os
+import time
+from typing import Any, Callable, Iterable, Optional
+
+from dlrover_tpu.common.log import logger
+
+
+class Trainer:
+    def __init__(
+        self,
+        model,
+        optimizer,
+        loss: Callable,                      # (module, params, batch) -> scalar
+        sample_batch,
+        spec: Any = "auto",
+        checkpoint_dir: str = "",
+        persist_every: int = 100,
+        grad_accum: int = 1,
+        profiler=None,
+        report_metrics: bool = True,
+        **accel_kwargs,
+    ):
+        import jax
+
+        from dlrover_tpu.accel import auto_accelerate
+
+        self._result = auto_accelerate(
+            model, optimizer, sample_batch, loss, spec=spec,
+            grad_accum=grad_accum, **accel_kwargs,
+        )
+        self.state = self._result.state
+        self._persist_every = persist_every
+        self._profiler = profiler
+        self._report = report_metrics
+        self._ckpt = None
+        if checkpoint_dir:
+            from dlrover_tpu.train.checkpoint import (
+                FlashCheckpointer,
+                ShardedCheckpointer,
+            )
+
+            cls = (
+                ShardedCheckpointer if jax.process_count() > 1
+                else FlashCheckpointer
+            )
+            self._ckpt = cls(checkpoint_dir)
+        self._client = None
+        if report_metrics and os.getenv("DLROVER_TPU_MASTER_ADDR"):
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            try:
+                self._client = MasterClient.singleton_instance()
+            except Exception:
+                self._client = None
+
+    @property
+    def train_step(self):
+        return self._result.train_step
+
+    @property
+    def batch_sharding(self):
+        return self._result.batch_sharding
+
+    def restore(self) -> int:
+        """Resume from the newest checkpoint; returns the step to start
+        from (0 when fresh)."""
+        if self._ckpt is None:
+            return 0
+        step, self.state = self._ckpt.load_checkpoint(self.state)
+        if step > 0:
+            logger.info("trainer resumed from step %s", step)
+        return max(0, step)
+
+    def fit(self, batches: Iterable, steps: int,
+            start_step: Optional[int] = None) -> dict:
+        """Run the loop; returns {'step': last, 'loss': last}.
+
+        ``batches`` yields device-puttable batches; the loop consumes one
+        per optimizer step and stops at ``steps`` or when data runs out.
+        """
+        import contextlib
+
+        import jax
+
+        from dlrover_tpu import train as dtrain
+        from dlrover_tpu.train import report_training_metrics
+        from dlrover_tpu.train.checkpoint import StorageType
+
+        start = self.restore() if start_step is None else start_step
+        it = iter(batches)
+        last_loss = float("nan")
+        done = start
+        for step in range(start, steps):
+            try:
+                batch = next(it)
+            except StopIteration:
+                logger.info("data exhausted at step %s", step)
+                break
+            ctx = (
+                self._profiler.step() if self._profiler is not None
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                batch = jax.device_put(batch, self.batch_sharding)
+                self.state, metrics = self.train_step(self.state, batch)
+            done = step + 1
+            if self._ckpt is not None:
+                if self._persist_every and done % self._persist_every == 0:
+                    self._ckpt.save_checkpoint(
+                        done, self.state, StorageType.DISK
+                    )
+                else:
+                    self._ckpt.save_checkpoint(
+                        done, self.state, StorageType.MEMORY
+                    )
+            if self._report:
+                if self._client is not None and dtrain.global_rank() == 0:
+                    try:
+                        self._client.report_global_step(done, time.time())
+                    except Exception:
+                        pass
+                report_training_metrics(done)
+            last_loss = metrics["loss"]
+        loss = float(last_loss)
+        logger.info("trainer finished at step %s (loss %.5f)", done, loss)
+        return {"step": done, "loss": loss}
+
+    def close(self):
+        if self._ckpt is not None:
+            self._ckpt.close()

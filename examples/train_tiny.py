@@ -200,7 +200,7 @@ def main():
         f"step counter {resumed_step} != {args.steps}: checkpoint resume "
         "lost training state"
     )
-    if args.steps >= 15:  # enough steps to converge
+    if args.steps >= 20:  # enough steps to converge even with minibatches
         assert final_loss < 1.0
 
 

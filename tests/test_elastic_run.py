@@ -175,9 +175,22 @@ class TestElasticRun:
                         stderr=subprocess.STDOUT, text=True,
                     )
                 )
-            # Let the 2-node world train for a bit, then hard-kill agent 1
-            # and its worker children (simulated host loss — no report).
-            time.sleep(12)
+            # Wait until BOTH workers are actually training (their flash
+            # ckpt shm appears after the first memory save) — a fixed
+            # sleep is load-sensitive when the suite saturates the CPU —
+            # then hard-kill agent 1 and its worker children (simulated
+            # host loss — no report).
+            import glob
+
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if len(glob.glob(f"/dev/shm/ckpt_{job}_n*_rank0")) >= 2:
+                    break
+                time.sleep(0.5)
+            assert len(glob.glob(f"/dev/shm/ckpt_{job}_n*_rank0")) >= 2, (
+                "workers never started saving memory snapshots"
+            )
+            time.sleep(2)  # a few steps past the first snapshot
             victim = agents[1]
             kids = sp.run(
                 ["pgrep", "-P", str(victim.pid)], capture_output=True,

@@ -1,0 +1,127 @@
+"""LLaMA-family model tests: RoPE properties, GQA, sharded numerics."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.accel import ParallelSpec, auto_accelerate
+from dlrover_tpu.models.llama import Llama, LlamaConfig, loss_fn, rope
+
+
+def tiny_cfg(**kw):
+    return dataclasses.replace(
+        LlamaConfig.tiny(), dtype=jnp.float32, **kw
+    )
+
+
+def token_loss(module, params, batch):
+    return loss_fn(module.apply({"params": params}, batch), batch)
+
+
+def run_training(spec, steps=3, cfg=None):
+    cfg = cfg or tiny_cfg()
+    model = Llama(cfg)
+    opt = optax.adamw(1e-3)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size
+    )
+    res = auto_accelerate(model, opt, tokens, token_loss, spec=spec)
+    state = res.state
+    batch = jax.device_put(tokens, res.batch_sharding)
+    losses = []
+    for _ in range(steps):
+        state, m = res.train_step(state, batch)
+        losses.append(float(m["loss"]))
+    res.state = state
+    return losses, res
+
+
+class TestRope:
+    def test_norm_preserved(self):
+        """Rotations are orthogonal: per-head vector norms are unchanged."""
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 4, 16))
+        out = rope(x, jnp.arange(8))
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(out), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1),
+            rtol=1e-5,
+        )
+
+    def test_position_zero_is_identity(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 2, 8))
+        out = rope(x, jnp.zeros(1, jnp.int32))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+    def test_relative_dot_products(self):
+        """q.k after RoPE depends only on the position OFFSET — the
+        property RoPE exists for."""
+        d = 16
+        q = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 1, d))
+        k = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, d))
+
+        def dot_at(pq, pk):
+            qq = rope(q, jnp.array([pq]))
+            kk = rope(k, jnp.array([pk]))
+            return float(jnp.sum(qq * kk))
+
+        assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-4)
+        assert dot_at(5, 3) != pytest.approx(dot_at(5, 4), rel=1e-3)
+
+
+class TestLlamaModel:
+    def test_gqa_param_shapes(self):
+        cfg = tiny_cfg(scan_layers=False)
+        model = Llama(cfg)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        import flax.linen as nn
+
+        params = nn.meta.unbox(
+            model.init(jax.random.PRNGKey(0), tokens)["params"]
+        )
+        l0 = params["layer_0"]
+        # 4 query heads, 2 kv heads, head_dim 8.
+        assert l0["q_proj"]["kernel"].shape == (32, 32)
+        assert l0["k_proj"]["kernel"].shape == (32, 16)
+        assert l0["v_proj"]["kernel"].shape == (32, 16)
+        assert "bias" not in l0["q_proj"]
+
+    def test_ff_dim_convention(self):
+        cfg = LlamaConfig(d_model=1024, d_ff=0)
+        assert cfg.ff_dim % 128 == 0
+        assert cfg.ff_dim >= 8 * 1024 // 3
+
+    def test_bad_gqa_rejected(self):
+        with pytest.raises(ValueError):
+            LlamaConfig(num_heads=4, num_kv_heads=3)
+
+
+class TestShardedNumerics:
+    @pytest.fixture(scope="class")
+    def baseline(self):
+        return run_training(ParallelSpec())[0]
+
+    @pytest.mark.parametrize(
+        "spec",
+        [
+            ParallelSpec(data=8),
+            ParallelSpec(data=2, fsdp=2, tensor=2),
+        ],
+        ids=["dp", "dp-fsdp-tp"],
+    )
+    def test_matches_baseline(self, spec, baseline):
+        losses, _ = run_training(spec)
+        np.testing.assert_allclose(losses, baseline, rtol=2e-5, atol=2e-5)
+
+    def test_loss_decreases(self):
+        losses, _ = run_training(ParallelSpec(data=4), steps=5)
+        assert losses[-1] < losses[0]
+
+    def test_flash_attention_variant_trains(self):
+        losses, _ = run_training(
+            ParallelSpec(data=2), steps=3, cfg=tiny_cfg(attn_impl="pallas")
+        )
+        assert losses[-1] < losses[0]

@@ -1,0 +1,122 @@
+"""Sharding-registry tests: arbitrary un-annotated flax models shard
+under auto_accelerate (SURVEY §2.5 — the modules-registry analog)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.accel import ParallelSpec, auto_accelerate
+from dlrover_tpu.accel.registry import ShardingRegistry, _default_axes
+
+
+class PlainMLP(nn.Module):
+    """Deliberately metadata-free: no logical axes anywhere."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(128, name="dense_in")(x)
+        x = nn.relu(x)
+        x = nn.Dense(256, name="dense_mid")(x)
+        x = nn.relu(x)
+        return nn.Dense(1, name="dense_out")(x)
+
+
+def mse_loss(module, params, batch):
+    pred = module.apply({"params": params}, batch)
+    target = batch.sum(axis=1, keepdims=True)
+    return jnp.mean((pred - target) ** 2)
+
+
+def make_batch(n=64, d=16):
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+
+
+def run_training(spec, steps=3, registry=None):
+    batch = make_batch()
+    res = auto_accelerate(
+        PlainMLP(), optax.adam(1e-2), batch, mse_loss, spec=spec,
+        registry=registry,
+    )
+    state = res.state
+    b = jax.device_put(batch, res.batch_sharding)
+    losses = []
+    for _ in range(steps):
+        state, m = res.train_step(state, b)
+        losses.append(float(m["loss"]))
+    res.state = state
+    return losses, res
+
+
+class TestDefaultAxes:
+    def test_kernel_largest_dim(self):
+        assert _default_axes("layer/kernel", (16, 256)) == (None, "embed")
+        assert _default_axes("layer/kernel", (256, 16)) == ("embed", None)
+        assert _default_axes("b/bias", (64,)) == (None,)
+        assert _default_axes("wte/embedding", (1000, 64)) == (
+            "vocab", "embed",
+        )
+
+
+class TestAutoAnnotation:
+    @pytest.fixture(scope="class")
+    def baseline(self):
+        return run_training(ParallelSpec())[0]
+
+    def test_fsdp_shards_plain_model(self, baseline):
+        losses, res = run_training(ParallelSpec(fsdp=8))
+        np.testing.assert_allclose(losses, baseline, rtol=2e-5, atol=2e-6)
+        kernel = res.state["params"]["dense_mid"]["kernel"]  # (128, 256)
+        shard = kernel.addressable_shards[0]
+        assert shard.data.shape[1] == kernel.shape[1] // 8
+
+    def test_opt_state_inherits_sharding(self):
+        _, res = run_training(ParallelSpec(fsdp=8), steps=1)
+        mu = res.state["opt"][0].mu["dense_mid"]["kernel"]
+        kernel = res.state["params"]["dense_mid"]["kernel"]
+        assert mu.sharding == kernel.sharding  # ZeRO for free
+
+    def test_registered_tp_pattern(self):
+        reg = ShardingRegistry().register(
+            r"dense_mid/kernel", ("embed", "mlp")
+        )
+        _, res = run_training(
+            ParallelSpec(data=4, tensor=2), registry=reg
+        )
+        kernel = res.state["params"]["dense_mid"]["kernel"]
+        shard = kernel.addressable_shards[0]
+        assert shard.data.shape[1] == kernel.shape[1] // 2
+
+    def test_rank_mismatch_rejected(self):
+        reg = ShardingRegistry().register(r"kernel", ("embed",))
+        with pytest.raises(ValueError, match="rank-mismatch"):
+            run_training(ParallelSpec(fsdp=2), registry=reg)
+
+    def test_annotated_models_untouched(self):
+        """Models WITH logical axes (the GPT flagship) keep their own
+        annotations — the registry only fills a vacuum."""
+        import dataclasses
+
+        from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+
+        cfg = dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size
+        )
+
+        def token_loss(module, params, b):
+            return loss_fn(module.apply({"params": params}, b), b)
+
+        res = auto_accelerate(
+            GPT(cfg), optax.adamw(1e-3), tokens, token_loss,
+            spec=ParallelSpec(tensor=2),
+        )
+        # TP sharding comes from the model's own "mlp" axes, which the
+        # default registry would never produce.
+        kernel = res.state["params"]["blocks"]["up"]["kernel"]
+        assert kernel.addressable_shards[0].data.shape[-1] == (
+            kernel.shape[-1] // 2
+        )

@@ -1,0 +1,63 @@
+"""Per-role manager tests (SURVEY §2.2 per-role managers)."""
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.master.node_manager import LocalJobManager
+from dlrover_tpu.master.role_manager import RoleAwareJobManager, RolePolicy
+
+
+@pytest.fixture
+def mgr():
+    jm = LocalJobManager(node_num=2)
+    return RoleAwareJobManager(jm, roles={
+        "worker": RolePolicy(target=2, critical=True),
+        "evaluator": RolePolicy(target=1, critical=False,
+                                may_finish_early=True),
+    })
+
+
+class TestRoleAwareJobManager:
+    def test_worker_role_delegates(self, mgr):
+        assert len(mgr.nodes("worker")) == 2
+        mgr.update_node_status("worker", 0, NodeStatus.RUNNING)
+        assert len(mgr.alive("worker")) == 2
+
+    def test_auxiliary_role_lifecycle(self, mgr):
+        mgr.register_node("evaluator", 0)
+        assert mgr.missing("evaluator") == 0
+        mgr.update_node_status("evaluator", 0, NodeStatus.RUNNING)
+        assert len(mgr.alive("evaluator")) == 1
+        mgr.update_node_status("evaluator", 0, NodeStatus.SUCCEEDED)
+        # finish-early role: a completed node still fills its slot (the
+        # scaler must never relaunch a finished evaluator)
+        assert mgr.missing("evaluator") == 0
+        mgr.update_node_status("evaluator", 0, NodeStatus.FAILED, "oom")
+        assert mgr.missing("evaluator") == 1  # failures DO leave a hole
+
+    def test_workers_register_via_job_manager_only(self, mgr):
+        with pytest.raises(ValueError):
+            mgr.register_node("worker", 5)
+
+    def test_success_gated_on_critical_roles_only(self, mgr):
+        """Evaluator failure never fails the job; worker success
+        completes it even with the evaluator still running."""
+        mgr.register_node("evaluator", 0, NodeStatus.RUNNING)
+        for wid in (0, 1):
+            mgr.update_node_status("worker", wid, NodeStatus.RUNNING)
+            mgr.update_node_status("worker", wid, NodeStatus.SUCCEEDED)
+        assert mgr.job_finished()
+        assert mgr.job_succeeded()
+        mgr.update_node_status("evaluator", 0, NodeStatus.FAILED, "oom")
+        assert mgr.job_succeeded()  # non-critical role can't gate
+
+    def test_critical_unrecoverable_failure(self, mgr):
+        mgr.update_node_status("worker", 0, NodeStatus.RUNNING)
+        node = mgr.nodes("worker")[0]
+        node.update_status(NodeStatus.FAILED)
+        node.relaunchable = False
+        assert mgr.job_failed()
+
+    def test_scale_deficits_per_role(self, mgr):
+        # Evaluator never launched: deficit 1. Workers present: 0.
+        assert mgr.scale_deficits() == {"evaluator": 1}

@@ -1,0 +1,88 @@
+"""High-level Trainer tests (SURVEY §2.5 AtorchTrainer analog)."""
+
+import dataclasses
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import optax
+
+from dlrover_tpu.accel import ParallelSpec
+from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+from dlrover_tpu.train.trainer import Trainer
+
+
+def tiny_cfg():
+    return dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)
+
+
+def token_loss(module, params, batch):
+    return loss_fn(module.apply({"params": params}, batch), batch)
+
+
+def batches(cfg, n=10_000, batch=8):
+    key = jax.random.PRNGKey(7)
+    for i in range(n):
+        yield jax.random.randint(
+            jax.random.fold_in(key, i), (batch, 16), 0, cfg.vocab_size
+        )
+
+
+class TestTrainer:
+    def test_fit_trains(self, job_name):
+        cfg = tiny_cfg()
+        trainer = Trainer(
+            GPT(cfg), optax.adamw(1e-3), token_loss,
+            next(batches(cfg)), spec=ParallelSpec(data=2),
+        )
+        first = trainer.fit(batches(cfg), steps=2)
+        second = trainer.fit(batches(cfg), steps=6, start_step=2)
+        assert second["step"] == 6
+        assert second["loss"] < first["loss"]
+
+    def test_fit_resumes_from_checkpoint(self, tmp_path, job_name):
+        cfg = tiny_cfg()
+        ckpt = str(tmp_path / "ckpts")
+
+        def make():
+            return Trainer(
+                GPT(cfg), optax.adamw(1e-3), token_loss,
+                next(batches(cfg)), spec=ParallelSpec(),
+                checkpoint_dir=ckpt, persist_every=5,
+            )
+
+        t1 = make()
+        out = t1.fit(batches(cfg), steps=5)
+        assert out["step"] == 5
+        t1.close()
+
+        t2 = make()  # "restarted process"
+        resumed = t2.restore()
+        assert resumed == 5, "did not resume from the persisted step"
+        out = t2.fit(batches(cfg), steps=8, start_step=resumed)
+        assert out["step"] == 8
+        assert int(jax.device_get(t2.state["step"])) == 8
+        t2.close()
+
+    def test_data_exhaustion_stops_cleanly(self, job_name):
+        cfg = tiny_cfg()
+        trainer = Trainer(
+            GPT(cfg), optax.adamw(1e-3), token_loss,
+            next(batches(cfg)), spec=ParallelSpec(),
+        )
+        out = trainer.fit(
+            itertools.islice(batches(cfg), 3), steps=100
+        )
+        assert out["step"] == 3
+
+    def test_grad_accum_passthrough(self, job_name):
+        cfg = tiny_cfg()
+        trainer = Trainer(
+            GPT(cfg), optax.adamw(1e-3), token_loss,
+            next(batches(cfg)), spec=ParallelSpec(), grad_accum=2,
+        )
+        out = trainer.fit(batches(cfg), steps=2)
+        assert out["step"] == 2
