@@ -1,0 +1,70 @@
+"""LLaMA pretraining through the high-level Trainer.
+
+The "switch from the reference" demo: elastic launch + data-parallel
+sharded training in ~40 lines, with flash checkpointing one flag away
+(``--ckpt-dir``). For the master-fed elastic data path see
+``train_tiny.py --use-dataloader``.
+
+Run::
+
+    python -m dlrover_tpu.cli --standalone --nproc_per_node=1 \
+        examples/train_llama.py -- --steps 30
+"""
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from dlrover_tpu import train as dtrain
+from dlrover_tpu.accel import ParallelSpec
+from dlrover_tpu.models.llama import Llama, LlamaConfig, loss_fn
+from dlrover_tpu.train.trainer import Trainer
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--ckpt-dir", type=str, default="")
+    parser.add_argument("--grad-accum", type=int, default=1)
+    args = parser.parse_args()
+
+    dtrain.init_training()
+    # The batch shards over the data axis: round it up to a device-count
+    # multiple so any slice size works unchanged.
+    n_dev = len(jax.devices())
+    args.batch = -(-args.batch // n_dev) * n_dev
+    cfg = LlamaConfig(
+        vocab_size=2048, max_seq_len=args.seq, num_layers=4,
+        num_heads=8, num_kv_heads=4, d_model=256,
+        attn_impl="pallas" if jax.default_backend() == "tpu" else "xla",
+    )
+
+    def token_loss(module, params, batch):
+        return loss_fn(module.apply({"params": params}, batch), batch)
+
+    def batches():
+        rng = np.random.default_rng(dtrain.global_rank())
+        while True:
+            yield rng.integers(
+                0, cfg.vocab_size, (args.batch, args.seq), dtype=np.int32
+            )
+
+    sample = next(batches())
+    trainer = Trainer(
+        Llama(cfg), optax.adamw(3e-4), token_loss, sample,
+        spec=ParallelSpec(data=n_dev) if n_dev > 1 else ParallelSpec(),
+        checkpoint_dir=args.ckpt_dir, persist_every=10,
+        grad_accum=args.grad_accum,
+    )
+    out = trainer.fit(batches(), steps=args.steps)
+    print(f"rank {dtrain.global_rank()}: done at step {out['step']}, "
+          f"loss {out['loss']:.4f}", flush=True)
+    trainer.close()
+
+
+if __name__ == "__main__":
+    main()
