@@ -33,10 +33,11 @@ def main():
     args = parser.parse_args()
 
     dtrain.init_training()
-    # The batch shards over the data axis: round it up to a device-count
-    # multiple so any slice size works unchanged.
+    # The batch shards over the data axis AND splits into grad-accum
+    # microbatches: round it up so any slice size / accum combo works.
     n_dev = len(jax.devices())
-    args.batch = -(-args.batch // n_dev) * n_dev
+    unit = n_dev * max(1, args.grad_accum)
+    args.batch = -(-args.batch // unit) * unit
     cfg = LlamaConfig(
         vocab_size=2048, max_seq_len=args.seq, num_layers=4,
         num_heads=8, num_kv_heads=4, d_model=256,
@@ -56,7 +57,7 @@ def main():
     sample = next(batches())
     trainer = Trainer(
         Llama(cfg), optax.adamw(3e-4), token_loss, sample,
-        spec=ParallelSpec(data=n_dev) if n_dev > 1 else ParallelSpec(),
+        spec=ParallelSpec(data=n_dev),
         checkpoint_dir=args.ckpt_dir, persist_every=10,
         grad_accum=args.grad_accum,
     )

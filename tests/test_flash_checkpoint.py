@@ -150,7 +150,7 @@ class TestAgentModeEngine:
         engine = CheckpointEngine(saver_env)
         try:
             assert engine.save_to_storage(4, state)
-            assert engine.wait_persisted(4, timeout=30.0)
+            assert engine.wait_persisted(4, timeout=90.0)
             shard = ckpt_persist.load_shard(
                 PosixDiskStorage(), saver_env, 4, 0
             )
@@ -266,7 +266,7 @@ class TestFlashCheckpointerAPI:
                 if ok:
                     last_memory = s
             assert ckpt.engine.wait_staged()
-            assert ckpt.wait_persisted(4, timeout=30.0)
+            assert ckpt.wait_persisted(4, timeout=90.0)
             # The newest staged snapshot wins on restore.
             step, restored = FlashCheckpointer(saver_env).load_checkpoint(
                 make_state(0)
