@@ -100,13 +100,16 @@ def main():
     # ---- train step timing (no checkpointing) ----
     # Fence with a scalar fetch, NOT block_until_ready: through a
     # tunneled backend a host read of the loss is the reliable barrier.
-    def run_steps(state, n):
+    def timed_steps(step_fn, state, batch, n):
         t0 = time.perf_counter()
         metrics = None
         for _ in range(n):
-            state, metrics = result.train_step(state, tokens)
+            state, metrics = step_fn(state, batch)
         float(metrics["loss"])
         return state, (time.perf_counter() - t0) / n
+
+    def run_steps(state, n):
+        return timed_steps(result.train_step, state, tokens, n)
 
     t0 = time.perf_counter()
     state, metrics = result.train_step(state, tokens)
@@ -121,6 +124,39 @@ def main():
     mfu = flops_per_step / step_s / peak * 100 if peak else -1.0
     log(f"bench: compile {compile_s:.1f}s, step {step_s*1e3:.1f}ms, "
         f"{tokens_per_s:,.0f} tok/s, MFU {mfu:.1f}%")
+
+    # ---- attention kernel speedup (Pallas vs einsum, same settings) ----
+    # Measured at a config both implementations can run (the einsum path
+    # must fully rematerialize its [S,S] logits).
+    attn_speedup = None
+    if on_tpu and cfg.attn_impl == "pallas":
+        # Best-effort: a failure here (e.g. the einsum leg OOMs at a big
+        # preset) must not cost the headline metric below.
+        try:
+            import dataclasses
+
+            per_impl = {}
+            for impl in ("xla", "pallas"):
+                c = dataclasses.replace(
+                    cfg, attn_impl=impl, remat=True,
+                    remat_policy="nothing",
+                )
+                t = tokens[:8]
+                r = auto_accelerate(
+                    GPT(c), opt, t, token_loss,
+                    spec=ParallelSpec(data=1), devices=[dev],
+                )
+                s = r.state
+                s, mm = r.train_step(s, t)
+                float(mm["loss"])  # compile + warm
+                _, per_impl[impl] = timed_steps(r.train_step, s, t, 5)
+                del r, s
+            attn_speedup = per_impl["xla"] / per_impl["pallas"]
+            log(f"bench: attention step {per_impl['xla']*1e3:.1f}ms "
+                f"(einsum) -> {per_impl['pallas']*1e3:.1f}ms (pallas): "
+                f"{attn_speedup:.2f}x")
+        except Exception as e:
+            log(f"bench: attention comparison skipped ({e})")
 
     # ---- flash checkpoint: dispatch latency + overlap measurement ----
     # Probe the host<->device path first: through a serialized tunnel
@@ -232,6 +268,10 @@ def main():
             "ckpt_staging_mbps": round(meas_bytes / 1e6 / staging_s, 1),
             "ckpt_restore_ms": round(restore_s * 1e3, 1),
             "ckpt_restore_ms_per_gb": round(restore_s * 1e3 / gb, 1),
+            **(
+                {"attn_pallas_speedup_vs_xla": round(attn_speedup, 2)}
+                if attn_speedup else {}
+            ),
         },
     }))
 
