@@ -23,3 +23,9 @@ from dlrover_tpu.accel.accelerate import (  # noqa: F401
     ParallelSpec,
     auto_accelerate,
 )
+from dlrover_tpu.accel.search import (  # noqa: F401
+    CostEstimate,
+    ModelProfile,
+    search_spec,
+)
+from dlrover_tpu.accel.tp_planner import plan_tp  # noqa: F401

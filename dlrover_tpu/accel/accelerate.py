@@ -215,16 +215,24 @@ def auto_accelerate(
     rng: Optional[Any] = None,
     profile: bool = False,
     profile_steps: int = 3,
-    allow_tensor: bool = False,
+    allow_tensor: Optional[bool] = None,
     grad_accum: int = 1,
     registry=None,
+    search_top_k: int = 4,
+    offload_optimizer: bool = False,
 ) -> AccelerateResult:
     """Analyze → choose strategy → build sharded state + train step.
 
     ``loss(module, params, batch) -> scalar``. ``spec`` may be a
-    ``ParallelSpec``, "auto" (heuristic), or "auto" + ``profile=True``
-    (dry-run-time every candidate and keep the fastest, parity:
-    ``auto/dry_runner/dry_runner.py``).
+    ``ParallelSpec``, "auto" (cost-model search over the full strategy
+    space, ``accel/search.py``), or "auto" + ``profile=True`` (dry-run
+    the top-K candidates and keep the fastest, parity:
+    ``auto/dry_runner/dry_runner.py``). ``allow_tensor``: None (default)
+    lets the search include tensor parallelism for framework models and
+    excludes it for plain ones; True enables planner-driven TP for
+    plain models; False forbids tensor candidates outright.
+    ``offload_optimizer=True`` keeps optimizer state at rest in host
+    memory (``optim/offload.py``).
     """
     import jax
 
@@ -232,9 +240,10 @@ def auto_accelerate(
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     n = len(devices)
 
-    def build(sp: ParallelSpec) -> AccelerateResult:
+    def build(sp: ParallelSpec, mod=None) -> AccelerateResult:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        mod = mod if mod is not None else module
         if sp.total > n:
             raise ValueError(f"{sp} needs {sp.total} devices, have {n}")
         mesh = create_mesh(
@@ -243,7 +252,7 @@ def auto_accelerate(
         rules = sp.rules()
 
         def init_fn(r):
-            variables = module.init(r, sample_batch)
+            variables = mod.init(r, sample_batch)
             params = variables["params"]
             return {
                 "params": params,
@@ -260,25 +269,86 @@ def auto_accelerate(
         if not has_annotations(abstract["params"]) and sp.total > 1:
             # Plain model (no logical-axis metadata): the registry's
             # path/shape rules make FSDP (and registered TP) work anyway.
+            reg = registry
+            if reg is None and (allow_tensor or sp.tensor > 1):
+                # Automatic TP placement (parity: mip_tp_planner.py):
+                # one abstract trace classifies every projection as
+                # column-/row-parallel; no hand-written register() calls.
+                from dlrover_tpu.accel.tp_planner import plan_tp
+
+                logger.info(
+                    "planning tensor-parallel placement automatically"
+                )
+                reg = plan_tp(mod, rng, sample_batch)
             logger.info(
                 "model carries no logical axes; auto-annotating via the "
                 "sharding registry"
             )
-            abstract = (registry or default_registry).annotate_state(
-                abstract
-            )
+            abstract = (reg or default_registry).annotate_state(abstract)
         _check_spec_axes_used(sp, abstract)
         shardings = state_shardings(mesh, abstract, rules)
+        opt = optimizer
+        if offload_optimizer:
+            from dlrover_tpu.optim.offload import (
+                host_memory_kind_supported,
+                normalize_shardings,
+                offload,
+                offload_shardings,
+            )
+
+            if host_memory_kind_supported(devices[0]):
+                abstract_opt = unbox(abstract["opt"])
+                dev_opt = normalize_shardings(
+                    shardings["opt"], abstract_opt
+                )
+                host_opt = offload_shardings(dev_opt, abstract_opt)
+                shardings = dict(shardings)
+                shardings["opt"] = host_opt
+                opt = offload(
+                    optimizer, device_shardings=dev_opt,
+                    host_shardings=host_opt,
+                )
+            else:
+                logger.warning(
+                    "offload_optimizer requested but this backend has "
+                    "no host memory space; keeping state in HBM"
+                )
         batch_axes = dict(rules)["batch"]
         batch_sharding = NamedSharding(
             mesh, P(*([batch_axes] + [None] * (sample_batch.ndim - 1)))
         )
+        # Materialize in default memory, then move the offloaded leaves
+        # eagerly: compiling the whole init with host-kind outputs makes
+        # XLA place init ops on the host, which not every runtime can
+        # execute (the train step only ever *transfers* across spaces).
+        init_shardings = shardings
+        post_init_put = None
+        if opt is not optimizer:  # offload active
+            init_shardings = dict(shardings)
+            init_shardings["opt"] = dev_opt
+
+            def post_init_put(state):
+                import jax as _jax
+
+                state = dict(state)
+                state["opt"] = jax.tree_util.tree_map(
+                    lambda s, x: _jax.device_put(x, s),
+                    shardings["opt"], state["opt"],
+                )
+                return state
+
         materialize = jax.jit(
-            lambda r: unbox(init_fn(r)), out_shardings=shardings
+            lambda r: unbox(init_fn(r)), out_shardings=init_shardings
         )
         state = materialize(rng)
+        if post_init_put is not None:
+            state = post_init_put(state)
+            _materialize_base = materialize
+
+            def materialize(r):
+                return post_init_put(_materialize_base(r))
         train_step = make_train_step(
-            module, optimizer, loss, mesh, rules, shardings,
+            mod, opt, loss, mesh, rules, shardings,
             batch_sharding, grad_accum=grad_accum,
         )
         return AccelerateResult(
@@ -290,46 +360,75 @@ def auto_accelerate(
     if isinstance(spec, ParallelSpec):
         return build(spec)
 
-    # ---- auto ----
-    def count_params() -> int:
+    # ---- auto: cost-model search over the full strategy space ----
+    import dataclasses as _dc
+
+    import numpy as np
+
+    from dlrover_tpu.accel.search import (
+        ModelProfile,
+        reconfigure_module,
+        search_spec,
+    )
+
+    def count_params(mod) -> int:
         abstract = jax.eval_shape(
-            lambda r: module.init(r, sample_batch), rng
+            lambda r: mod.init(r, sample_batch), rng
         )
         return sum(
             int(np.prod(l.shape))
             for l in jax.tree_util.tree_leaves(unbox(abstract))
         )
 
-    import numpy as np
-
-    params = count_params()
+    params = count_params(module)
     hbm = _device_hbm(devices)
-    chosen = choose_spec(params, n, hbm, allow_tensor)
+    cfg = getattr(module, "cfg", None)
+    if cfg is not None and _dc.is_dataclass(cfg):
+        mprofile = ModelProfile.from_config(cfg, param_count=params)
+        if allow_tensor is False:
+            # Explicit opt-out: strip the tensor capability from the
+            # search space (the default None lets the search decide —
+            # that IS the auto contract for framework models).
+            mprofile = _dc.replace(mprofile, num_heads=0)
+    else:
+        mprofile = ModelProfile.from_params(params)
+        if allow_tensor:
+            # Registry-annotated plain models can TP; expose it to the
+            # search by advertising a head count the degrees can divide.
+            mprofile = _dc.replace(mprofile, num_heads=n)
+
+    # Exact per-candidate state bytes need the abstract tree for the
+    # *reconfigured* module (pipe adds a stage axis); cache per reshape.
+    _abstract_cache = {}
+
+    def abstract_for(sp: ParallelSpec):
+        mod = reconfigure_module(module, sp, sample_batch.shape[0])
+        key = (sp.pipe, getattr(getattr(mod, "cfg", None), "attn_impl", None))
+        if key not in _abstract_cache:
+            def init_fn(r):
+                variables = mod.init(r, sample_batch)
+                p = variables["params"]
+                return {"params": p, "opt": optimizer.init(p), "step": 0}
+
+            _abstract_cache[key] = jax.eval_shape(init_fn, rng)
+        return _abstract_cache[key]
+
+    ranked = search_spec(
+        mprofile, n, batch_size=sample_batch.shape[0], hbm=hbm,
+        abstract_fn=abstract_for, top_k=max(1, search_top_k),
+    )
+    chosen = ranked[0][0]
     logger.info(
-        "auto_accelerate: %.1fM params on %s devices -> %s",
+        "auto_accelerate: %.1fM params on %s devices -> search chose %s",
         params / 1e6, n, chosen,
     )
-    if not profile:
-        return build(chosen)
-
-    candidates = {chosen}
-    candidates.add(ParallelSpec(data=n))
-    candidates.add(ParallelSpec(fsdp=n))
-    if n >= 4:
-        for f in _divisors_leq(n, n):
-            if 1 < f < n:
-                candidates.add(ParallelSpec(data=n // f, fsdp=f))
-    if allow_tensor:
-        for t in _divisors_leq(n, 8):
-            if t > 1:
-                candidates.add(ParallelSpec(data=n // t, tensor=t))
+    if not profile or len(ranked) == 1:
+        return build(chosen, reconfigure_module(module, chosen, sample_batch.shape[0]))
 
     best, best_time = None, float("inf")
-    import jax.numpy as jnp
-
-    for cand in sorted(candidates, key=lambda s: (s.fsdp, s.tensor)):
+    for cand, _est in ranked:
         try:
-            result = build(cand)
+            result = build(cand, reconfigure_module(module, cand, sample_batch.shape[0]))
             state, batch = result.state, jax.device_put(
                 sample_batch, result.batch_sharding
             )
@@ -347,4 +446,4 @@ def auto_accelerate(
             logger.warning("dry-run %s failed: %s", cand, e)
     if best is None:
         best = chosen
-    return build(best)
+    return build(best, reconfigure_module(module, best, sample_batch.shape[0]))

@@ -61,6 +61,10 @@ class ShardingRegistry:
     def axes_for(self, path: str, shape) -> Tuple:
         for pat, axes in self._rules:
             if pat.search(path):
+                if len(axes) < len(shape):
+                    # Leading lifted dims (nn.scan layer stacks, pipeline
+                    # stage banks) left-pad as unsharded.
+                    axes = (None,) * (len(shape) - len(axes)) + axes
                 if len(axes) != len(shape):
                     raise ValueError(
                         f"registered axes {axes} rank-mismatch param "

@@ -1,0 +1,177 @@
+"""Automatic tensor-parallel placement for arbitrary flax models.
+
+Parity: the reference's MIP TP planner
+(``atorch/atorch/auto/opt_lib/shard_planners/mip_tp_planner.py``, 496
+LoC: build the op graph, solve an integer program assigning each matmul a
+row/column shard that minimizes resharding). GSPMD collapses the problem:
+"placing" TP is just naming axes on kernels, and the graph signal needed
+to pair row- with column-parallel kernels is recoverable from ONE
+abstract trace — no solver required:
+
+1. a flax method interceptor records every projection call (path, in/out
+   widths, and the *identity* of its input tracer, in call order);
+2. classification per scope:
+   - expansion kernels (out > in) are column-parallel — shard the
+     output dim;
+   - contraction kernels (in > out) are row-parallel — shard the input
+     dim (the Megatron pair: no resharding between them);
+   - square kernels are disambiguated by dataflow: siblings sharing one
+     input tracer (q/k/v projections read the same normed hidden state)
+     are a column-parallel branch group; a later square kernel in a
+     scope that already has column shards is its row-parallel closer
+     (the attention output projection);
+3. the result is a :class:`ShardingRegistry` whose rules name the
+   ``mlp`` logical axis on those dims (mapped to the ``tensor`` mesh
+   axis by the sharding rules), stacked on the FSDP defaults.
+
+Embedding-like tables keep the registry defaults; an LM head whose
+output width equals the embedding vocab is sharded over ``vocab``.
+"""
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from dlrover_tpu.accel.registry import ShardingRegistry, default_registry
+from dlrover_tpu.common.log import logger
+
+
+@dataclass
+class _ProjRecord:
+    path: Tuple[str, ...]
+    in_features: int
+    out_features: int
+    input_id: int
+    order: int
+    role: Optional[str] = None  # "col" | "row" | None
+
+
+def _trace_projections(module, rng, *example_args) -> List[_ProjRecord]:
+    """One abstract init trace; record every call that looks like a
+    projection (last-dim-to-last-dim map on a >=2D input)."""
+    import flax.linen as nn
+
+    records: List[_ProjRecord] = []
+    counter = [0]
+
+    def interceptor(next_fn, args, kwargs, context):
+        out = next_fn(*args, **kwargs)
+        try:
+            x = args[0] if args else None
+            y = out[0] if isinstance(out, tuple) else out
+            if (
+                context.method_name == "__call__"
+                and hasattr(x, "shape") and hasattr(y, "shape")
+                and getattr(x, "ndim", 0) >= 2
+                and getattr(y, "ndim", 0) >= 2
+                and x.shape[:-1] == y.shape[:-1]
+                and context.module.path
+            ):
+                records.append(_ProjRecord(
+                    path=tuple(context.module.path),
+                    in_features=int(x.shape[-1]),
+                    out_features=int(y.shape[-1]),
+                    input_id=id(x),
+                    order=counter[0],
+                ))
+                counter[0] += 1
+        except Exception:
+            pass
+        return out
+
+    def trace():
+        with nn.intercept_methods(interceptor):
+            return module.init(rng, *example_args)
+
+    jax.eval_shape(trace)
+    return records
+
+
+def _classify(records: List[_ProjRecord]):
+    """Assign col/row roles per scope (see module docstring)."""
+    by_scope: Dict[Tuple, List[_ProjRecord]] = defaultdict(list)
+    for r in records:
+        by_scope[r.path[:-1]].append(r)
+
+    for scope, rs in by_scope.items():
+        rs.sort(key=lambda r: r.order)
+        # dataflow: same-input square siblings = column branch group
+        by_input: Dict[int, List[_ProjRecord]] = defaultdict(list)
+        for r in rs:
+            by_input[r.input_id].append(r)
+        for group in by_input.values():
+            squares = [
+                g for g in group if g.in_features == g.out_features
+            ]
+            if len(squares) >= 2:
+                for g in squares:
+                    g.role = "col"
+        for r in rs:
+            if r.role is not None:
+                continue
+            if r.out_features > r.in_features:
+                r.role = "col"
+            elif r.in_features > r.out_features:
+                r.role = "row"
+        # square closers: a still-unclassified square after any col in
+        # the same scope becomes its row-parallel pair
+        for i, r in enumerate(rs):
+            if r.role is None and r.in_features == r.out_features:
+                if any(
+                    p.role == "col" and p.order < r.order for p in rs
+                ):
+                    r.role = "row"
+    return records
+
+
+def plan_tp(
+    module,
+    rng,
+    *example_args,
+    vocab_size: Optional[int] = None,
+    base: Optional[ShardingRegistry] = None,
+) -> ShardingRegistry:
+    """Build a registry with automatic TP placement for ``module``.
+
+    Returns a fresh :class:`ShardingRegistry` whose rules cover the
+    model's projection kernels (column: ``(..., "embed", "mlp")``, row:
+    ``(..., "mlp", "embed")``); anything unmatched falls through to the
+    FSDP defaults. ``vocab_size`` (or the largest embedding dim found)
+    marks LM heads for ``vocab`` sharding.
+    """
+    import re
+
+    records = _classify(_trace_projections(module, rng, *example_args))
+    reg = ShardingRegistry()
+    if base is not None:
+        reg._rules.extend(base._rules)
+
+    n_col = n_row = 0
+    for r in records:
+        path = "/".join(r.path)
+        pattern = rf"^{re.escape(path)}/kernel$"
+        if r.role == "col":
+            # vocab sharding only for top-level heads: a block-internal
+            # expansion that merely *equals* the vocab width is mlp.
+            out_ax = (
+                "vocab"
+                if vocab_size and r.out_features == vocab_size
+                and len(r.path) == 1
+                else "mlp"
+            )
+            reg.register(pattern, ("embed", out_ax))
+            reg.register(
+                rf"^{re.escape(path)}/bias$", (out_ax,)
+            )
+            n_col += 1
+        elif r.role == "row":
+            reg.register(pattern, ("mlp", "embed"))
+            reg.register(rf"^{re.escape(path)}/bias$", (None,))
+            n_row += 1
+    logger.info(
+        "tp planner: %d column + %d row shards over %d projections",
+        n_col, n_row, len(records),
+    )
+    return reg

@@ -100,20 +100,14 @@ class BrainService:
 
     # ------------- strategy -------------
     def optimize(self, job_name: str) -> Dict:
-        """Resource plan from the job's history: p95 of observed usage
-        with headroom (reference's percentile sizing strategy)."""
+        """Resource plan from the job's history: every registered
+        algorithm runs and their partial plans merge (baseline p95
+        sizing + hot-node differentiation; see ``brain/algorithms.py``,
+        parity with the reference's optalgorithm library)."""
+        from dlrover_tpu.brain.algorithms import run_all
+
         with self._lock:
-            records = [
-                r for r in self._store.get(job_name, ())
-                if r.get("kind") == "node_resource"
-            ]
+            records = list(self._store.get(job_name, ()))
         if not records:
             return {}
-        mems = sorted(r.get("memory_mb", 0) for r in records)
-        cpus = sorted(r.get("cpu", 0.0) for r in records)
-        p95 = max(0, int(0.95 * len(mems)) - 1)
-        return {
-            "worker_memory_mb": int(mems[p95] * 1.2),
-            "worker_cpu": round(cpus[p95] / 100 * 1.2, 2),
-            "samples": len(records),
-        }
+        return run_all(records)

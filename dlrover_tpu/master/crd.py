@@ -1,0 +1,311 @@
+"""ElasticJob / ScalePlan CRD contract + reconciler.
+
+Vendored, typed mirror of the operator's CRD schemas
+(``dlrover/go/operator/api/v1alpha1/scaleplan_types.go`` and
+``elasticjob_types.go``): the exact field names and nesting the Go
+controller serializes, as dataclasses with ``to_manifest`` /
+``from_manifest`` round-trips. ``ElasticJobScaler`` emits THIS shape, so
+a real cluster's operator and the local platform see identical objects.
+
+``ScalePlanReconciler`` is the controller-pattern analog of
+``elasticjob_controller.go:85,182,215``: watch ScalePlan objects →
+realize them against the platform (here: ``ProcessScaler``) → update
+``status.phase``. Running the same watch→realize→status loop locally
+means the control flow is exercised end-to-end without a cluster, and a
+k8s backend only swaps the scaler implementation.
+"""
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+
+API_VERSION = "elastic.iml.github.io/v1alpha1"
+
+# JobConditionType phases used by ScalePlanStatus (common/api/v1 types).
+PHASE_PENDING = "Pending"
+PHASE_SCALING = "Scaling"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+
+
+@dataclass
+class ReplicaResourceSpec:
+    """scaleplan_types.go ReplicaResourceSpec: replica count + a
+    corev1.ResourceList-shaped resource map ({"cpu": "4",
+    "memory": "8Gi"})."""
+
+    replicas: int = 0
+    resource: Dict[str, str] = field(default_factory=dict)
+
+    def to_manifest(self) -> Dict:
+        return {"replicas": self.replicas, "resource": dict(self.resource)}
+
+    @staticmethod
+    def from_manifest(doc: Dict) -> "ReplicaResourceSpec":
+        return ReplicaResourceSpec(
+            replicas=int(doc.get("replicas", 0)),
+            resource=dict(doc.get("resource", {})),
+        )
+
+
+@dataclass
+class PodMeta:
+    """scaleplan_types.go PodMeta."""
+
+    name: str = ""
+    id: int = 0
+    type: str = "worker"
+    rank_index: int = 0
+    service: str = ""
+    resource: Dict[str, str] = field(default_factory=dict)
+
+    def to_manifest(self) -> Dict:
+        return {
+            "name": self.name,
+            "id": self.id,
+            "type": self.type,
+            "rankIndex": self.rank_index,
+            "service": self.service,
+            "resource": dict(self.resource),
+        }
+
+    @staticmethod
+    def from_manifest(doc: Dict) -> "PodMeta":
+        return PodMeta(
+            name=doc.get("name", ""),
+            id=int(doc.get("id", 0)),
+            type=doc.get("type", "worker"),
+            rank_index=int(doc.get("rankIndex", 0)),
+            service=doc.get("service", ""),
+            resource=dict(doc.get("resource", {})),
+        )
+
+
+@dataclass
+class ScaleSpec:
+    """scaleplan_types.go ScaleSpec (psHosts omitted: no PS on TPU
+    SPMD — SURVEY §2.2 elastic_ps N/A)."""
+
+    replica_resource_specs: Dict[str, ReplicaResourceSpec] = field(
+        default_factory=dict
+    )
+    create_pods: List[PodMeta] = field(default_factory=list)
+    remove_pods: List[PodMeta] = field(default_factory=list)
+    migrate_pods: List[PodMeta] = field(default_factory=list)
+    owner_job: str = ""
+
+    def to_manifest(self) -> Dict:
+        return {
+            "replicaResourceSpecs": {
+                k: v.to_manifest()
+                for k, v in self.replica_resource_specs.items()
+            },
+            "createPods": [p.to_manifest() for p in self.create_pods],
+            "removePods": [p.to_manifest() for p in self.remove_pods],
+            "migratePods": [p.to_manifest() for p in self.migrate_pods],
+            "ownerJob": self.owner_job,
+        }
+
+    @staticmethod
+    def from_manifest(doc: Dict) -> "ScaleSpec":
+        return ScaleSpec(
+            replica_resource_specs={
+                k: ReplicaResourceSpec.from_manifest(v)
+                for k, v in doc.get("replicaResourceSpecs", {}).items()
+            },
+            create_pods=[
+                PodMeta.from_manifest(p) for p in doc.get("createPods", [])
+            ],
+            remove_pods=[
+                PodMeta.from_manifest(p) for p in doc.get("removePods", [])
+            ],
+            migrate_pods=[
+                PodMeta.from_manifest(p)
+                for p in doc.get("migratePods", [])
+            ],
+            owner_job=doc.get("ownerJob", ""),
+        )
+
+
+@dataclass
+class ScalePlanStatus:
+    create_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    phase: str = PHASE_PENDING
+
+    def to_manifest(self) -> Dict:
+        return {
+            "createTime": self.create_time,
+            "finishTime": self.finish_time,
+            "phase": self.phase,
+        }
+
+
+@dataclass
+class ScalePlanCRD:
+    """The full namespaced object (TypeMeta + ObjectMeta + spec/status)."""
+
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    spec: ScaleSpec = field(default_factory=ScaleSpec)
+    status: ScalePlanStatus = field(default_factory=ScalePlanStatus)
+
+    def to_manifest(self) -> Dict:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": "ScalePlan",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "labels": dict(self.labels),
+            },
+            "spec": self.spec.to_manifest(),
+            "status": self.status.to_manifest(),
+        }
+
+    @staticmethod
+    def from_manifest(doc: Dict) -> "ScalePlanCRD":
+        meta = doc.get("metadata", {})
+        status = doc.get("status", {})
+        out = ScalePlanCRD(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            labels=dict(meta.get("labels", {})),
+            spec=ScaleSpec.from_manifest(doc.get("spec", {})),
+        )
+        out.status = ScalePlanStatus(
+            create_time=status.get("createTime"),
+            finish_time=status.get("finishTime"),
+            phase=status.get("phase", PHASE_PENDING),
+        )
+        return out
+
+
+def scaleplan_from_plan(plan, job_name: str, seq: int) -> ScalePlanCRD:
+    """Translate the master's internal ScalePlan into the CRD shape the
+    operator consumes (what ``pod_scaler``/``elasticjob_scaler`` build in
+    the reference)."""
+
+    def res_list(r) -> Dict[str, str]:
+        out = {}
+        if getattr(r, "cpu", 0):
+            out["cpu"] = str(r.cpu)
+        if getattr(r, "memory_mb", 0):
+            out["memory"] = f"{r.memory_mb}Mi"
+        return out
+
+    spec = ScaleSpec(owner_job=job_name)
+    for group, g in getattr(plan, "node_group_resources", {}).items():
+        spec.replica_resource_specs[group] = ReplicaResourceSpec(
+            replicas=g.count, resource=res_list(g.node_resource)
+        )
+    for n in getattr(plan, "launch_nodes", []):
+        ri = getattr(n, "rank_index", None)
+        spec.create_pods.append(PodMeta(
+            name=f"{job_name}-{n.type}-{n.id}", id=n.id, type=n.type,
+            rank_index=ri if ri is not None else n.id,
+            resource=res_list(getattr(n, "resource", None) or object()),
+        ))
+    for n in getattr(plan, "remove_nodes", []):
+        spec.remove_pods.append(PodMeta(
+            name=f"{job_name}-{n.type}-{n.id}", id=n.id, type=n.type,
+        ))
+    crd = ScalePlanCRD(
+        name=f"{job_name}-scaleplan-{seq}",
+        labels={"elasticjob-name": job_name, "scale-type": "auto"},
+        spec=spec,
+    )
+    crd.status.create_time = time.time()
+    return crd
+
+
+class ScalePlanStore:
+    """The watchable object store (a cluster's etcd, one queue deep).
+    ``ElasticJobScaler`` writes here; the reconciler watches it."""
+
+    def __init__(self):
+        self._q: "queue.Queue[ScalePlanCRD]" = queue.Queue()
+        self.applied: List[ScalePlanCRD] = []
+
+    def submit(self, crd: ScalePlanCRD):
+        self._q.put(crd)
+
+    # Back-compat with the injected-client contract (client.patch(body)).
+    def patch(self, body: Dict):
+        self.submit(ScalePlanCRD.from_manifest(body))
+
+    def watch(self, timeout: float = 0.5) -> Optional[ScalePlanCRD]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class ScalePlanReconciler:
+    """elasticjob_controller.go's reconcile loop, platform-agnostic:
+    watch plans → realize (create/remove through the scaler backend) →
+    stamp ``status.phase``. The local backend is ``ProcessScaler``; a
+    k8s backend would swap in a pod-creating scaler with zero changes
+    here."""
+
+    def __init__(self, store: ScalePlanStore, scaler,
+                 node_factory=None):
+        from dlrover_tpu.common.node import Node
+
+        self._store = store
+        self._scaler = scaler
+        self._node_factory = node_factory or (
+            lambda pm: Node(pm.type, pm.id)
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="scaleplan-reconciler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            crd = self._store.watch(timeout=0.2)
+            if crd is not None:
+                self.reconcile(crd)
+
+    def reconcile(self, crd: ScalePlanCRD):
+        from dlrover_tpu.master.node_manager import ScalePlan
+
+        crd.status.phase = PHASE_SCALING
+        try:
+            plan = ScalePlan(
+                launch_nodes=[
+                    self._node_factory(pm) for pm in crd.spec.create_pods
+                ],
+                remove_nodes=[
+                    self._node_factory(pm) for pm in crd.spec.remove_pods
+                ],
+            )
+            self._scaler.scale(plan)
+            crd.status.phase = PHASE_SUCCEEDED
+        except Exception:
+            logger.exception("reconcile failed for %s", crd.name)
+            crd.status.phase = PHASE_FAILED
+        crd.status.finish_time = time.time()
+        self._store.applied.append(crd)
+        logger.info(
+            "reconciled %s: create=%s remove=%s -> %s",
+            crd.name,
+            [p.id for p in crd.spec.create_pods],
+            [p.id for p in crd.spec.remove_pods],
+            crd.status.phase,
+        )

@@ -87,6 +87,14 @@ class ProcessScaler(Scaler):
                 nid for nid, p in self._procs.items() if p.poll() is None
             ]
 
+    def dead_nodes(self) -> List[int]:
+        """Launched nodes whose process has exited (not yet removed)."""
+        with self._lock:
+            return [
+                nid for nid, p in self._procs.items()
+                if p.poll() is not None
+            ]
+
     def stop(self):
         with self._lock:
             ids = list(self._procs)
@@ -95,33 +103,25 @@ class ProcessScaler(Scaler):
 
 
 class ElasticJobScaler(Scaler):
-    """Operator integration point: a ScalePlan becomes a patch to the
-    ElasticJob resource (reference ``scaler/elasticjob_scaler.py``). The
-    ``client`` is any object with ``patch(body: dict)`` — the real k8s
-    client on a cluster, a fake in tests."""
+    """Operator integration point: a ScalePlan becomes a ScalePlan *CRD
+    manifest* — the exact schema the Go controller consumes
+    (``scaleplan_types.go``; vendored as ``master/crd.py``) — submitted
+    through the ``client`` (``patch(body: dict)``): the real k8s client
+    on a cluster, a ``ScalePlanStore`` + reconciler locally."""
 
     def __init__(self, client, job_name: str):
         self._client = client
         self._job_name = job_name
+        self._seq = 0
 
     def scale(self, plan: ScalePlan):
-        body = {
-            "job": self._job_name,
-            "replicas": {
-                group: {
-                    "replicas": res.count,
-                    "resource": {
-                        "cpu": res.node_resource.cpu,
-                        "memory_mb": res.node_resource.memory_mb,
-                    },
-                }
-                for group, res in plan.node_group_resources.items()
-            },
-            "launch": [n.id for n in plan.launch_nodes],
-            "remove": [n.id for n in plan.remove_nodes],
-        }
+        from dlrover_tpu.master.crd import scaleplan_from_plan
+
+        self._seq += 1
+        crd = scaleplan_from_plan(plan, self._job_name, self._seq)
+        body = crd.to_manifest()
         self._client.patch(body)
-        logger.info("elasticjob scaler patched: %s", body)
+        logger.info("elasticjob scaler submitted %s", crd.name)
 
 
 # ---------------------------------------------------------------- watcher
@@ -135,16 +135,24 @@ class ProcessWatcher:
                  interval: float = 1.0):
         self._scaler = scaler
         self._job_manager = job_manager
-        self._known_alive: set = set()
+        self._reported_dead: set = set()
         self._task = PeriodicTask(self._poll, interval, "process-watcher")
 
     def _poll(self):
-        alive = set(self._scaler.alive_nodes())
-        for died in self._known_alive - alive:
+        # Ask the platform for *exited* launches directly rather than
+        # diffing alive sets: a process that dies between two polls (or
+        # before the first) must still produce its failure event.
+        dead = set(self._scaler.dead_nodes())
+        # A relaunch (same id, alive again) clears the report marker so
+        # a second death re-reports.
+        self._reported_dead &= dead
+        for died in dead:
+            if died in self._reported_dead:
+                continue
+            self._reported_dead.add(died)
             logger.info("watcher: node %s process exited", died)
             self._job_manager.update_node_status(died, "failed",
                                                  "process-exit")
-        self._known_alive = alive
 
     def list(self) -> List[int]:
         return self._scaler.alive_nodes()

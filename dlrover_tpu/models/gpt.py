@@ -44,7 +44,7 @@ class GPTConfig:
     # throughput/memory point when activations almost fit).
     remat_policy: str = "nothing"
     scan_layers: bool = True
-    attn_impl: str = "xla"  # "xla" | "pallas" | "ring"
+    attn_impl: str = "xla"  # "xla" | "pallas" | "ring" | "ulysses"
     attn_block_q: int = 512  # pallas kernel tile sizes
     attn_block_k: int = 512
     dropout: float = 0.0
@@ -56,21 +56,21 @@ class GPTConfig:
     # Pipeline parallelism (0 = off). With pipeline_stages > 1 the blocks
     # are split into equal stages run as a GPipe schedule
     # (dlrover_tpu.accel.pipeline); pair with ParallelSpec(pipe=stages).
+    # pipeline_repeats > 1 selects the circular/interleaved schedule
+    # (CircularPipeline): stages*repeats chunks, ~repeats x smaller
+    # bubble; requires microbatches >= stages. MoE composes with both
+    # (the aux loss rides the pipeline carry).
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0  # 0 -> = pipeline_stages
+    pipeline_repeats: int = 1
 
     def __post_init__(self):
         if self.pipeline_stages > 1:
-            if self.num_layers % self.pipeline_stages:
+            chunks = self.pipeline_stages * max(self.pipeline_repeats, 1)
+            if self.num_layers % chunks:
                 raise ValueError(
                     f"num_layers {self.num_layers} not divisible by "
-                    f"pipeline_stages {self.pipeline_stages}"
-                )
-            if self.num_experts > 0:
-                raise ValueError(
-                    "pipeline_stages and num_experts are mutually "
-                    "exclusive for now (MoE aux-loss aggregation through "
-                    "the pipeline schedule is not implemented)"
+                    f"pipeline_stages*repeats {chunks}"
                 )
 
     @property
@@ -155,6 +155,10 @@ def _attention(q, k, v, cfg: GPTConfig):
         from dlrover_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, causal=True, axis_name="seq")
+    if cfg.attn_impl == "ulysses":
+        from dlrover_tpu.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, causal=True, axis_name="seq")
     scale = 1.0 / np.sqrt(cfg.head_dim)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     s = q.shape[1]
@@ -213,22 +217,39 @@ class Block(nn.Module):
 
 
 
-def _remat_policy(cfg: "GPTConfig"):
+def _remat_policy(cfg):
+    """Shared by GPT and Llama (duck-typed on ``remat_policy``).
+
+    - "nothing": recompute everything (min HBM);
+    - "dots": save matmul outputs (usual throughput/memory sweet spot);
+    - "offload": save matmul outputs to *host* memory — activations
+      leave HBM between fwd and bwd (parity: the reference's
+      ``selective_offloading_checkpoint.py``); XLA streams them back
+      over DMA during the backward pass.
+    """
     if cfg.remat_policy == "dots":
         return jax.checkpoint_policies.checkpoint_dots
+    if cfg.remat_policy == "offload":
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host"
+        )
     return jax.checkpoint_policies.nothing_saveable
 
 
 class _GPTStage(nn.Module):
-    """One pipeline stage: ``num_layers / pipeline_stages`` blocks.
-    Used as the ``make_stage`` body of ``accel.pipeline.Pipeline``."""
+    """One pipeline chunk: ``num_layers / (stages * repeats)`` blocks.
+    Used as the ``make_stage`` body of ``accel.pipeline.Pipeline`` /
+    ``CircularPipeline``. MoE chunks return ``(x, aux_mean)`` so the
+    load-balance loss rides the pipeline carry."""
 
     cfg: GPTConfig
 
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        per_stage = cfg.num_layers // cfg.pipeline_stages
+        per_stage = cfg.num_layers // (
+            cfg.pipeline_stages * max(cfg.pipeline_repeats, 1)
+        )
         block = Block
         if cfg.remat:
             block = nn.remat(
@@ -236,16 +257,23 @@ class _GPTStage(nn.Module):
                 policy=_remat_policy(cfg),
             )
         if cfg.scan_layers:
-            x, _ = nn.scan(
+            x, aux = nn.scan(
                 block,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=per_stage,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="blocks")(x)
+            aux_mean = jnp.mean(aux) if aux is not None else None
         else:
+            auxes = []
             for i in range(per_stage):
-                x, _ = block(cfg, name=f"block_{i}")(x)
+                x, aux = block(cfg, name=f"block_{i}")(x)
+                if aux is not None:
+                    auxes.append(aux)
+            aux_mean = jnp.mean(jnp.stack(auxes)) if auxes else None
+        if cfg.num_experts > 0:
+            return x, aux_mean
         return x
 
 
@@ -278,20 +306,42 @@ class GPT(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
         if cfg.pipeline_stages > 1:
-            from dlrover_tpu.accel.pipeline import Pipeline
+            from dlrover_tpu.accel.pipeline import (
+                CircularPipeline,
+                Pipeline,
+            )
 
-            x = Pipeline(
-                make_stage=lambda: _GPTStage(cfg, name="stage"),
-                num_stages=cfg.pipeline_stages,
-                num_microbatches=cfg.pipeline_microbatches,
-                carry_axes=("batch", "seq", "embed"),
-                name="pipeline",
-            )(x)
+            if cfg.pipeline_repeats > 1:
+                out = CircularPipeline(
+                    make_stage=lambda: _GPTStage(cfg, name="stage"),
+                    num_stages=cfg.pipeline_stages,
+                    num_repeats=cfg.pipeline_repeats,
+                    num_microbatches=cfg.pipeline_microbatches,
+                    carry_axes=("batch", "seq", "embed"),
+                    name="pipeline",
+                )(x)
+            else:
+                out = Pipeline(
+                    make_stage=lambda: _GPTStage(cfg, name="stage"),
+                    num_stages=cfg.pipeline_stages,
+                    num_microbatches=cfg.pipeline_microbatches,
+                    carry_axes=("batch", "seq", "embed"),
+                    has_aux=cfg.num_experts > 0,
+                    name="pipeline",
+                )(x)
+            aux_total = None
+            if cfg.num_experts > 0:
+                x, aux_total = out
+            else:
+                x = out
             x = _layernorm("ln_f", cfg)(x)
             logits = embed.attend(x)  # module dtype (bf16): full MXU rate
-            return nn.with_logical_constraint(
+            logits = nn.with_logical_constraint(
                 logits, ("batch", "seq", "vocab")
             )
+            if cfg.num_experts > 0:
+                return logits, aux_total
+            return logits
 
         block = Block
         if cfg.remat:

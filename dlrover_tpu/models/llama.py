@@ -26,7 +26,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dlrover_tpu.models.gpt import _attention, loss_fn  # shared kernel path
+from dlrover_tpu.models.gpt import (  # shared kernel + remat paths
+    _attention,
+    _remat_policy,
+    loss_fn,
+)
 
 __all__ = ["LlamaConfig", "Llama", "loss_fn"]
 
@@ -46,9 +50,15 @@ class LlamaConfig:
     remat: bool = False
     remat_policy: str = "nothing"
     scan_layers: bool = True
-    attn_impl: str = "xla"  # "xla" | "pallas" | "ring"
+    attn_impl: str = "xla"  # "xla" | "pallas" | "ring" | "ulysses"
     attn_block_q: int = 512
     attn_block_k: int = 512
+    # Pipeline parallelism (0 = off): same contract as GPTConfig —
+    # stages run as GPipe (repeats == 1) or the circular/interleaved
+    # schedule (repeats > 1); pair with ParallelSpec(pipe=stages).
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0  # 0 -> = pipeline_stages
+    pipeline_repeats: int = 1
 
     def __post_init__(self):
         if self.kv_heads > self.num_heads or self.num_heads % self.kv_heads:
@@ -56,6 +66,13 @@ class LlamaConfig:
                 f"num_kv_heads {self.kv_heads} must divide num_heads "
                 f"{self.num_heads}"
             )
+        if self.pipeline_stages > 1:
+            chunks = self.pipeline_stages * max(self.pipeline_repeats, 1)
+            if self.num_layers % chunks:
+                raise ValueError(
+                    f"num_layers {self.num_layers} not divisible by "
+                    f"pipeline_stages*repeats {chunks}"
+                )
 
     @property
     def kv_heads(self) -> int:
@@ -170,6 +187,37 @@ class LlamaBlock(nn.Module):
         return x, None
 
 
+class _LlamaStage(nn.Module):
+    """One pipeline chunk: ``num_layers / (stages * repeats)`` blocks
+    (same contract as ``gpt._GPTStage``)."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        per_stage = cfg.num_layers // (
+            cfg.pipeline_stages * max(cfg.pipeline_repeats, 1)
+        )
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(
+                LlamaBlock, prevent_cse=False, policy=_remat_policy(cfg)
+            )
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=per_stage,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="blocks")(x)
+        else:
+            for i in range(per_stage):
+                x, _ = block(cfg, name=f"block_{i}")(x)
+        return x
+
+
 class Llama(nn.Module):
     """Decoder-only LM. ``__call__(tokens[B,S]) -> logits[B,S,V]``."""
 
@@ -190,14 +238,40 @@ class Llama(nn.Module):
         x = embed(tokens)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
+        if cfg.pipeline_stages > 1:
+            from dlrover_tpu.accel.pipeline import (
+                CircularPipeline,
+                Pipeline,
+            )
+
+            pipe_cls = (
+                CircularPipeline if cfg.pipeline_repeats > 1 else Pipeline
+            )
+            kw = (
+                {"num_repeats": cfg.pipeline_repeats}
+                if cfg.pipeline_repeats > 1 else {}
+            )
+            x = pipe_cls(
+                make_stage=lambda: _LlamaStage(cfg, name="stage"),
+                num_stages=cfg.pipeline_stages,
+                num_microbatches=cfg.pipeline_microbatches,
+                carry_axes=("batch", "seq", "embed"),
+                name="pipeline",
+                **kw,
+            )(x)
+            x = _rms_norm("final_norm", cfg)(x)
+            logits = _dense(
+                cfg.vocab_size, "lm_head", ("embed", "vocab"), cfg
+            )(x)
+            return nn.with_logical_constraint(
+                logits, ("batch", "seq", "vocab")
+            )
+
         block = LlamaBlock
         if cfg.remat:
-            policy = (
-                jax.checkpoint_policies.checkpoint_dots
-                if cfg.remat_policy == "dots"
-                else jax.checkpoint_policies.nothing_saveable
+            block = nn.remat(
+                LlamaBlock, prevent_cse=False, policy=_remat_policy(cfg)
             )
-            block = nn.remat(LlamaBlock, prevent_cse=False, policy=policy)
         if cfg.scan_layers:
             x, _ = nn.scan(
                 block,

@@ -12,8 +12,11 @@ ports.
 from dlrover_tpu.ops.attention import flash_attention, reference_attention
 from dlrover_tpu.ops.moe import MoEMLP, compute_dispatch, load_balance_loss
 from dlrover_tpu.ops.ring_attention import ring_attention, ring_attention_shard
+from dlrover_tpu.ops.ulysses import ulysses_attention, ulysses_attention_shard
 
 __all__ = [
+    "ulysses_attention",
+    "ulysses_attention_shard",
     "flash_attention",
     "reference_attention",
     "ring_attention",

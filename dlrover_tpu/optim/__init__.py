@@ -11,6 +11,7 @@ other computation, and the low-bit kernels are XLA-fused instead of CUDA.
 from dlrover_tpu.optim.agd import agd
 from dlrover_tpu.optim.bf16 import bf16_master_weights
 from dlrover_tpu.optim.low_bit import adam8bit
+from dlrover_tpu.optim.offload import offload
 from dlrover_tpu.optim.wsam import WeightedSAM
 
-__all__ = ["agd", "WeightedSAM", "bf16_master_weights", "adam8bit"]
+__all__ = ["agd", "WeightedSAM", "bf16_master_weights", "adam8bit", "offload"]

@@ -61,6 +61,20 @@ def adam8bit(
     """Adam with int8 blockwise-quantized moments (8-bit optimizer)."""
 
     def init(params):
+        # Strip flax partitioning boxes first: quantized blocks are a
+        # *flattened* relayout of the param, so the param's logical axis
+        # names do not apply to them — a box left wrapping a _QTensor
+        # would broadcast one (rank-mismatched) sharding over q and
+        # scale. The moments are replicated instead: at ~2 bytes/param
+        # that is the 8-bit optimizer's single-chip memory story; under
+        # FSDP the fp32 master path is the sharded one.
+        try:
+            import flax.linen as nn
+
+            params = nn.meta.unbox(params)
+        except Exception:
+            pass
+
         def qzero(p):
             return _quantize(jnp.zeros_like(p, jnp.float32), block_size)
 

@@ -24,6 +24,16 @@ TPU-first split of the same capability:
 Checkpoint: ``export()`` returns ``(ids, values)`` of live rows only;
 ``import_()`` rebuilds the map — world-size independent, so a restore
 can reshard/repartition keys freely.
+
+Tiered storage (parity: tfplus hybrid DRAM/SSD tables,
+``tfplus/tfplus/kv_variable/kernels/storage_table.h`` /
+``table_manager.h``): with ``max_capacity`` set, the device table stops
+doubling at that row count and *cold rows spill to host RAM* instead —
+an LRU keyed on last-touch tick. A spilled id transparently restores on
+its next lookup (evicting the then-coldest row). Optimizer slot tables
+follow evictions/restores through the slot-listener interface
+(``attach_slot_listener``), so a key's Adam moments survive a trip
+through the host tier.
 """
 
 from typing import Callable, Dict, Optional, Tuple
@@ -45,9 +55,12 @@ class KvVariable:
         dtype=jnp.float32,
         initializer: Optional[Callable] = None,
         seed: int = 0,
+        max_capacity: Optional[int] = None,
     ):
         if capacity <= 0 or dim <= 0:
             raise ValueError("capacity and dim must be positive")
+        if max_capacity is not None and max_capacity < capacity:
+            raise ValueError("max_capacity must be >= capacity")
         self.dim = dim
         self.dtype = dtype
         self._initializer = initializer or (
@@ -56,9 +69,27 @@ class KvVariable:
         )
         self._key = jax.random.PRNGKey(seed)
         self._capacity = capacity
-        self._slots: Dict[int, int] = {}     # id -> slot
+        self._max_capacity = max_capacity
+        self._slots: Dict[int, int] = {}     # id -> slot (device-resident)
         self._next_slot = 0
         self.table = self._init_rows(capacity)
+        # host tier: id -> (value_row, {listener_name: payload_row})
+        self._host_store: Dict[int, tuple] = {}
+        # LRU order: oldest-touched first (OrderedDict keyed by id).
+        from collections import OrderedDict
+
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._listeners: Dict[str, object] = {}
+
+    # ------------- slot listeners (optimizer tables) -------------
+    def attach_slot_listener(self, name: str, listener):
+        """``listener`` mirrors per-slot state (an optimizer's m/v/count
+        rows). Contract: ``extract_rows(slots) -> payload`` (host
+        arrays, stacked over slots), ``write_rows(slots, payload)``,
+        ``reset_rows(slots)`` (zero recycled slots so a new key never
+        inherits an evicted key's state), and ``on_grow(new_cap)``.
+        Evicted rows carry their payload into the host tier and back."""
+        self._listeners[name] = listener
 
     # ------------- internals -------------
     def _init_rows(self, n: int):
@@ -69,34 +100,148 @@ class KvVariable:
         new_cap = self._capacity
         while new_cap < need:
             new_cap *= 2
+        if self._max_capacity is not None:
+            new_cap = min(new_cap, self._max_capacity)
+        if new_cap <= self._capacity:
+            return
         fresh = self._init_rows(new_cap - self._capacity)
         self.table = jnp.concatenate([self.table, fresh], axis=0)
         logger.info("KvVariable grew %s -> %s slots",
                     self._capacity, new_cap)
         self._capacity = new_cap
+        for listener in self._listeners.values():
+            listener.on_grow(new_cap)
+
+    def _pick_victim(self, protect: set) -> int:
+        """Oldest resident id not referenced by the current batch
+        (O(#protected) thanks to LRU ordering)."""
+        for key in self._lru:
+            if key not in protect:
+                return key
+        raise RuntimeError(
+            "KvVariable: every resident id is referenced by the "
+            "current batch; raise max_capacity above the per-batch "
+            "unique-id count"
+        )
 
     # ------------- lookup / update -------------
     def to_slots(self, ids, allocate: bool = True) -> np.ndarray:
         """Map ids -> slot indices (host side). ``allocate=True`` admits
         unseen ids (training); ``False`` marks them -1 (lookup returns a
         zero row for them — inference on unknown keys must not leak some
-        other key's trained embedding)."""
+        other key's trained embedding). Spilled ids restore from the
+        host tier, evicting the coldest resident rows.
+
+        Two phases: plan slot assignments on the host (victim picks via
+        the LRU ordering), then apply all device work batched — one
+        gather of evicted rows, one scatter of restored/fresh rows, one
+        listener extract/write/reset each — so admitting k cold ids
+        costs O(k) and a constant number of device round-trips, not
+        O(k·N) scans with per-row transfers."""
         ids = np.asarray(ids).reshape(-1)
+        protect = {int(r) for r in ids}
         out = np.empty(ids.shape, np.int32)
+
+        evict_keys: list = []     # victims, aligned with their slots
+        evict_slots: list = []
+        restore: list = []        # (key, slot) landing from host tier
+        fresh_recycled: list = []  # slots needing re-init + reset
+
         for i, raw in enumerate(ids):
             key = int(raw)
             slot = self._slots.get(key)
             if slot is None:
-                if not allocate:
+                known = key in self._host_store
+                if not allocate and not known:
                     out[i] = -1
                     continue
-                if self._next_slot >= self._capacity:
+                if self._next_slot < self._capacity:
+                    slot = self._next_slot
+                    self._next_slot += 1
+                else:
                     self._grow(self._next_slot + 1)
-                slot = self._next_slot
+                    if self._next_slot < self._capacity:
+                        slot = self._next_slot
+                        self._next_slot += 1
+                    else:
+                        victim = self._pick_victim(protect)
+                        slot = self._slots.pop(victim)
+                        self._lru.pop(victim, None)
+                        evict_keys.append(victim)
+                        evict_slots.append(slot)
+                        if not known:
+                            fresh_recycled.append(slot)
+                if known:
+                    restore.append((key, slot))
                 self._slots[key] = slot
-                self._next_slot += 1
+            self._lru[key] = None
+            self._lru.move_to_end(key)
             out[i] = slot
+
+        self._apply_tier_moves(evict_keys, evict_slots, restore,
+                               fresh_recycled)
         return out
+
+    def _apply_tier_moves(self, evict_keys, evict_slots, restore,
+                          fresh_recycled):
+        """Batched device work for one ``to_slots`` call. Victim rows
+        are read before any write: victims keep sole ownership of their
+        slots until eviction (restored/fresh ids are in ``protect``),
+        so the gather sees unmodified rows."""
+        if evict_keys:
+            slots_arr = np.asarray(evict_slots)
+            rows = np.asarray(jnp.take(
+                self.table, jnp.asarray(slots_arr), axis=0
+            ))
+            payloads = {
+                name: listener.extract_rows(slots_arr)
+                for name, listener in self._listeners.items()
+            }
+            for i, key in enumerate(evict_keys):
+                per_key = {
+                    name: jax.tree_util.tree_map(lambda a: a[i:i + 1], p)
+                    for name, p in payloads.items()
+                }
+                self._host_store[key] = (rows[i], per_key)
+        if restore:
+            slots_arr = np.asarray([s for _, s in restore])
+            stored = [self._host_store.pop(k) for k, _ in restore]
+            self.table = self.table.at[jnp.asarray(slots_arr)].set(
+                jnp.asarray(
+                    np.stack([row for row, _ in stored]),
+                    self.table.dtype,
+                )
+            )
+            for name, listener in self._listeners.items():
+                have = [
+                    (i, pl[name]) for i, (_, pl) in enumerate(stored)
+                    if name in pl
+                ]
+                if have:
+                    idx = [i for i, _ in have]
+                    listener.write_rows(
+                        slots_arr[idx],
+                        jax.tree_util.tree_map(
+                            lambda *xs: np.concatenate(xs),
+                            *[p for _, p in have],
+                        ),
+                    )
+                # Rows spilled without this listener's payload (e.g.
+                # import_()-seeded entries) land on recycled slots that
+                # may hold an evicted key's state: zero them.
+                missing = [
+                    i for i, (_, pl) in enumerate(stored)
+                    if name not in pl
+                ]
+                if missing:
+                    listener.reset_rows(slots_arr[missing])
+        if fresh_recycled:
+            slots_arr = np.asarray(fresh_recycled)
+            self.table = self.table.at[jnp.asarray(slots_arr)].set(
+                self._init_rows(len(fresh_recycled))
+            )
+            for listener in self._listeners.values():
+                listener.reset_rows(slots_arr)
 
     def lookup(self, ids, allocate: bool = True):
         """Gather rows for ids; shape ``ids.shape + (dim,)``. Unknown ids
@@ -128,46 +273,82 @@ class KvVariable:
     # ------------- introspection / checkpoint -------------
     @property
     def size(self) -> int:
+        return len(self._slots) + len(self._host_store)
+
+    @property
+    def resident_size(self) -> int:
         return len(self._slots)
+
+    @property
+    def spilled_size(self) -> int:
+        return len(self._host_store)
 
     @property
     def capacity(self) -> int:
         return self._capacity
 
     def export(self) -> Tuple[np.ndarray, np.ndarray]:
-        """(ids, values) of live rows — the checkpoint payload."""
-        if not self._slots:
+        """(ids, values) of live rows — both tiers — the checkpoint
+        payload."""
+        n = self.size
+        if not n:
             return np.zeros(0, np.int64), np.zeros(
                 (0, self.dim), np.dtype(self.table.dtype)
             )
-        ids = np.fromiter(self._slots.keys(), np.int64, len(self._slots))
-        slots = np.fromiter(self._slots.values(), np.int64,
-                            len(self._slots))
-        values = np.asarray(jnp.take(
-            self.table, jnp.asarray(slots), axis=0
-        ))
+        ids = np.empty(n, np.int64)
+        values = np.empty((n, self.dim), np.dtype(self.table.dtype))
+        if self._slots:
+            res_ids = np.fromiter(
+                self._slots.keys(), np.int64, len(self._slots)
+            )
+            slots = np.fromiter(
+                self._slots.values(), np.int64, len(self._slots)
+            )
+            ids[: len(res_ids)] = res_ids
+            values[: len(res_ids)] = np.asarray(jnp.take(
+                self.table, jnp.asarray(slots), axis=0
+            ))
+        for i, (key, (row, _)) in enumerate(
+            self._host_store.items(), start=len(self._slots)
+        ):
+            ids[i] = key
+            values[i] = row
         return ids, values
 
     def import_(self, ids, values):
-        """Rebuild from an export (capacity re-derived, map rebuilt)."""
+        """Rebuild from an export (capacity re-derived, map rebuilt;
+        rows beyond ``max_capacity`` land in the host tier)."""
         ids = np.asarray(ids).reshape(-1)
         values = np.asarray(values).reshape(len(ids), self.dim)
-        self._slots = {int(k): i for i, k in enumerate(ids)}
-        self._next_slot = len(ids)
         cap = self._capacity
         while cap < max(1, len(ids)):
             cap *= 2
+        if self._max_capacity is not None:
+            cap = min(cap, self._max_capacity)
+        from collections import OrderedDict
+
         self._capacity = cap
         self.table = self._init_rows(cap)
-        if len(ids):
-            self.table = self.table.at[jnp.arange(len(ids))].set(
-                jnp.asarray(values, self.table.dtype)
+        self._host_store = {}
+        n_resident = min(len(ids), cap)
+        self._slots = {
+            int(k): i for i, k in enumerate(ids[:n_resident])
+        }
+        self._lru = OrderedDict((k, None) for k in self._slots)
+        self._next_slot = n_resident
+        if n_resident:
+            self.table = self.table.at[jnp.arange(n_resident)].set(
+                jnp.asarray(values[:n_resident], self.table.dtype)
             )
+        for k, row in zip(ids[n_resident:], values[n_resident:]):
+            self._host_store[int(k)] = (np.asarray(row), {})
 
 
 class SparseAdam:
     """Adam over a KvVariable's touched rows (per-key optimizer slots —
-    the tfplus slot-variable analog; m/v live in same-capacity tables)."""
+    the tfplus slot-variable analog; m/v live in same-capacity tables).
+    Registers as a slot listener so a key's moments follow it through
+    the host tier (evict → restore keeps the Adam trajectory exact)."""
 
     def __init__(self, var: KvVariable, lr: float = 1e-3, b1: float = 0.9,
                  b2: float = 0.999, eps: float = 1e-8):
@@ -176,6 +357,40 @@ class SparseAdam:
         self._m = jnp.zeros_like(var.table)
         self._v = jnp.zeros_like(var.table)
         self._counts = jnp.zeros((var.capacity,), jnp.int32)
+        var.attach_slot_listener("adam", self)
+
+    # ---- slot-listener contract ----
+    def on_grow(self, new_cap: int):
+        self._sync_capacity()
+
+    def extract_rows(self, slots: np.ndarray):
+        self._sync_capacity()
+        s = jnp.asarray(slots)
+        return {
+            "m": np.asarray(self._m[s]),
+            "v": np.asarray(self._v[s]),
+            "counts": np.asarray(self._counts[s]),
+        }
+
+    def write_rows(self, slots: np.ndarray, payload):
+        self._sync_capacity()
+        s = jnp.asarray(slots)
+        self._m = self._m.at[s].set(
+            jnp.asarray(payload["m"], self._m.dtype)
+        )
+        self._v = self._v.at[s].set(
+            jnp.asarray(payload["v"], self._v.dtype)
+        )
+        self._counts = self._counts.at[s].set(
+            jnp.asarray(payload["counts"], jnp.int32)
+        )
+
+    def reset_rows(self, slots: np.ndarray):
+        self._sync_capacity()
+        s = jnp.asarray(slots)
+        self._m = self._m.at[s].set(0.0)
+        self._v = self._v.at[s].set(0.0)
+        self._counts = self._counts.at[s].set(0)
 
     def _sync_capacity(self):
         cap = self.var.capacity

@@ -8,6 +8,10 @@ sampler.py`` (ElasticDistributedSampler) and ``elastic/dataloader.py``
 that were missing in rounds 1-2.
 """
 
+from dlrover_tpu.train.data.data_service import (
+    CoworkerDataService,
+    ShmBatchRing,
+)
 from dlrover_tpu.train.data.dataloader import ElasticDataLoader
 from dlrover_tpu.train.data.sampler import ElasticSampler
 from dlrover_tpu.train.data.sharding_client import (
@@ -16,6 +20,8 @@ from dlrover_tpu.train.data.sharding_client import (
 )
 
 __all__ = [
+    "CoworkerDataService",
+    "ShmBatchRing",
     "ElasticDataLoader",
     "ElasticSampler",
     "IndexShardingClient",

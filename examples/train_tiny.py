@@ -28,11 +28,17 @@ from dlrover_tpu.train.checkpoint import (
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=20)
-    parser.add_argument("--crash-at", type=int, default=-1,
-                        help="crash at this step on the first run")
+    parser.add_argument("--crash-at", type=str, default="",
+                        help="comma-separated steps to crash at (each "
+                        "fires once, tracked by sentinel suffix)")
     parser.add_argument("--crash-sentinel", type=str, default="")
     parser.add_argument("--ckpt-dir", type=str, default="")
     parser.add_argument("--persist-every", type=int, default=5)
+    parser.add_argument("--no-flash", action="store_true",
+                        help="disable per-step memory snapshots: resume "
+                        "only from periodic DISK checkpoints (the "
+                        "conventional-checkpointing baseline the flash "
+                        "engine is benchmarked against)")
     parser.add_argument("--resume-marker", type=str, default="",
                         help="file to record the step resumed from")
     parser.add_argument("--expect-world", type=int, default=0)
@@ -158,13 +164,18 @@ def main():
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices(f"step-{step}")
+        crash_steps = [
+            int(c) for c in args.crash_at.split(",") if c.strip()
+        ]
+        sentinel = (
+            f"{args.crash_sentinel}.{step}" if args.crash_sentinel else ""
+        )
         if (
-            args.crash_at >= 0
-            and step == args.crash_at
-            and args.crash_sentinel
-            and not os.path.exists(args.crash_sentinel)
+            step in crash_steps
+            and sentinel
+            and not os.path.exists(sentinel)
         ):
-            with open(args.crash_sentinel, "w") as f:
+            with open(sentinel, "w") as f:
                 f.write("crashed")
             print(f"rank {rank}: injected crash at step {step}", flush=True)
             # A real crash runs no graceful shutdown: os._exit skips the
@@ -183,7 +194,7 @@ def main():
         if ckpt is not None:
             if args.persist_every and (step + 1) % args.persist_every == 0:
                 ckpt.save_checkpoint(step + 1, state, StorageType.DISK)
-            else:
+            elif not args.no_flash:
                 # block=True: deterministic for the e2e crash test (async
                 # staging may legitimately skip steps while busy).
                 ckpt.save_checkpoint(

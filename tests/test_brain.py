@@ -74,3 +74,57 @@ class TestBrainService:
         client.close()
         dead = BrainResourceOptimizer(BrainClient("127.0.0.1:1"), "job-d")
         assert dead.generate_plan(1).empty()
+
+
+class TestHotNodeAlgorithm:
+    """Hot-node differentiation (parity:
+    ``optimize_job_hot_ps_resource.go``): synthetic skewed history must
+    produce a non-uniform plan naming the hot worker."""
+
+    def test_skewed_history_differentiates(self, brain):
+        client = BrainClient(brain.addr)
+        # 3 normal workers at ~100% CPU, one hot worker at ~400%.
+        for step in range(5):
+            for node in range(3):
+                client.persist_metrics(
+                    "job-hot", "node_resource",
+                    {"node_id": node, "cpu": 100.0 + step,
+                     "memory_mb": 1000},
+                )
+            client.persist_metrics(
+                "job-hot", "node_resource",
+                {"node_id": 3, "cpu": 400.0 + step, "memory_mb": 4000},
+            )
+        plan = client.get_optimization_plan("job-hot")
+        client.close()
+        assert "hot_nodes" in plan
+        assert list(plan["hot_nodes"]) == [3]
+        hot = plan["hot_nodes"][3]
+        assert hot["hot_ratio"] >= 3.5
+        assert hot["memory_mb"] > plan["worker_memory_mb"]
+
+    def test_uniform_history_stays_uniform(self, brain):
+        client = BrainClient(brain.addr)
+        for step in range(5):
+            for node in range(4):
+                client.persist_metrics(
+                    "job-uniform", "node_resource",
+                    {"node_id": node, "cpu": 100.0, "memory_mb": 1000},
+                )
+        plan = client.get_optimization_plan("job-uniform")
+        client.close()
+        assert "hot_nodes" not in plan
+        assert plan["worker_memory_mb"] == 1200
+
+    def test_algorithm_registry_extensible(self):
+        from dlrover_tpu.brain import algorithms as alg
+
+        @alg.register_algorithm("_test_dummy")
+        def dummy(records):
+            return {"dummy": len(records)}
+
+        try:
+            out = alg.run_all([{"kind": "x"}])
+            assert out["dummy"] == 1
+        finally:
+            alg._ALGORITHMS.pop("_test_dummy")

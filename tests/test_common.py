@@ -216,12 +216,19 @@ class TestNativeCopyEngine:
         src = np.ones(256 << 20, dtype=np.uint8)
         dst = np.empty_like(src)
         dst[:] = 0  # pre-fault: page faults must not bill either timing
-        t0 = time.perf_counter()
-        fastcopy.copy_many([(dst, src)])
-        native_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        dst[:] = src
-        single_s = time.perf_counter() - t0
+        # Warm the engine (lazy .so load + thread calibration) outside
+        # the timed region, and take best-of-3 on both sides: this is a
+        # pathological-overhead floor, not a bench, and the shared CI
+        # host is noisy.
+        fastcopy.copy_many([(dst[:1 << 20], src[:1 << 20])])
+        native_s = single_s = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fastcopy.copy_many([(dst, src)])
+            native_s = min(native_s, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            dst[:] = src
+            single_s = min(single_s, time.perf_counter() - t0)
         assert native_s < single_s * 2.0, (
             f"native {native_s:.3f}s vs single-thread {single_s:.3f}s"
         )

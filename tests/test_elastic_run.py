@@ -86,7 +86,7 @@ class TestElasticRun:
             ],
         )
         assert result.returncode == 0, result.stderr[-2000:]
-        assert os.path.exists(sentinel), "crash was never injected"
+        assert os.path.exists(f"{sentinel}.7"), "crash was never injected"
         assert os.path.exists(marker), "worker never resumed from checkpoint"
         with open(marker) as f:
             resumed = int(f.read())
@@ -120,7 +120,7 @@ class TestElasticRun:
             ],
         )
         assert result.returncode == 0, result.stderr[-2000:]
-        assert os.path.exists(sentinel), "crash was never injected"
+        assert os.path.exists(f"{sentinel}.7"), "crash was never injected"
         with open(marker) as f:
             assert int(f.read()) == 7
 
@@ -321,7 +321,7 @@ class TestElasticRun:
                 out, _ = a.communicate(timeout=240)
                 outs.append(out)
                 assert a.returncode == 0, out[-4000:]
-            assert os.path.exists(sentinel), "crash was never injected"
+            assert os.path.exists(f"{sentinel}.7"), "crash was never injected"
             for r in range(2):
                 assert os.path.exists(markers[r]), (
                     f"rank {r} never resumed\n" + outs[r][-3000:]

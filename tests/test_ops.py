@@ -144,3 +144,54 @@ class TestModelIntegration:
         )
         losses = run_training(ParallelSpec(), cfg)
         np.testing.assert_allclose(losses, baseline, rtol=1e-4, atol=1e-4)
+
+
+class TestUlyssesAttention:
+    """All-to-all sequence parallelism (optional SURVEY §2.8 row): exact
+    numerics vs the einsum path, composed through training."""
+
+    def test_shard_matches_reference(self):
+        import flax.linen as nn
+        from jax.sharding import Mesh
+
+        from dlrover_tpu.ops.attention import reference_attention
+        from dlrover_tpu.ops.ulysses import ulysses_attention
+
+        b, s, h, d = 2, 32, 4, 8
+        key = jax.random.PRNGKey(0)
+        q, k, v = (
+            jax.random.normal(kk, (b, s, h, d), jnp.float32)
+            for kk in jax.random.split(key, 3)
+        )
+        devices = np.array(jax.devices()[:4]).reshape(4)
+        mesh = Mesh(devices, ("seq",))
+        out = jax.jit(
+            lambda a, b_, c: ulysses_attention(a, b_, c, mesh=mesh)
+        )(q, k, v)
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_ulysses_training_matches(self):
+        cfg0 = dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)
+        baseline = run_training(ParallelSpec(), cfg0)
+        cfg = dataclasses.replace(
+            GPTConfig.tiny(), dtype=jnp.float32, attn_impl="ulysses"
+        )
+        # heads=2 divides seq degree 2
+        losses = run_training(ParallelSpec(data=4, seq=2), cfg)
+        np.testing.assert_allclose(losses, baseline, rtol=2e-5, atol=2e-5)
+
+    def test_head_divisibility_enforced(self):
+        from jax.sharding import Mesh
+
+        from dlrover_tpu.ops.ulysses import ulysses_attention
+
+        b, s, h, d = 2, 32, 3, 8  # 3 heads, 4-way seq: invalid
+        q = jnp.zeros((b, s, h, d))
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        with pytest.raises(ValueError, match="divisible"):
+            jax.jit(
+                lambda a: ulysses_attention(a, a, a, mesh=mesh)
+            )(q)

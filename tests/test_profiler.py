@@ -108,3 +108,71 @@ class TestTracer:
         for i in range(100):
             tracer.instant(f"e{i}")
         assert len(tracer.events) == 10
+
+
+class TestModuleCosts:
+    """Per-module attribution (VERDICT r3 #9, parity with AProfiler's
+    module table ``atorch/atorch/utils/prof.py:39-464``)."""
+
+    def test_ranks_transformer_blocks_dominant(self):
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.models.gpt import GPT, GPTConfig
+        from dlrover_tpu.utils.profiler import Profiler
+
+        cfg = dataclasses.replace(
+            GPTConfig.tiny(), dtype=jnp.float32, scan_layers=False
+        )
+        prof = Profiler()
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        rows = prof.module_costs(
+            GPT(cfg), jax.random.PRNGKey(0), tokens, depth=2
+        )
+        assert rows, "no module rows recorded"
+        by_path = {r["path"]: r for r in rows}
+        # Transformer blocks must dominate the norms/embeddings...
+        assert by_path["block_0"]["flops"] > by_path["ln_f"]["flops"]
+        # ...and within a block the MLP up-projection (d->4d) must
+        # outrank qkv (d->3d): the compiler's numbers, not guesses.
+        assert (
+            by_path["block_0/up"]["flops"]
+            > by_path["block_0/qkv"]["flops"]
+        )
+        # shares are normalized against the root total
+        top = rows[0]
+        assert 0 < top["share"] <= 1.0
+
+    def test_scan_module_reports_whole_stack(self):
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.models.gpt import GPT, GPTConfig
+        from dlrover_tpu.utils.profiler import Profiler
+
+        unrolled = dataclasses.replace(
+            GPTConfig.tiny(), dtype=jnp.float32, scan_layers=False
+        )
+        scanned = dataclasses.replace(unrolled, scan_layers=True)
+        prof = Profiler()
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        rows_u = prof.module_costs(
+            GPT(unrolled), jax.random.PRNGKey(0), tokens, depth=1
+        )
+        rows_s = prof.module_costs(
+            GPT(scanned), jax.random.PRNGKey(0), tokens, depth=1
+        )
+        flops_u = sum(
+            r["flops"] for r in rows_u if r["path"].startswith("block_")
+        )
+        blocks = next(r for r in rows_s if r["path"] == "blocks")
+        # XLA's cost analysis counts a while-loop body ONCE, so the
+        # scanned row reports per-iteration cost: total / num_layers
+        # (module_costs documents this; unrolled configs give totals).
+        assert blocks["flops"] == pytest.approx(
+            flops_u / unrolled.num_layers, rel=0.05
+        )

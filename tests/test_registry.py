@@ -91,9 +91,19 @@ class TestAutoAnnotation:
         assert shard.data.shape[1] == kernel.shape[1] // 2
 
     def test_rank_mismatch_rejected(self):
-        reg = ShardingRegistry().register(r"kernel", ("embed",))
+        # Axes LONGER than the param rank are user error; SHORTER axes
+        # left-pad as unsharded leading dims (nn.scan layer stacks,
+        # pipeline banks) — see test_short_axes_left_pad.
+        reg = ShardingRegistry().register(
+            r"kernel", ("layers", "embed", "mlp")
+        )
         with pytest.raises(ValueError, match="rank-mismatch"):
             run_training(ParallelSpec(fsdp=2), registry=reg)
+
+    def test_short_axes_left_pad(self):
+        reg = ShardingRegistry().register(r"kernel", ("embed",))
+        axes = reg.axes_for("dense/kernel", (3, 4, 8))
+        assert axes == (None, None, "embed")
 
     def test_annotated_models_untouched(self):
         """Models WITH logical axes (the GPT flagship) keep their own

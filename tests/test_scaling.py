@@ -128,7 +128,10 @@ class TestLocalResourceOptimizer:
 
 
 class TestElasticJobScaler:
-    def test_patch_body(self):
+    def test_emits_crd_manifest(self):
+        """The emitted body must be the vendored ScalePlan CRD schema
+        (``scaleplan_types.go`` field names), not an ad-hoc dict."""
+
         class FakeClient:
             def __init__(self):
                 self.bodies = []
@@ -150,6 +153,186 @@ class TestElasticJobScaler:
             launch_nodes=[Node("worker", 5)],
         ))
         body = client.bodies[0]
-        assert body["job"] == "job-x"
-        assert body["replicas"]["worker"]["replicas"] == 4
-        assert body["launch"] == [5]
+        assert body["kind"] == "ScalePlan"
+        assert body["apiVersion"].endswith("v1alpha1")
+        assert body["metadata"]["labels"]["elasticjob-name"] == "job-x"
+        spec = body["spec"]
+        assert spec["ownerJob"] == "job-x"
+        rrs = spec["replicaResourceSpecs"]["worker"]
+        assert rrs["replicas"] == 4
+        assert rrs["resource"] == {"cpu": "2.0", "memory": "8192Mi"}
+        (pod,) = spec["createPods"]
+        assert pod["id"] == 5 and pod["type"] == "worker"
+        assert pod["rankIndex"] == 5
+        assert body["status"]["phase"] == "Pending"
+
+    def test_manifest_round_trips(self):
+        from dlrover_tpu.master.crd import ScalePlanCRD, scaleplan_from_plan
+
+        crd = scaleplan_from_plan(
+            ScalePlan(launch_nodes=[Node("worker", 1)],
+                      remove_nodes=[Node("worker", 0)]),
+            "job-y", seq=3,
+        )
+        doc = crd.to_manifest()
+        back = ScalePlanCRD.from_manifest(doc)
+        assert back.name == "job-y-scaleplan-3"
+        assert [p.id for p in back.spec.create_pods] == [1]
+        assert [p.id for p in back.spec.remove_pods] == [0]
+
+
+class TestScalePlanReconciler:
+    def test_round_trip_autoscaler_to_new_process(self):
+        """VERDICT r3 #7 done-criterion: auto-scaler -> ScalePlan CRD ->
+        reconciler -> the platform actually launches the node (the same
+        watch->realize->status flow elasticjob_controller.go runs)."""
+        from dlrover_tpu.master.crd import (
+            PHASE_SUCCEEDED,
+            ScalePlanReconciler,
+            ScalePlanStore,
+        )
+
+        jm = LocalJobManager(node_num=2)
+        jm.update_node_status(1, "failed", "killed")
+        jm.get_node(1).relaunchable = False
+
+        store = ScalePlanStore()
+        process_scaler = ProcessScaler(sleep_cmd)
+        reconciler = ScalePlanReconciler(store, process_scaler)
+        auto = AllreduceAutoScaler(
+            jm, ElasticJobScaler(store, "job-rt"),
+            target_worker_num=2, interval=60,
+        )
+        try:
+            auto._reconcile()          # emits the CRD into the store
+            reconciler.start()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not store.applied:
+                time.sleep(0.05)
+            assert store.applied, "reconciler never applied the plan"
+            applied = store.applied[0]
+            assert applied.status.phase == PHASE_SUCCEEDED
+            assert applied.status.finish_time is not None
+            # the platform really launched the replacement node
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if process_scaler.alive_nodes():
+                    break
+                time.sleep(0.05)
+            assert process_scaler.alive_nodes()
+        finally:
+            reconciler.stop()
+            process_scaler.stop()
+
+    def test_remove_flows_through(self):
+        from dlrover_tpu.master.crd import (
+            ScalePlanReconciler,
+            ScalePlanStore,
+        )
+
+        store = ScalePlanStore()
+        process_scaler = ProcessScaler(sleep_cmd)
+        reconciler = ScalePlanReconciler(store, process_scaler)
+        ej = ElasticJobScaler(store, "job-rm")
+        try:
+            process_scaler.scale(
+                ScalePlan(launch_nodes=[Node("worker", 7)])
+            )
+            assert process_scaler.alive_nodes() == [7]
+            ej.scale(ScalePlan(remove_nodes=[Node("worker", 7)]))
+            reconciler.start()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not store.applied:
+                time.sleep(0.05)
+            assert store.applied
+            assert process_scaler.alive_nodes() == []
+        finally:
+            reconciler.stop()
+            process_scaler.stop()
+
+
+class TestK8sClientContract:
+    """The REST client must emit exactly the apiserver's custom-resource
+    protocol (paths/verbs/bodies) — pinned here so a real cluster is a
+    transport swap (parity: reference k8sClient/pod_scaler surface)."""
+
+    def make(self):
+        calls = []
+
+        def transport(method, path, body):
+            calls.append((method, path, body))
+            if method == "GET" and path.endswith("scaleplans"):
+                return 200, {"items": []}
+            if method == "GET":
+                from dlrover_tpu.master.crd import scaleplan_from_plan
+
+                return 200, scaleplan_from_plan(
+                    ScalePlan(), "job-k", 1
+                ).to_manifest()
+            return 201, {"ok": True}
+
+        from dlrover_tpu.master.k8s import K8sElasticJobClient
+
+        return K8sElasticJobClient(transport, namespace="ml"), calls
+
+    def test_create_scaleplan_request_shape(self):
+        from dlrover_tpu.master.crd import scaleplan_from_plan
+
+        client, calls = self.make()
+        crd = scaleplan_from_plan(
+            ScalePlan(launch_nodes=[Node("worker", 2)]), "job-k", 7
+        )
+        client.create_scaleplan(crd)
+        method, path, body = calls[0]
+        assert method == "POST"
+        assert path == (
+            "/apis/elastic.iml.github.io/v1alpha1/namespaces/ml/"
+            "scaleplans"
+        )
+        assert body["kind"] == "ScalePlan"
+        assert body["metadata"]["name"] == "job-k-scaleplan-7"
+        assert body["spec"]["createPods"][0]["id"] == 2
+
+    def test_status_patch_subresource(self):
+        client, calls = self.make()
+        client.update_scaleplan_status("job-k-scaleplan-7", "Succeeded")
+        method, path, body = calls[0]
+        assert method == "PATCH"
+        assert path.endswith("/scaleplans/job-k-scaleplan-7/status")
+        assert body["status"]["phase"] == "Succeeded"
+
+    def test_elasticjob_replica_patch(self):
+        client, calls = self.make()
+        client.patch_elasticjob_replicas("job-k", {"worker": 5})
+        method, path, body = calls[0]
+        assert method == "PATCH"
+        assert path.endswith("/elasticjobs/job-k")
+        assert body["spec"]["replicaSpecs"]["worker"]["replicas"] == 5
+
+    def test_elasticjob_scaler_through_k8s_submitter(self):
+        """ElasticJobScaler -> K8sScalePlanSubmitter -> apiserver create:
+        the cluster path uses the same CRD emission as the local one."""
+        from dlrover_tpu.master.k8s import K8sScalePlanSubmitter
+
+        client, calls = self.make()
+        scaler = ElasticJobScaler(
+            K8sScalePlanSubmitter(client), "job-k"
+        )
+        scaler.scale(ScalePlan(launch_nodes=[Node("worker", 0)]))
+        method, path, body = calls[0]
+        assert method == "POST"
+        assert path.endswith("/scaleplans")
+        assert body["spec"]["ownerJob"] == "job-k"
+
+    def test_error_status_raises(self):
+        from dlrover_tpu.master.crd import scaleplan_from_plan
+        from dlrover_tpu.master.k8s import K8sElasticJobClient
+
+        client = K8sElasticJobClient(
+            lambda m, p, b: (409, {"reason": "AlreadyExists"}),
+            namespace="ml",
+        )
+        with pytest.raises(RuntimeError, match="409"):
+            client.create_scaleplan(
+                scaleplan_from_plan(ScalePlan(), "j", 1)
+            )

@@ -1,0 +1,274 @@
+"""Strategy-search engine tests (VERDICT r3 #1 done-criteria).
+
+The search must pick each parallelism family on its own, given only a
+model + device count: fsdp for a too-big dense model, ``expert`` for an
+MoE model, ``seq`` for a long-context batch-1 model, ``pipe`` when even
+fully-sharded state exceeds HBM (the pipeline composition halves the
+FSDP all-gather traffic at equal memory). Parity target: the reference's
+acceleration engine + strategy-generation algorithms
+(``atorch/atorch/auto/engine/acceleration_engine.py:13``,
+``sg_algo/bayes_opt_sg.py``) — here the space is small enough to
+enumerate exactly.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.accel import ParallelSpec, auto_accelerate
+from dlrover_tpu.accel.search import (
+    ModelProfile,
+    enumerate_specs,
+    estimate,
+    reconfigure_module,
+    search_spec,
+    state_bytes_per_device,
+)
+from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+
+HBM_16G = 16e9
+
+
+def profile_of(cfg, **over):
+    p = ModelProfile.from_config(cfg)
+    return dataclasses.replace(p, **over) if over else p
+
+
+class TestEnumeration:
+    def test_covers_all_families_when_model_supports_them(self):
+        cfg = GPTConfig(
+            vocab_size=50264, max_seq_len=2048, num_layers=8,
+            num_heads=8, d_model=512,
+        )
+        specs = enumerate_specs(profile_of(cfg), 8, batch_size=8)
+        axes_seen = set()
+        for s in specs:
+            for name in ("data", "fsdp", "tensor", "seq", "pipe"):
+                if getattr(s, name) > 1:
+                    axes_seen.add(name)
+        assert axes_seen == {"data", "fsdp", "tensor", "seq", "pipe"}
+        assert all(s.total == 8 for s in specs)
+
+    def test_gating(self):
+        # No ring/pipeline support, no experts, odd head count: the
+        # space degrades to data/fsdp only.
+        p = ModelProfile.from_params(1_000_000)
+        specs = enumerate_specs(p, 8, batch_size=8)
+        assert specs
+        for s in specs:
+            assert s.tensor == s.seq == s.expert == s.pipe == 1
+
+    def test_batch_divisibility(self):
+        cfg = GPTConfig.tiny()
+        specs = enumerate_specs(profile_of(cfg), 8, batch_size=2)
+        assert all(s.data * s.fsdp in (1, 2) for s in specs)
+
+
+class TestChoices:
+    """Each family must be chosen on its own merits."""
+
+    def test_small_dense_pure_dp(self):
+        cfg = GPTConfig.tiny()
+        (spec, est), *_ = search_spec(
+            profile_of(cfg), 8, batch_size=8, hbm=HBM_16G
+        )
+        assert spec == ParallelSpec(data=8)
+        assert est.fits(HBM_16G)
+
+    def test_too_big_dense_gets_fsdp(self):
+        # GPT-2-xl class: 1.5B params * 16 B/param = 25 GB state.
+        cfg = GPTConfig.gpt2_xl()
+        (spec, est), *_ = search_spec(
+            profile_of(cfg), 8, batch_size=8, hbm=HBM_16G
+        )
+        assert spec.fsdp > 1
+        assert est.fits(HBM_16G)
+
+    def test_moe_model_gets_expert_parallel(self):
+        # Experts hold ~8x the dense params: replicating them under pure
+        # DP wastes memory and FSDP all-gathers the full expert set every
+        # layer; EP's all-to-all is the cheap option.
+        cfg = GPTConfig(
+            vocab_size=50264, max_seq_len=1024, num_layers=16,
+            num_heads=16, d_model=2048, num_experts=8, remat=True,
+        )
+        (spec, est), *_ = search_spec(
+            profile_of(cfg), 8, batch_size=8, hbm=HBM_16G
+        )
+        assert spec.expert > 1
+        assert est.fits(HBM_16G)
+
+    def test_long_context_gets_seq(self):
+        # Batch 1 at 32k context: the batch axis cannot shard, so only
+        # seq parallelism divides the activation footprint.
+        cfg = GPTConfig(
+            vocab_size=50264, max_seq_len=32768, num_layers=24,
+            num_heads=16, d_model=2048, remat=True,
+        )
+        (spec, _), *_ = search_spec(
+            profile_of(cfg), 8, batch_size=1, hbm=HBM_16G
+        )
+        assert spec.seq > 1
+
+    def test_pipe_when_fsdp_not_enough(self):
+        # State >> 8 x HBM: nothing fits even fully sharded, so the
+        # ranking is comm-driven among maximally-sharded candidates.
+        # Over a slow interconnect (hosts linked by DCN, not ICI) the
+        # per-layer FSDP all-gathers and TP all-reduces are ruinous;
+        # composing pipe halves the gathered volume at equal memory and
+        # its own traffic is one activation per microbatch per boundary.
+        # This is exactly how real TPU pods place PP: across the slow
+        # links, FSDP/TP inside the fast ones.
+        cfg = GPTConfig(
+            vocab_size=50264, max_seq_len=4096, num_layers=48,
+            num_heads=32, d_model=8192, remat=True,
+        )
+        ranked = search_spec(
+            profile_of(cfg), 8, batch_size=32, hbm=HBM_16G,
+            ici_bw=2e9,  # DCN-class
+        )
+        spec = ranked[0][0]
+        assert spec.pipe > 1
+
+    def test_fast_ici_prefers_fsdp_over_pipe(self):
+        # Same model on real ICI: the all-gathers overlap with compute
+        # and the pipeline bubble is pure loss — fsdp/tp must win.
+        cfg = GPTConfig(
+            vocab_size=50264, max_seq_len=4096, num_layers=48,
+            num_heads=32, d_model=8192, remat=True,
+        )
+        ranked = search_spec(
+            profile_of(cfg), 8, batch_size=32, hbm=HBM_16G
+        )
+        assert ranked[0][0].pipe == 1
+
+    def test_prefer_breaks_ties(self):
+        cfg = GPTConfig.tiny()
+        (spec, _), *_ = search_spec(
+            profile_of(cfg), 8, batch_size=8, hbm=HBM_16G,
+            prefer=("fsdp",),
+        )
+        # tiny model: dp and dp/fsdp are within noise; prefer tips it.
+        assert spec.fsdp > 1 or spec == ParallelSpec(data=8)
+
+
+class TestStateBytes:
+    def test_matches_actual_sharded_state(self):
+        """The analytic per-device bytes must equal what GSPMD actually
+        materializes (the whole point of computing it from the rules)."""
+        cfg = dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)
+        model = GPT(cfg)
+        opt = optax.adamw(1e-3)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (8, 16), 0, cfg.vocab_size
+        )
+
+        def token_loss(module, params, b):
+            return loss_fn(module.apply({"params": params}, b), b)
+
+        spec = ParallelSpec(fsdp=8)
+
+        def init_fn(r):
+            variables = model.init(r, tokens)
+            p = variables["params"]
+            return {"params": p, "opt": opt.init(p), "step": 0}
+
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        predicted = state_bytes_per_device(abstract, spec)
+
+        res = auto_accelerate(
+            model, opt, tokens, token_loss, spec=spec
+        )
+        actual = sum(
+            leaf.addressable_shards[0].data.nbytes
+            for leaf in jax.tree_util.tree_leaves(res.state)
+        )
+        # ceil-div padding may overcount slightly; never undercount.
+        assert predicted >= actual
+        assert predicted <= actual * 1.05 + 4096
+
+    def test_fsdp_halves_vs_coarser(self):
+        cfg = GPTConfig.tiny()
+        model = GPT(cfg)
+        opt = optax.adamw(1e-3)
+        tokens = jnp.zeros((8, 16), jnp.int32)
+
+        def init_fn(r):
+            variables = model.init(r, tokens)
+            p = variables["params"]
+            return {"params": p, "opt": opt.init(p), "step": 0}
+
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        b2 = state_bytes_per_device(abstract, ParallelSpec(fsdp=2))
+        b8 = state_bytes_per_device(abstract, ParallelSpec(fsdp=8))
+        assert b8 < b2
+
+
+class TestReconfigure:
+    def test_seq_spec_flips_to_ring(self):
+        model = GPT(GPTConfig.tiny())
+        out = reconfigure_module(model, ParallelSpec(seq=2))
+        assert out.cfg.attn_impl == "ring"
+
+    def test_pipe_spec_sets_stages(self):
+        model = GPT(GPTConfig.tiny())
+        out = reconfigure_module(model, ParallelSpec(pipe=2))
+        assert out.cfg.pipeline_stages == 2
+
+    def test_noop_returns_same_module(self):
+        model = GPT(GPTConfig.tiny())
+        assert reconfigure_module(model, ParallelSpec(data=8)) is model
+
+
+class TestAutoIntegration:
+    def test_auto_trains_tiny(self):
+        """spec="auto" end-to-end through the search on the CPU mesh."""
+        cfg = dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)
+        model = GPT(cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size
+        )
+
+        def token_loss(module, params, b):
+            return loss_fn(module.apply({"params": params}, b), b)
+
+        res = auto_accelerate(
+            model, optax.adamw(1e-3), tokens, token_loss, spec="auto"
+        )
+        assert res.spec.total == 8
+        state = res.state
+        batch = jax.device_put(tokens, res.batch_sharding)
+        losses = []
+        for _ in range(3):
+            state, m = res.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+
+class TestAllowTensorOptOut:
+    def test_false_forbids_tensor_candidates(self):
+        """allow_tensor=False must strip tensor from the search space
+        even for config-carrying models (round-4 review finding)."""
+        import optax
+
+        cfg = dataclasses.replace(
+            GPTConfig.tiny(), dtype=jnp.float32, num_heads=2
+        )
+        model = GPT(cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size
+        )
+
+        def token_loss(module, params, b):
+            return loss_fn(module.apply({"params": params}, b), b)
+
+        res = auto_accelerate(
+            model, optax.adamw(1e-3), tokens, token_loss, spec="auto",
+            allow_tensor=False,
+        )
+        assert res.spec.tensor == 1
