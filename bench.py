@@ -349,7 +349,10 @@ def section_goodput():
 
     repo = os.path.dirname(os.path.abspath(__file__))
     script = os.path.join(repo, "examples", "train_tiny.py")
-    steps, sleep = 30, 0.2
+    # Step cost must dominate process-restart jitter (~±4 s) or the
+    # comparison drowns: at 0.4 s/step the disk-only config redoes
+    # (14+14) x 0.4 = 11.2 s of lost work per run vs ~0 for flash.
+    steps, sleep = 30, 0.4
     kills = "14,29"
     persist_every = 15
 
@@ -428,9 +431,16 @@ def main():
 
     extra = {"device": dev.device_kind}
     save_block_s = None
+    budget_s = float(os.getenv("DLROVER_TPU_BENCH_BUDGET_S", "1500"))
+    bench_t0 = time.perf_counter()
     log(f"bench: device={dev.device_kind} sections={sections}")
     for name in sections:
         name = name.strip()
+        if time.perf_counter() - bench_t0 > budget_s:
+            log(f"bench: budget {budget_s:.0f}s exhausted; skipping "
+                f"{name} (the JSON line must still print)")
+            extra[f"{name}_skipped"] = "time budget"
+            continue
         t0 = time.perf_counter()
         try:
             if name == "small":

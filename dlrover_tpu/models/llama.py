@@ -30,9 +30,10 @@ from dlrover_tpu.models.gpt import (  # shared kernel + remat paths
     _attention,
     _remat_policy,
     loss_fn,
+    moe_loss_fn,
 )
 
-__all__ = ["LlamaConfig", "Llama", "loss_fn"]
+__all__ = ["LlamaConfig", "Llama", "loss_fn", "moe_loss_fn"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +54,12 @@ class LlamaConfig:
     attn_impl: str = "xla"  # "xla" | "pallas" | "ring" | "ulysses"
     attn_block_q: int = 512
     attn_block_k: int = 512
+    # MoE (0 = dense SwiGLU). With num_experts > 0 every block's FFN
+    # becomes a Mixtral-style expert-parallel SwiGLU MoE and __call__
+    # returns (logits, aux_loss); pair with ParallelSpec(expert=K).
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     # Pipeline parallelism (0 = off): same contract as GPTConfig —
     # stages run as GPipe (repeats == 1) or the circular/interleaved
     # schedule (repeats > 1); pair with ParallelSpec(pipe=stages).
@@ -178,6 +185,22 @@ class LlamaBlock(nn.Module):
         x = x + _dense(d, "o_proj", ("heads", "embed"), cfg)(attn)
 
         y = _rms_norm("mlp_norm", cfg)(x)
+        if cfg.num_experts > 0:
+            from dlrover_tpu.ops.moe import MoEMLP
+
+            y, aux = MoEMLP(
+                num_experts=cfg.num_experts,
+                ff_dim=cfg.ff_dim,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                mlp_type="swiglu",
+                name="moe",
+            )(y)
+            x = x + y
+            x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+            return x, aux
         gate = _dense(cfg.ff_dim, "gate_proj", ("embed", "mlp"), cfg)(y)
         up = _dense(cfg.ff_dim, "up_proj", ("embed", "mlp"), cfg)(y)
         y = nn.silu(gate) * up
@@ -205,16 +228,23 @@ class _LlamaStage(nn.Module):
                 LlamaBlock, prevent_cse=False, policy=_remat_policy(cfg)
             )
         if cfg.scan_layers:
-            x, _ = nn.scan(
+            x, aux = nn.scan(
                 block,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=per_stage,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="blocks")(x)
+            aux_mean = jnp.mean(aux) if aux is not None else None
         else:
+            auxes = []
             for i in range(per_stage):
-                x, _ = block(cfg, name=f"block_{i}")(x)
+                x, aux = block(cfg, name=f"block_{i}")(x)
+                if aux is not None:
+                    auxes.append(aux)
+            aux_mean = jnp.mean(jnp.stack(auxes)) if auxes else None
+        if cfg.num_experts > 0:
+            return x, aux_mean
         return x
 
 
@@ -249,9 +279,10 @@ class Llama(nn.Module):
             )
             kw = (
                 {"num_repeats": cfg.pipeline_repeats}
-                if cfg.pipeline_repeats > 1 else {}
+                if cfg.pipeline_repeats > 1
+                else {"has_aux": cfg.num_experts > 0}
             )
-            x = pipe_cls(
+            out = pipe_cls(
                 make_stage=lambda: _LlamaStage(cfg, name="stage"),
                 num_stages=cfg.pipeline_stages,
                 num_microbatches=cfg.pipeline_microbatches,
@@ -259,13 +290,21 @@ class Llama(nn.Module):
                 name="pipeline",
                 **kw,
             )(x)
+            aux_total = None
+            if cfg.num_experts > 0:
+                x, aux_total = out
+            else:
+                x = out
             x = _rms_norm("final_norm", cfg)(x)
             logits = _dense(
                 cfg.vocab_size, "lm_head", ("embed", "vocab"), cfg
             )(x)
-            return nn.with_logical_constraint(
+            logits = nn.with_logical_constraint(
                 logits, ("batch", "seq", "vocab")
             )
+            if cfg.num_experts > 0:
+                return logits, aux_total
+            return logits
 
         block = LlamaBlock
         if cfg.remat:
@@ -273,22 +312,30 @@ class Llama(nn.Module):
                 LlamaBlock, prevent_cse=False, policy=_remat_policy(cfg)
             )
         if cfg.scan_layers:
-            x, _ = nn.scan(
+            x, aux = nn.scan(
                 block,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="layers")(x)
+            aux_total = jnp.mean(aux) if aux is not None else None
         else:
+            auxes = []
             for i in range(cfg.num_layers):
-                x, _ = block(cfg, name=f"layer_{i}")(x)
+                x, aux = block(cfg, name=f"layer_{i}")(x)
+                if aux is not None:
+                    auxes.append(aux)
+            aux_total = jnp.mean(jnp.stack(auxes)) if auxes else None
 
         x = _rms_norm("final_norm", cfg)(x)
         # Untied LM head (LLaMA convention).
         logits = _dense(
             cfg.vocab_size, "lm_head", ("embed", "vocab"), cfg
         )(x)
-        return nn.with_logical_constraint(
+        logits = nn.with_logical_constraint(
             logits, ("batch", "seq", "vocab")
         )
+        if cfg.num_experts > 0:
+            return logits, aux_total
+        return logits

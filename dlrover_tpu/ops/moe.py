@@ -107,6 +107,9 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # "gelu": 2-matrix GPT-style FFN experts; "swiglu": 3-matrix
+    # gate/up/down LLaMA/Mixtral-style experts (no biases).
+    mlp_type: str = "gelu"
 
     @nn.compact
     def __call__(self, x) -> Tuple[Any, Any]:
@@ -181,7 +184,22 @@ class MoEMLP(nn.Module):
         h = jnp.einsum(
             "ecd,edf->ecf", expert_in, w_up.astype(self.dtype)
         ) + b_up[:, None, :].astype(self.dtype)
-        h = nn.gelu(h)
+        if self.mlp_type == "swiglu":
+            w_gate = self.param(
+                "w_gate",
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02),
+                    ("expert", "embed", "mlp"),
+                ),
+                (e, d, f),
+                self.param_dtype,
+            )
+            g = jnp.einsum(
+                "ecd,edf->ecf", expert_in, w_gate.astype(self.dtype)
+            )
+            h = nn.silu(g) * h
+        else:
+            h = nn.gelu(h)
         h = nn.with_logical_constraint(h, ("expert", None, "mlp"))
         out_e = jnp.einsum(
             "ecf,efd->ecd", h, w_down.astype(self.dtype)

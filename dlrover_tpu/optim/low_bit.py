@@ -11,14 +11,20 @@ slices of the flattened param, so an even sharding keeps scale blocks
 device-local).
 
 Memory: 2 x int8 + 2 x fp32/block ≈ 2.03 bytes/param for the moments vs
-8 bytes for fp32 Adam.
+8 bytes for fp32 Adam. *Transient* update memory is bounded too:
+``nn.scan``-stacked leaves (a 48-layer QKV stack is one 1.5 GB-fp32
+tensor) update layer-by-layer under ``lax.map``, so the dequantized
+fp32 temporaries never exceed one layer — this is what lets a 1.5B
+model train on a single 16 GB chip.
 """
 
-from typing import Any, NamedTuple, Tuple
+from functools import partial
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 
 
 class _QTensor(NamedTuple):
@@ -50,6 +56,13 @@ def _dequantize(qt: _QTensor, shape, size) -> jnp.ndarray:
     return blocks.reshape(-1)[:size].reshape(shape)
 
 
+def _chunked(shape) -> bool:
+    """Scanned/stacked leaves ([L, ...] from nn.scan or pipeline banks)
+    quantize and update per leading index — bounds fp32 temporaries to
+    one layer."""
+    return len(shape) >= 3 and shape[0] > 1
+
+
 def adam8bit(
     learning_rate: float = 1e-3,
     b1: float = 0.9,
@@ -59,6 +72,34 @@ def adam8bit(
     block_size: int = 256,
 ) -> optax.GradientTransformation:
     """Adam with int8 blockwise-quantized moments (8-bit optimizer)."""
+
+    def leaf_update(g, qm, qv, p, bc1, bc2):
+        """One (sub)array's bias-corrected step: dequantize → update →
+        requantize, all in its own quantization domain."""
+        g = g.astype(jnp.float32)
+        m = b1 * _dequantize(qm, g.shape, g.size) + (1 - b1) * g
+        # v is stored as sqrt(v): linear int8 of the squares loses
+        # small-|g| entries to a block's absmax quadratically faster
+        # than m does, and a v that underflows to 0 under a live m
+        # turns the Adam step into m/eps — divergence. In the sqrt
+        # domain both moments share the same relative resolution.
+        s_prev = _dequantize(qv, g.shape, g.size)
+        v = b2 * s_prev * s_prev + (1 - b2) * g * g
+        s = jnp.sqrt(v)
+        mhat = m / bc1
+        denom = s / jnp.sqrt(bc2)
+        # Floor the denominator at half a quantization step of s so a
+        # moment that will round to zero can never amplify m by 1/eps.
+        qs = _quantize(s, block_size)
+        floor = jnp.repeat(
+            qs.scale / (127.0 * 2.0), block_size
+        )[: g.size].reshape(g.shape) / jnp.sqrt(bc2)
+        u = -learning_rate * mhat / (
+            jnp.maximum(denom, floor) + eps
+        )
+        if weight_decay and p is not None:
+            u = u - learning_rate * weight_decay * p
+        return u, _quantize(m, block_size), qs
 
     def init(params):
         # Strip flax partitioning boxes first: quantized blocks are a
@@ -76,7 +117,10 @@ def adam8bit(
             pass
 
         def qzero(p):
-            return _quantize(jnp.zeros_like(p, jnp.float32), block_size)
+            z = jnp.zeros_like(p, jnp.float32)
+            if _chunked(p.shape):
+                return jax.vmap(partial(_quantize, block=block_size))(z)
+            return _quantize(z, block_size)
 
         zeros = jax.tree_util.tree_map(qzero, params)
         return Adam8bitState(
@@ -100,32 +144,33 @@ def adam8bit(
 
         new_updates, new_m, new_v = [], [], []
         for g, qm, qv, p in zip(flat_g, flat_m, flat_v, flat_p):
-            g = g.astype(jnp.float32)
-            m = b1 * _dequantize(qm, g.shape, g.size) + (1 - b1) * g
-            # v is stored as sqrt(v): linear int8 of the squares loses
-            # small-|g| entries to a block's absmax quadratically faster
-            # than m does, and a v that underflows to 0 under a live m
-            # turns the Adam step into m/eps — divergence. In the sqrt
-            # domain both moments share the same relative resolution.
-            s_prev = _dequantize(qv, g.shape, g.size)
-            v = b2 * s_prev * s_prev + (1 - b2) * g * g
-            s = jnp.sqrt(v)
-            mhat = m / bc1
-            denom = s / jnp.sqrt(bc2)
-            # Floor the denominator at half a quantization step of s so a
-            # moment that will round to zero can never amplify m by 1/eps.
-            qs = _quantize(s, block_size)
-            floor = jnp.repeat(
-                qs.scale / (127.0 * 2.0), block_size
-            )[: g.size].reshape(g.shape) / jnp.sqrt(bc2)
-            u = -learning_rate * mhat / (
-                jnp.maximum(denom, floor) + eps
-            )
-            if weight_decay and p is not None:
-                u = u - learning_rate * weight_decay * p
-            new_updates.append(u.astype(g.dtype))
-            new_m.append(_quantize(m, block_size))
-            new_v.append(qs)
+            if _chunked(g.shape):
+                # Layer-by-layer under lax.map: the fp32 temporaries of
+                # a scanned 48-layer stack never exceed one layer.
+                if p is not None:
+                    u, m2, v2 = lax.map(
+                        lambda xs: leaf_update(
+                            xs[0], _QTensor(*xs[1]), _QTensor(*xs[2]),
+                            xs[3], bc1, bc2,
+                        ),
+                        (g, tuple(qm), tuple(qv), p),
+                    )
+                else:
+                    u, m2, v2 = lax.map(
+                        lambda xs: leaf_update(
+                            xs[0], _QTensor(*xs[1]), _QTensor(*xs[2]),
+                            None, bc1, bc2,
+                        ),
+                        (g, tuple(qm), tuple(qv)),
+                    )
+                new_updates.append(u.astype(g.dtype))
+                new_m.append(_QTensor(*m2))
+                new_v.append(_QTensor(*v2))
+            else:
+                u, m2, v2 = leaf_update(g, qm, qv, p, bc1, bc2)
+                new_updates.append(u.astype(g.dtype))
+                new_m.append(m2)
+                new_v.append(v2)
 
         return (
             jax.tree_util.tree_unflatten(treedef, new_updates),

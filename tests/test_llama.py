@@ -125,3 +125,65 @@ class TestShardedNumerics:
             ParallelSpec(data=2), steps=3, cfg=tiny_cfg(attn_impl="pallas")
         )
         assert losses[-1] < losses[0]
+
+
+class TestLlamaMoE:
+    """Mixtral-style SwiGLU MoE in the LLaMA family (round-4: the
+    second flagship gets the full parallelism matrix, expert axis
+    included)."""
+
+    def _cfg(self, **kw):
+        import dataclasses
+
+        from dlrover_tpu.models.llama import LlamaConfig
+
+        return dataclasses.replace(
+            LlamaConfig.tiny(), dtype=jnp.float32, num_experts=2, **kw
+        )
+
+    def _train(self, spec, cfg):
+        from dlrover_tpu.models.llama import Llama, moe_loss_fn
+
+        model = Llama(cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size
+        )
+
+        def moe_token_loss(module, params, b):
+            return moe_loss_fn(
+                module.apply({"params": params}, b), b
+            )
+
+        res = auto_accelerate(
+            model, optax.adamw(1e-3), tokens, moe_token_loss, spec=spec
+        )
+        state = res.state
+        batch = jax.device_put(tokens, res.batch_sharding)
+        losses = []
+        for _ in range(3):
+            state, m = res.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        res.state = state
+        return losses, res
+
+    def test_ep_matches_single_device(self):
+        cfg = self._cfg()
+        base, _ = self._train(ParallelSpec(), cfg)
+        ep, res = self._train(ParallelSpec(data=4, expert=2), cfg)
+        np.testing.assert_allclose(ep, base, rtol=2e-5, atol=2e-5)
+        # the swiglu gate stack exists and is expert-sharded
+        wg = res.state["params"]["layers"]["moe"]["w_gate"]
+        shard = wg.addressable_shards[0]
+        assert shard.data.shape[1] == wg.shape[1] // 2  # expert dim
+        assert np.isfinite(base).all()
+
+    def test_moe_pipeline_composes(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            self._cfg(), num_layers=2, pipeline_stages=2,
+            pipeline_microbatches=4,
+        )
+        losses, _ = self._train(ParallelSpec(pipe=2, expert=2), cfg)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
