@@ -280,7 +280,7 @@ def section_large(peak):
             cfg = dataclasses.replace(
                 GPTConfig.gpt2_xl(), param_dtype=jnp.bfloat16,
                 remat=True, remat_policy=policy, attn_impl="pallas",
-                attn_block_q=512, attn_block_k=1024,
+                attn_block_q=1024, attn_block_k=1024,  # swept: +1.3pp MFU
             )
             row, result, state, _ = build_and_time(
                 cfg, batch, 5, opt=adam8bit(2e-4), peak=peak

@@ -69,6 +69,7 @@ class JobManager:
         scaler: Optional[Scaler] = None,
         error_monitor: Optional[ErrorMonitor] = None,
         heartbeat_timeout: float = 120.0,
+        resource_manager=None,
     ):
         self._lock = threading.Lock()
         self._nodes: Dict[int, Node] = {}
@@ -77,6 +78,14 @@ class JobManager:
         self._scaler = scaler or NoopScaler()
         self._error_monitor = error_monitor or ErrorMonitor()
         self._heartbeat_timeout = heartbeat_timeout
+        # Per-role resource bookkeeping + OOM escalation
+        # (master/job_resource.py; optional — tests may inject).
+        if resource_manager is None:
+            from dlrover_tpu.master.job_resource import JobResourceManager
+
+            resource_manager = JobResourceManager()
+            resource_manager.init_from_config(node_num)
+        self.resource_manager = resource_manager
         self._stopped = False
         self._event_callbacks = []
         for i in range(node_num):
@@ -169,6 +178,18 @@ class JobManager:
         )
         if relaunch_node:
             reason = self._error_monitor.classify(error_data)
+            if reason == NodeExitReason.OOM:
+                # Escalate the role's memory request before the relaunch
+                # (parity: JobResourceOptimizer.adjust_oom_resource) —
+                # relaunching into the same size just OOM-loops. A spent
+                # escalation budget makes the failure fatal.
+                node = self.get_node(node_id)
+                if node is not None:
+                    adjusted = self.resource_manager.adjust_oom_resource(
+                        node
+                    )
+                    if adjusted is None:
+                        node.relaunchable = False
             self.update_node_status(node_id, NodeStatus.FAILED, reason)
         return relaunch_node
 

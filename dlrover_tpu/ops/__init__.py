@@ -12,9 +12,17 @@ ports.
 from dlrover_tpu.ops.attention import flash_attention, reference_attention
 from dlrover_tpu.ops.moe import MoEMLP, compute_dispatch, load_balance_loss
 from dlrover_tpu.ops.ring_attention import ring_attention, ring_attention_shard
+from dlrover_tpu.ops.quantized import (
+    QuantizedWeight,
+    dequantize_params,
+    quantize_params,
+)
 from dlrover_tpu.ops.ulysses import ulysses_attention, ulysses_attention_shard
 
 __all__ = [
+    "QuantizedWeight",
+    "quantize_params",
+    "dequantize_params",
     "ulysses_attention",
     "ulysses_attention_shard",
     "flash_attention",

@@ -1,0 +1,89 @@
+"""Int8 weight-only quantization for inference/serving.
+
+Capability parity with the reference's quantized-compute support
+(``atorch/atorch/amp/amp_optimization.py:193`` fp8 paths, CUDA-only).
+v5e-class TPUs have no fp8 MXU, so the TPU-first cut is the serving
+technique that actually maps to the hardware: **int8 weight-only**
+quantization — kernels stored as per-output-channel int8 + fp32 absmax
+scales (4x smaller than fp32, 2x smaller than bf16), dequantized to
+bf16 at the point of use. Under jit, XLA fuses the dequant into each
+consumer matmul, so the int8 buffers are what's HBM-resident; the
+per-layer bf16 view is a transient the scheduler recycles. Activations
+stay bf16 (the MXU's native rate), so accuracy loss is the weight
+rounding only (~1e-2 relative on logits for transformer blocks).
+
+Usage::
+
+    qparams = quantize_params(params)           # int8 storage pytree
+    logits = jit(lambda qp, x: model.apply(
+        {"params": dequantize_params(qp)}, x))(qparams, tokens)
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "QuantizedWeight",
+    "quantize_params",
+    "dequantize_params",
+    "quantized_nbytes",
+]
+
+_MIN_QUANT_ELEMS = 1024  # tiny leaves (biases, norms) stay as-is
+
+
+class QuantizedWeight(NamedTuple):
+    q: jnp.ndarray        # int8, same shape as the original kernel
+    scale: jnp.ndarray    # fp32 absmax per output channel (last dim)
+
+
+def _quantizable(leaf) -> bool:
+    return (
+        hasattr(leaf, "ndim") and leaf.ndim >= 2
+        and leaf.size >= _MIN_QUANT_ELEMS
+        and jnp.issubdtype(leaf.dtype, jnp.floating)
+    )
+
+
+def quantize_params(params, min_elems: int = _MIN_QUANT_ELEMS):
+    """Per-output-channel symmetric int8 quantization of every >=2D
+    floating kernel; small leaves pass through unchanged."""
+
+    def quant(leaf):
+        if not _quantizable(leaf) or leaf.size < min_elems:
+            return leaf
+        x = leaf.astype(jnp.float32)
+        scale = jnp.max(jnp.abs(x), axis=tuple(range(x.ndim - 1)))
+        safe = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(jnp.round(x / safe * 127.0), -127, 127).astype(
+            jnp.int8
+        )
+        return QuantizedWeight(q=q, scale=scale.astype(jnp.float32))
+
+    return jax.tree_util.tree_map(quant, params)
+
+
+def dequantize_params(qparams, dtype=jnp.bfloat16):
+    """bf16 view of a quantized pytree (fused into consumers under
+    jit — the int8 storage stays resident, the view is transient)."""
+
+    def dequant(leaf):
+        if isinstance(leaf, QuantizedWeight):
+            return (
+                leaf.q.astype(jnp.float32) * (leaf.scale / 127.0)
+            ).astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(
+        dequant, qparams,
+        is_leaf=lambda l: isinstance(l, QuantizedWeight),
+    )
+
+
+def quantized_nbytes(qparams) -> int:
+    return sum(
+        l.nbytes for l in jax.tree_util.tree_leaves(qparams)
+        if hasattr(l, "nbytes")
+    )

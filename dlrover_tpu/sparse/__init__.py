@@ -1,3 +1,8 @@
+from dlrover_tpu.sparse.group_optimizers import (
+    SparseGroupAdagrad,
+    SparseGroupLassoAdam,
+)
 from dlrover_tpu.sparse.kv_variable import KvVariable, SparseAdam
 
-__all__ = ["KvVariable", "SparseAdam"]
+__all__ = ["KvVariable", "SparseAdam", "SparseGroupLassoAdam",
+           "SparseGroupAdagrad"]

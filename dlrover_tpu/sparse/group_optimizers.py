@@ -1,0 +1,130 @@
+"""Group-lasso sparse optimizers over a KvVariable.
+
+Capability parity with tfplus's group optimizers
+(``tfplus/tfplus/kv_variable/python/training/group_adam.py`` /
+``group_adagrad.py``: Adam/Adagrad whose update applies group-lasso
+regularization per embedding row, so rarely-useful rows shrink to exactly
+zero and can be reclaimed). Each embedding row is one group; after the
+base update the closed-form proximal operator of ``λ‖w‖₂`` rescales the
+row:
+
+    w ← w · max(0, 1 − lr·λ / ‖w‖₂)
+
+plus optional elementwise L1 soft-thresholding. Rows driven to zero are
+reported by ``zero_rows()`` so callers can evict them from the table —
+the sparsification the tfplus variants exist for.
+
+Both optimizers register as KvVariable slot listeners, so their
+accumulators follow rows through the host spill tier exactly like
+SparseAdam's moments.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.sparse.kv_variable import KvVariable, SparseAdam
+
+__all__ = ["SparseGroupLassoAdam", "SparseGroupAdagrad"]
+
+
+def _group_prox(rows: jnp.ndarray, shrink: float,
+                l1: float = 0.0) -> jnp.ndarray:
+    """Proximal step for λ‖w‖₂ (+ optional elementwise L1)."""
+    if l1 > 0.0:
+        rows = jnp.sign(rows) * jnp.maximum(jnp.abs(rows) - l1, 0.0)
+    norms = jnp.linalg.norm(rows, axis=-1, keepdims=True)
+    scale = jnp.maximum(0.0, 1.0 - shrink / jnp.maximum(norms, 1e-12))
+    return rows * scale
+
+
+class SparseGroupLassoAdam(SparseAdam):
+    """Adam + per-row group-lasso (tfplus GroupAdam analog)."""
+
+    def __init__(self, var: KvVariable, lr: float = 1e-3,
+                 b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                 l21: float = 0.0, l1: float = 0.0):
+        super().__init__(var, lr=lr, b1=b1, b2=b2, eps=eps)
+        self.l21 = l21
+        self.l1 = l1
+
+    def update(self, ids, grads):
+        super().update(ids, grads)
+        if self.l21 <= 0.0 and self.l1 <= 0.0:
+            return
+        slots = jnp.asarray(
+            np.unique(self.var.to_slots(ids, allocate=False))
+        )
+        rows = self.var.table[slots]
+        self.var.table = self.var.table.at[slots].set(
+            _group_prox(rows, self.lr * self.l21, self.l1)
+        )
+
+    def zero_rows(self, ids) -> np.ndarray:
+        """ids among ``ids`` whose rows the regularizer zeroed (eviction
+        candidates)."""
+        ids = np.asarray(ids).reshape(-1)
+        rows = np.asarray(self.var.lookup(ids, allocate=False))
+        dead = ~np.asarray(rows).any(axis=-1)
+        return ids[dead]
+
+
+class SparseGroupAdagrad:
+    """Adagrad + per-row group-lasso (tfplus GroupAdagrad analog).
+
+    Per-key accumulator ``G += g²``; step ``-lr·g/√(G+eps)``; then the
+    group proximal. Registers as a KvVariable slot listener."""
+
+    def __init__(self, var: KvVariable, lr: float = 0.1,
+                 eps: float = 1e-10, l21: float = 0.0, l1: float = 0.0):
+        self.var = var
+        self.lr, self.eps = lr, eps
+        self.l21, self.l1 = l21, l1
+        self._acc = jnp.zeros_like(var.table)
+        var.attach_slot_listener("adagrad", self)
+
+    # ---- slot-listener contract ----
+    def on_grow(self, new_cap: int):
+        self._sync_capacity()
+
+    def extract_rows(self, slots: np.ndarray):
+        self._sync_capacity()
+        return {"acc": np.asarray(self._acc[jnp.asarray(slots)])}
+
+    def write_rows(self, slots: np.ndarray, payload):
+        self._sync_capacity()
+        self._acc = self._acc.at[jnp.asarray(slots)].set(
+            jnp.asarray(payload["acc"], self._acc.dtype)
+        )
+
+    def reset_rows(self, slots: np.ndarray):
+        self._sync_capacity()
+        self._acc = self._acc.at[jnp.asarray(slots)].set(0.0)
+
+    def _sync_capacity(self):
+        cap = self.var.capacity
+        if self._acc.shape[0] < cap:
+            pad = cap - self._acc.shape[0]
+            self._acc = jnp.concatenate(
+                [self._acc,
+                 jnp.zeros((pad, self.var.dim), self._acc.dtype)]
+            )
+
+    def update(self, ids, grads):
+        slots_np = self.var.to_slots(ids, allocate=True)
+        self._sync_capacity()
+        g = jnp.asarray(grads).reshape(len(slots_np), self.var.dim)
+        uniq, inverse = np.unique(slots_np, return_inverse=True)
+        g = jax.ops.segment_sum(
+            g, jnp.asarray(inverse), num_segments=len(uniq)
+        )
+        slots = jnp.asarray(uniq)
+        acc = self._acc[slots] + g * g
+        self._acc = self._acc.at[slots].set(acc)
+        delta = -self.lr * g / jnp.sqrt(acc + self.eps)
+        rows = self.var.table[slots] + delta
+        if self.l21 > 0.0 or self.l1 > 0.0:
+            rows = _group_prox(rows, self.lr * self.l21, self.l1)
+        self.var.table = self.var.table.at[slots].set(rows)

@@ -317,3 +317,77 @@ class TestErrorMonitor:
             ErrorMonitor.classify("ICI link failure on port 3")
             == NodeExitReason.HARDWARE_ERROR
         )
+
+
+class TestJobResource:
+    """Per-role resource bookkeeping + OOM escalation (SURVEY §2.2
+    JobResource row; parity: master/resource/job.py)."""
+
+    def test_bookkeeping_round_trip(self):
+        from dlrover_tpu.master.job_resource import JobResource
+
+        jr = JobResource()
+        jr.update_node_group_resource("worker", 4, 2.0, 8192)
+        jr.update_node_group_resource("evaluator", 1, 1.0, 2048)
+        assert jr.worker_num == 4
+        assert jr.evaluator_num == 1
+        assert sorted(jr.get_node_types()) == ["evaluator", "worker"]
+        back = JobResource.from_dict(jr.to_dict())
+        g = back.get_node_group_resource("worker")
+        assert g.count == 4 and g.node_resource.memory_mb == 8192
+
+    def test_oom_escalates_geometrically_then_gives_up(self):
+        from dlrover_tpu.common.node import Node
+        from dlrover_tpu.master.job_resource import (
+            JobResourceManager,
+            OomPolicy,
+        )
+
+        mgr = JobResourceManager(OomPolicy(factor=2.0, max_escalations=2))
+        mgr.init_from_config(2, cpu=1.0, memory_mb=4096)
+        node = Node("worker", 0)
+        g1 = mgr.adjust_oom_resource(node)
+        assert g1.node_resource.memory_mb == 8192
+        g2 = mgr.adjust_oom_resource(node)
+        assert g2.node_resource.memory_mb == 16384
+        assert mgr.adjust_oom_resource(node) is None  # budget spent
+
+    def test_oom_error_bumps_memory_and_exhaustion_is_fatal(self):
+        """End-to-end through the job manager: an OOM report escalates
+        the worker memory request; once the budget is spent the node
+        becomes non-relaunchable instead of OOM-looping."""
+        from dlrover_tpu.common.constants import TrainingExceptionLevel
+        from dlrover_tpu.master.job_resource import (
+            JobResourceManager,
+            OomPolicy,
+        )
+        from dlrover_tpu.master.node_manager import LocalJobManager
+
+        mgr = JobResourceManager(OomPolicy(factor=2.0, max_escalations=1))
+        mgr.init_from_config(1, memory_mb=4096)
+        jm = LocalJobManager(node_num=1, resource_manager=mgr)
+        assert jm.process_error(
+            0, 0, "RESOURCE_EXHAUSTED: out of memory",
+            TrainingExceptionLevel.PROCESS_ERROR,
+        )
+        g = mgr.job_resource.get_node_group_resource("worker")
+        assert g.node_resource.memory_mb == 8192
+        # budget spent: second OOM marks the node non-relaunchable
+        assert jm.process_error(
+            0, 1, "RESOURCE_EXHAUSTED: out of memory",
+            TrainingExceptionLevel.PROCESS_ERROR,
+        )
+        assert jm.get_node(0).relaunchable is False
+
+    def test_resource_plan_recorded(self):
+        from dlrover_tpu.master.job_resource import JobResourceManager
+        from dlrover_tpu.master.scaling import ResourcePlan
+
+        mgr = JobResourceManager()
+        assert not mgr.apply_resource_plan(ResourcePlan())
+        assert mgr.apply_resource_plan(
+            ResourcePlan(worker_cpu=2.0, worker_memory_mb=9000,
+                         worker_num=3)
+        )
+        g = mgr.job_resource.get_node_group_resource("worker")
+        assert g.count == 3 and g.node_resource.memory_mb == 9000

