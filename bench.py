@@ -120,7 +120,7 @@ def section_small(peak, steps):
         cfg = GPTConfig(
             vocab_size=50257, max_seq_len=1024, num_layers=12,
             num_heads=12, d_model=768, remat=True, remat_policy="dots",
-            attn_impl="pallas", attn_block_k=1024,
+            attn_impl="pallas", attn_block_q=1024, attn_block_k=1024,
         )
         batch = int(os.getenv("DLROVER_TPU_BENCH_BATCH", "16"))
     else:
@@ -257,7 +257,7 @@ def section_medium(peak):
     cfg = GPTConfig(
         vocab_size=50257, max_seq_len=1024, num_layers=24,
         num_heads=16, d_model=1024, remat=True, remat_policy="dots",
-        attn_impl="pallas", attn_block_k=1024,
+        attn_impl="pallas", attn_block_q=1024, attn_block_k=1024,
     )
     row, result, state, _ = build_and_time(cfg, 8, 6, peak=peak)
     del result, state

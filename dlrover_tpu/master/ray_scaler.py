@@ -1,0 +1,119 @@
+"""Ray actor scaler + cluster-level polling watcher.
+
+Parity: the reference's Ray backend (``master/scaler/ray_scaler.py``
+``ActorScaler``: one Ray actor per node, created/killed through a
+``RayClient``) and its cluster watcher (``watcher/k8s_watcher.py:151``:
+platform state → NodeEvents). Same transport-injection pattern as
+``master/k8s.py``: this module owns the naming/bookkeeping protocol
+(actor name ``{job}-{type}-{id}``, type/id parse-back, alive diffing);
+the ``ray_client`` is any object with ``create_actor(name, spec)``,
+``remove_actor(name)``, ``list_actors() -> [name]`` — the real Ray API
+on a cluster, a fake in tests. Contract tests pin the protocol, so a
+live Ray backend is a client swap.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.common.periodic import PeriodicTask
+from dlrover_tpu.master.node_manager import ScalePlan, Scaler
+
+
+def actor_name(job: str, node: Node) -> str:
+    return f"{job}-{node.type}-{node.id}"
+
+
+def parse_actor_name(name: str) -> Optional[Tuple[str, int]]:
+    """``{job}-{type}-{id}`` -> (type, id); None for foreign actors."""
+    parts = name.rsplit("-", 2)
+    if len(parts) != 3:
+        return None
+    try:
+        return parts[1], int(parts[2])
+    except ValueError:
+        return None
+
+
+class ActorScaler(Scaler):
+    """Realize ScalePlans as Ray actor create/kill calls."""
+
+    def __init__(self, ray_client, job_name: str):
+        self._client = ray_client
+        self._job = job_name
+
+    def scale(self, plan: ScalePlan):
+        for node in plan.remove_nodes:
+            name = actor_name(self._job, node)
+            self._client.remove_actor(name)
+            logger.info("ray scaler removed actor %s", name)
+        for node in plan.launch_nodes:
+            name = actor_name(self._job, node)
+            spec = {
+                "type": node.type,
+                "id": node.id,
+                "rank_index": getattr(node, "rank_index", node.id),
+            }
+            res = getattr(node, "resource", None)
+            if res is not None:
+                spec["num_cpus"] = getattr(res, "cpu", 0) or None
+                mem = getattr(res, "memory_mb", 0)
+                spec["memory"] = mem * (1 << 20) if mem else None
+            self._client.create_actor(name, spec)
+            logger.info("ray scaler created actor %s", name)
+
+    def alive_nodes(self) -> List[Tuple[str, int]]:
+        out = []
+        for name in self._client.list_actors():
+            if not name.startswith(f"{self._job}-"):
+                continue
+            parsed = parse_actor_name(name)
+            if parsed is not None:
+                out.append(parsed)
+        return out
+
+
+class ClusterWatcher:
+    """Poll any platform's node listing into job-manager failure events
+    (parity: ``watcher/k8s_watcher.py`` / ``watcher/ray_watcher.py``).
+
+    ``list_alive() -> iterable of node ids`` is the platform adapter:
+    ``ActorScaler.alive_nodes`` ids for Ray, a pod lister for k8s, the
+    ``ProcessScaler`` for local runs. A node that was expected (known to
+    the job manager as non-exited) but vanished from the listing is
+    reported failed — the cluster-level death signal heartbeats alone
+    can't give (a preempted VM never sends a last heartbeat)."""
+
+    def __init__(self, list_alive, job_manager, interval: float = 2.0):
+        self._list_alive = list_alive
+        self._job_manager = job_manager
+        self._reported: set = set()
+        self._task = PeriodicTask(self._poll, interval, "cluster-watcher")
+
+    def _poll(self):
+        try:
+            alive = set(self._list_alive())
+        except Exception:
+            logger.exception("cluster watcher: listing failed")
+            return
+        expected = {
+            n.id for n in self._job_manager.all_nodes() if not n.exited()
+        }
+        vanished = expected - alive
+        # A node seen alive again (relaunch) re-arms its report.
+        self._reported &= vanished
+        for node_id in vanished - self._reported:
+            self._reported.add(node_id)
+            logger.info(
+                "cluster watcher: node %s vanished from the platform",
+                node_id,
+            )
+            self._job_manager.update_node_status(
+                node_id, "failed", "node-vanished"
+            )
+
+    def start(self):
+        self._task.start()
+
+    def stop(self):
+        self._task.stop()

@@ -336,3 +336,96 @@ class TestK8sClientContract:
             client.create_scaleplan(
                 scaleplan_from_plan(ScalePlan(), "j", 1)
             )
+
+
+class TestActorScaler:
+    """Ray backend contract (parity: scaler/ray_scaler.py ActorScaler):
+    actor naming, create/remove protocol, alive diffing."""
+
+    class FakeRay:
+        def __init__(self):
+            self.actors = {}
+            self.calls = []
+
+        def create_actor(self, name, spec):
+            self.calls.append(("create", name, spec))
+            self.actors[name] = spec
+
+        def remove_actor(self, name):
+            self.calls.append(("remove", name))
+            self.actors.pop(name, None)
+
+        def list_actors(self):
+            return list(self.actors)
+
+    def test_scale_creates_and_removes_actors(self):
+        from dlrover_tpu.common.node import NodeResource
+        from dlrover_tpu.master.ray_scaler import ActorScaler
+
+        ray = self.FakeRay()
+        scaler = ActorScaler(ray, "job-r")
+        n = Node("worker", 3)
+        n.resource = NodeResource(cpu=2.0, memory_mb=4096)
+        scaler.scale(ScalePlan(launch_nodes=[n]))
+        assert "job-r-worker-3" in ray.actors
+        spec = ray.actors["job-r-worker-3"]
+        assert spec["num_cpus"] == 2.0
+        assert spec["memory"] == 4096 << 20
+        scaler.scale(ScalePlan(remove_nodes=[Node("worker", 3)]))
+        assert ray.actors == {}
+
+    def test_alive_nodes_ignores_foreign_actors(self):
+        from dlrover_tpu.master.ray_scaler import ActorScaler
+
+        ray = self.FakeRay()
+        ray.actors = {
+            "job-r-worker-0": {},
+            "job-r-worker-2": {},
+            "other-job-worker-5": {},
+            "unrelated": {},
+        }
+        scaler = ActorScaler(ray, "job-r")
+        assert sorted(scaler.alive_nodes()) == [
+            ("worker", 0), ("worker", 2)
+        ]
+
+    def test_actor_name_round_trip(self):
+        from dlrover_tpu.master.ray_scaler import (
+            actor_name,
+            parse_actor_name,
+        )
+
+        name = actor_name("j", Node("worker", 7))
+        assert parse_actor_name(name) == ("worker", 7)
+        assert parse_actor_name("garbage") is None
+
+
+class TestClusterWatcher:
+    def test_vanished_node_reported_once_and_rearms(self):
+        from dlrover_tpu.master.ray_scaler import ClusterWatcher
+
+        jm = LocalJobManager(node_num=2)
+        failures = []
+        jm.add_event_callback(
+            lambda event: failures.append(
+                (event.node.id, event.node.status)
+            ) if event.node.status == "failed" else None
+        )
+        alive = {0, 1}
+        watcher = ClusterWatcher(lambda: alive, jm, interval=60)
+        watcher._poll()
+        assert failures == []
+        alive.discard(1)                # platform lost node 1
+        watcher._poll()
+        watcher._poll()                 # no duplicate report while down
+        assert [f for f in failures if f[0] == 1] == [(1, "failed")]
+        # relaunch: node 1 alive again, then vanishes again -> re-report
+        jm.get_node(1).update_status("running")
+        alive.add(1)
+        watcher._poll()
+        alive.discard(1)
+        jm.get_node(1).update_status("running")
+        watcher._poll()
+        assert [f for f in failures if f[0] == 1] == [
+            (1, "failed"), (1, "failed")
+        ]
