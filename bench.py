@@ -19,6 +19,8 @@ select, default all):
   blockwise adam (the memory-lean recipe the low-bit optimizer exists
   for; fp32 adam state alone would need 25 GB). BASELINE.md's model
   class.
+- ``llama``   — the second flagship family at ~1.15B (GQA + SwiGLU,
+  seq 2048): the best-MFU configuration in the suite.
 - ``longctx`` — seq-4096/8192 flash attention vs the einsum path at
   batch 1 (where the [S,S] logits dominate): the memory win the Pallas
   kernel exists for.
@@ -307,6 +309,56 @@ def section_large(peak):
     return row
 
 
+def section_llama(peak):
+    """Second flagship family at ~1.15B (GQA + SwiGLU, seq 2048, bf16
+    params + layer-chunked 8-bit adam): measured 50.7% MFU on v5e."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.accel import ParallelSpec, auto_accelerate
+    from dlrover_tpu.models.llama import Llama, LlamaConfig, loss_fn
+    from dlrover_tpu.optim.low_bit import adam8bit
+
+    cfg = LlamaConfig(
+        vocab_size=32000, max_seq_len=2048, num_layers=22,
+        num_heads=16, num_kv_heads=8, d_model=2048,
+        param_dtype=jnp.bfloat16, remat=True, remat_policy="dots",
+        attn_impl="pallas", attn_block_q=1024, attn_block_k=1024,
+    )
+    B = 4
+    model = Llama(cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(0), (B, cfg.max_seq_len), 0, cfg.vocab_size
+    )
+
+    def token_loss(module, params, b):
+        return loss_fn(module.apply({"params": params}, b), b)
+
+    res = auto_accelerate(
+        model, adam8bit(2e-4), tokens, token_loss,
+        spec=ParallelSpec(data=1), devices=[jax.devices()[0]],
+    )
+    state = res.state
+    t0 = time.perf_counter()
+    state, m = res.train_step(state, tokens)
+    float(m["loss"])
+    compile_s = time.perf_counter() - t0
+    state, step_s = timed_steps(res.train_step, state, tokens, 5)
+    flops = cfg.flops_per_token() * B * cfg.max_seq_len
+    row = {
+        "params_m": round(cfg.param_count() / 1e6, 1),
+        "batch": B,
+        "seq": cfg.max_seq_len,
+        "compile_s": round(compile_s, 1),
+        "step_time_ms": round(step_s * 1e3, 1),
+        "tokens_per_s": round(B * cfg.max_seq_len / step_s),
+        "mfu_pct": round(flops / step_s / peak * 100, 1) if peak else -1,
+    }
+    del res, state
+    log(f"bench[llama]: {row}")
+    return row
+
+
 def section_longctx(peak):
     """Flash-attention's long-context case: batch 1, seq 4k/8k; the
     einsum path materializes the [S,S] logits, the Pallas kernel never
@@ -423,7 +475,8 @@ def main():
     steps = int(os.getenv("DLROVER_TPU_BENCH_STEPS", "10"))
     on_tpu = dev.platform not in ("cpu",)
     default_sections = (
-        "small,medium,large,longctx,goodput" if on_tpu else "small,goodput"
+        "small,medium,large,llama,longctx,goodput"
+        if on_tpu else "small,goodput"
     )
     sections = os.getenv(
         "DLROVER_TPU_BENCH_SECTIONS", default_sections
@@ -451,6 +504,8 @@ def main():
                 extra["medium"] = section_medium(peak)
             elif name == "large":
                 extra["large"] = section_large(peak)
+            elif name == "llama":
+                extra["llama"] = section_llama(peak)
             elif name == "longctx":
                 extra["longctx"] = section_longctx(peak)
             elif name == "goodput":
