@@ -474,8 +474,10 @@ def main():
     )
     steps = int(os.getenv("DLROVER_TPU_BENCH_STEPS", "10"))
     on_tpu = dev.platform not in ("cpu",)
+    # Most-load-bearing first: if the driver's time limit bites, the
+    # budget guard sheds the tail sections, not the headline.
     default_sections = (
-        "small,medium,large,llama,longctx,goodput"
+        "small,large,llama,longctx,goodput,medium"
         if on_tpu else "small,goodput"
     )
     sections = os.getenv(
@@ -484,7 +486,7 @@ def main():
 
     extra = {"device": dev.device_kind}
     save_block_s = None
-    budget_s = float(os.getenv("DLROVER_TPU_BENCH_BUDGET_S", "1500"))
+    budget_s = float(os.getenv("DLROVER_TPU_BENCH_BUDGET_S", "1100"))
     bench_t0 = time.perf_counter()
     log(f"bench: device={dev.device_kind} sections={sections}")
     for name in sections:

@@ -66,6 +66,8 @@ class AccelerateResult:
     batch_sharding: Any
     train_step: Callable         # (state, batch) -> (state, metrics)
     init_fn: Callable            # (rng) -> sharded state (for re-init)
+    search_ranking: Any = None   # [(ParallelSpec, CostEstimate)] from the
+                                 # strategy search (None for explicit specs)
 
 
 def _device_hbm(devices) -> float:
@@ -417,13 +419,31 @@ def auto_accelerate(
         mprofile, n, batch_size=sample_batch.shape[0], hbm=hbm,
         abstract_fn=abstract_for, top_k=max(1, search_top_k),
     )
-    chosen = ranked[0][0]
+    chosen, chosen_est = ranked[0]
     logger.info(
         "auto_accelerate: %.1fM params on %s devices -> search chose %s",
         params / 1e6, n, chosen,
     )
+    if not chosen_est.fits(hbm) and not offload_optimizer:
+        # The binding constraint is memory and most of it is optimizer
+        # state at rest: say so instead of letting the compile OOM
+        # mutely (parity: the reference engine's strategy feedback).
+        logger.warning(
+            "auto_accelerate: best strategy %s needs %.1f GB/device "
+            "(%.1f GB HBM); the optimizer state is %.0f%% of it — "
+            "consider offload_optimizer=True and/or the 8-bit adam",
+            chosen, chosen_est.total_bytes / 1e9, hbm / 1e9,
+            100 * max(
+                0.0, 1 - 8.0 * params / max(chosen_est.state_bytes, 1)
+            ),
+        )
     if not profile or len(ranked) == 1:
-        return build(chosen, reconfigure_module(module, chosen, sample_batch.shape[0]))
+        result = build(
+            chosen,
+            reconfigure_module(module, chosen, sample_batch.shape[0]),
+        )
+        result.search_ranking = ranked
+        return result
 
     best, best_time = None, float("inf")
     for cand, _est in ranked:
@@ -446,4 +466,8 @@ def auto_accelerate(
             logger.warning("dry-run %s failed: %s", cand, e)
     if best is None:
         best = chosen
-    return build(best, reconfigure_module(module, best, sample_batch.shape[0]))
+    result = build(
+        best, reconfigure_module(module, best, sample_batch.shape[0])
+    )
+    result.search_ranking = ranked
+    return result

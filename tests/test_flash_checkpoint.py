@@ -339,3 +339,48 @@ class TestStepConsistencyVote:
         assert self._vote(master, tmp_path, monkeypatch, [7, -1]) == [
             False, False,
         ]
+
+
+class TestQuantizedStateCheckpoint:
+    """The 8-bit optimizer's int8/_QTensor pytree must round-trip
+    through the flash engines byte-exactly (namedtuple structure,
+    int8 + fp32 leaves, per-layer chunked layouts)."""
+
+    def test_adam8bit_state_round_trips(self, tmp_path, monkeypatch):
+        import jax
+
+        from dlrover_tpu.optim.low_bit import adam8bit
+
+        params = {
+            "stack": jnp.ones((4, 8, 16), jnp.float32),  # chunked leaf
+            "w": jnp.ones((32, 8), jnp.float32),
+            "b": jnp.zeros((8,), jnp.float32),
+        }
+        opt = adam8bit(1e-2)
+        opt_state = opt.init(params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        _, opt_state = opt.update(grads, opt_state, params)
+        state = {"params": params, "opt": opt_state, "step": 1}
+
+        monkeypatch.setenv("DLROVER_TPU_JOB_NAME", f"q8-{os.getpid()}")
+        ckpt = FlashCheckpointer(str(tmp_path / "ckpts"))
+        try:
+            from dlrover_tpu.train.checkpoint.checkpointer import (
+                StorageType,
+            )
+
+            ckpt.save_checkpoint(1, state, StorageType.DISK)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, state)
+            step, restored = ckpt.load_checkpoint(zeros)
+            assert step == 1
+            for a, b in zip(
+                jax.tree_util.tree_leaves(state),
+                jax.tree_util.tree_leaves(restored),
+            ):
+                if hasattr(a, "dtype"):
+                    assert a.dtype == b.dtype
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b)
+                )
+        finally:
+            ckpt.close()
