@@ -415,9 +415,14 @@ def auto_accelerate(
             _abstract_cache[key] = jax.eval_shape(init_fn, rng)
         return _abstract_cache[key]
 
+    # Hierarchy awareness: when the device set spans hosts, axes whose
+    # collective block crosses the host boundary are priced at DCN.
+    hosts = len({getattr(d, "process_index", 0) for d in devices})
+    devices_per_host = (n + hosts - 1) // hosts if hosts > 1 else 0
     ranked = search_spec(
         mprofile, n, batch_size=sample_batch.shape[0], hbm=hbm,
         abstract_fn=abstract_for, top_k=max(1, search_top_k),
+        devices_per_host=devices_per_host,
     )
     chosen, chosen_est = ranked[0]
     logger.info(

@@ -53,6 +53,34 @@ _PEAK_FLOPS_DEFAULT = 197e12  # v5e bf16
 # parallelism (a collective every layer) correctly lose to pure DP (one
 # grad all-reduce) on models too small to amortize it.
 _COLL_LAT = 5e-6
+# Inter-host (DCN) figures: per-device bandwidth and per-collective
+# latency for mesh axes whose neighbours live on different hosts.
+_DCN_BW = 2.5e9
+_DCN_LAT = 100e-6
+
+
+def _axis_links(spec, devices_per_host: int):
+    """Per-axis (bandwidth_kind) map: which mesh axes cross hosts.
+
+    Device order follows the canonical mesh layout (mesh.AXIS_ORDER,
+    outermost first); an axis is host-local iff the block its
+    collectives span — its own size times everything inner to it — fits
+    in one host. With ``devices_per_host=0`` (single host) every axis is
+    ICI.
+    """
+    from dlrover_tpu.accel.mesh import AXIS_ORDER
+
+    sizes = _axis_sizes(spec)
+    crossing = {}
+    for i, axis in enumerate(AXIS_ORDER):
+        inner = 1
+        for later in AXIS_ORDER[i + 1:]:
+            inner *= sizes.get(later, 1)
+        span = inner * sizes.get(axis, 1)
+        crossing[axis] = bool(
+            devices_per_host and span > devices_per_host
+        )
+    return crossing
 
 
 @dataclass(frozen=True)
@@ -214,8 +242,16 @@ def estimate(
     peak_flops: float = _PEAK_FLOPS_DEFAULT,
     ici_bw: float = _ICI_BW,
     microbatches: int = 0,
+    devices_per_host: int = 0,
+    dcn_bw: float = _DCN_BW,
 ) -> CostEstimate:
-    """Analytic memory + roofline cost for one candidate spec."""
+    """Analytic memory + roofline cost for one candidate spec.
+
+    ``devices_per_host > 0`` makes the comm terms hierarchy-aware: a
+    mesh axis whose collective block spans hosts (canonical layout,
+    outer axes first) is priced at ``dcn_bw`` with DCN latency — the
+    model that makes hierarchical placements (fsdp inside a host, dp or
+    pp across) beat host-crossing gathers."""
     p = profile
     dp = spec.data * spec.fsdp                      # batch shards
     tokens_dev = batch_size * max(p.seq_len, 1) / (dp * spec.seq)
@@ -258,48 +294,58 @@ def estimate(
     )
     bubble = (m + spec.pipe - 1) / m if spec.pipe > 1 else 1.0
 
-    # --- communication (per-device bytes over ICI + per-collective α) ---
-    comm_ov = 0.0    # prefetchable: FSDP gathers, DP grad sync
-    comm_cp = 0.0    # critical path: TP/ring/EP/stage transfers
-    n_coll = 0.0
+    # --- communication (per-axis bandwidth + per-collective α) ---
+    # Each term is priced at its own axis's link: ICI within a host,
+    # DCN when the axis's collective block spans hosts.
+    crossing = _axis_links(spec, devices_per_host)
+
+    def bw(axis):
+        return dcn_bw if crossing.get(axis) else ici_bw
+
+    def lat(axis):
+        return _DCN_LAT if crossing.get(axis) else _COLL_LAT
+
+    comm_ov_s = 0.0  # prefetchable: FSDP gathers, DP grad sync
+    comm_cp_s = 0.0  # critical path: TP/ring/EP/stage transfers
     pbytes_tp = 2.0 * p.param_count / (spec.tensor * spec.expert * spec.pipe)
     if spec.fsdp > 1:
         # all-gather params fwd + bwd, reduce-scatter grads (bf16 wire);
         # one collective per layer per direction.
-        comm_ov += 3.0 * pbytes_tp * (spec.fsdp - 1) / spec.fsdp
-        n_coll += 3.0 * layers_dev
+        comm_ov_s += (3.0 * pbytes_tp * (spec.fsdp - 1) / spec.fsdp
+                      / bw("fsdp"))
+        comm_cp_s += 3.0 * layers_dev * lat("fsdp")
     if spec.data > 1:
         # grad all-reduce over the pure-DP axis (on the fsdp-sharded rest).
-        comm_ov += (2.0 * (pbytes_tp / spec.fsdp)
-                    * (spec.data - 1) / spec.data)
-        n_coll += 1.0
+        comm_ov_s += (2.0 * (pbytes_tp / spec.fsdp)
+                      * (spec.data - 1) / spec.data / bw("data"))
+        comm_cp_s += lat("data")
     if spec.tensor > 1:
         # Megatron semantics: 2 activation all-reduces fwd + 2 bwd per
         # layer of [tokens, d_model]; an all-reduce moves 2x the payload
         # (reduce-scatter + all-gather).
-        comm_cp += (8.0 * layers_dev * tokens_dev * p.d_model * dtype_b
-                    * (spec.tensor - 1) / spec.tensor)
-        n_coll += 4.0 * layers_dev
+        comm_cp_s += (8.0 * layers_dev * tokens_dev * p.d_model * dtype_b
+                      * (spec.tensor - 1) / spec.tensor / bw("tensor"))
+        comm_cp_s += 4.0 * layers_dev * lat("tensor")
     if spec.seq > 1:
         # ring attention: each device's K and V blocks make (seq-1) hops
         # around the ring per layer (full KV visits every shard); the
         # backward ring doubles it.
-        comm_cp += (3.0 * 2.0 * layers_dev * tokens_dev * p.d_model
-                    * dtype_b * (spec.seq - 1))
-        n_coll += 3.0 * layers_dev * spec.seq
+        comm_cp_s += (3.0 * 2.0 * layers_dev * tokens_dev * p.d_model
+                      * dtype_b * (spec.seq - 1) / bw("seq"))
+        comm_cp_s += 3.0 * layers_dev * spec.seq * lat("seq")
     if spec.expert > 1:
         # dispatch + combine all-to-all, fwd + bwd, top_k routed copies.
-        comm_cp += (4.0 * layers_dev * tokens_dev * p.d_model * dtype_b
-                    * p.moe_top_k * (spec.expert - 1) / spec.expert)
-        n_coll += 4.0 * layers_dev
+        comm_cp_s += (4.0 * layers_dev * tokens_dev * p.d_model * dtype_b
+                      * p.moe_top_k * (spec.expert - 1) / spec.expert
+                      / bw("expert"))
+        comm_cp_s += 4.0 * layers_dev * lat("expert")
     if spec.pipe > 1:
         # stage-boundary activation transfers: m microbatches cross each
-        # boundary fwd + bwd (one permute per schedule tick each way).
-        comm_cp += 2.0 * tokens_dev * p.d_model * dtype_b
-        n_coll += 2.0 * (m + spec.pipe - 1)
-    lat = n_coll * _COLL_LAT
-    comm_ov_s = comm_ov / ici_bw
-    comm_cp_s = comm_cp / ici_bw + lat
+        # boundary fwd + bwd (one permute per schedule tick each way) —
+        # the tiny traffic that makes PP the right axis to place across
+        # DCN.
+        comm_cp_s += 2.0 * tokens_dev * p.d_model * dtype_b / bw("pipe")
+        comm_cp_s += 2.0 * (m + spec.pipe - 1) * lat("pipe")
 
     return CostEstimate(
         state_bytes=state_b, grad_bytes=grad_b, act_bytes=act_b,
@@ -386,6 +432,8 @@ def search_spec(
     prefer: Sequence[str] = (),
     abstract_fn=None,
     ici_bw: float = _ICI_BW,
+    devices_per_host: int = 0,
+    dcn_bw: float = _DCN_BW,
 ) -> List[Tuple[Any, CostEstimate]]:
     """Rank the feasible strategy space; return the top-K (spec, cost).
 
@@ -405,12 +453,14 @@ def search_spec(
         ab = abstract_fn(fallback) if abstract_fn else abstract_state
         return [(fallback, estimate(
             profile, fallback, batch_size, hbm, ab, peak_flops,
-            ici_bw=ici_bw))]
+            ici_bw=ici_bw, devices_per_host=devices_per_host,
+            dcn_bw=dcn_bw))]
     scored = []
     for spec in cands:
         ab = abstract_fn(spec) if abstract_fn else abstract_state
         est = estimate(profile, spec, batch_size, hbm, ab, peak_flops,
-                       ici_bw=ici_bw)
+                       ici_bw=ici_bw, devices_per_host=devices_per_host,
+                       dcn_bw=dcn_bw)
         scored.append((spec, est))
     fitting = [s for s in scored if s[1].fits(hbm)]
     if fitting:
