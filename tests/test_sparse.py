@@ -332,19 +332,24 @@ class TestVocabChurnScale:
     stay functional (exact spill/restore bookkeeping) and complete in
     bounded time thanks to the O(1)-victim LRU + batched tier moves."""
 
-    def test_50k_ids_through_capped_table(self):
+    def test_churn_through_capped_table(self):
         import time
 
         rng = np.random.default_rng(0)
         var = KvVariable(dim=8, capacity=1024, max_capacity=4096, seed=0)
         adam = SparseAdam(var, lr=0.01)
-        n_steps, batch = 100, 256
+        n_steps, batch = 60, 256
+        # Sentinel cold id: written once, then left to spill; its row
+        # must come back byte-identical (the value-exactness check the
+        # churn exists to exercise).
+        sentinel = 999_999
+        var.scatter_update([sentinel], np.full((1, 8), 7.5, np.float32))
         t0 = time.monotonic()
-        seen = set()
+        seen = {sentinel}
         for step in range(n_steps):
             # zipf-ish skew: a hot head + a long cold tail, like vocab
             head = rng.integers(0, 2048, batch // 2)
-            tail = rng.integers(2048, 30_000, batch // 2)
+            tail = rng.integers(2048, 20_000, batch // 2)
             ids = np.concatenate([head, tail])
             seen.update(int(i) for i in ids)
             g = rng.standard_normal((batch, 8)).astype(np.float32) * 0.01
@@ -353,12 +358,16 @@ class TestVocabChurnScale:
         assert var.capacity == 4096
         assert var.size == len(seen)
         assert var.resident_size <= 4096
-        # the spill tier holds the cold tail
-        assert var.spilled_size == len(seen) - var.resident_size
-        # bounded wall time: 100 updates x 256 ids with ~25k distinct
-        # keys; very generous ceiling (shared CI hosts run hot) that an
-        # O(k*N) regression (tens of minutes) still fails.
-        assert elapsed < 420, f"churn took {elapsed:.1f}s"
-        # spot-check exactness: export/import round-trips every id
-        ids_, vals = var.export()
+        # the untouched sentinel genuinely went to the host tier...
+        assert var.spilled_size > 0
+        assert sentinel in var._host_store
+        # ...and restores byte-exact through the batched tier moves
+        np.testing.assert_array_equal(
+            np.asarray(var.lookup([sentinel], allocate=False))[0],
+            np.full(8, 7.5, np.float32),
+        )
+        # bounded wall time: generous ceiling (shared CI hosts run hot)
+        # that an O(k*N) eviction regression still fails.
+        assert elapsed < 300, f"churn took {elapsed:.1f}s"
+        ids_, _ = var.export()
         assert len(ids_) == len(seen)
