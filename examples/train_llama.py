@@ -30,6 +30,10 @@ def main():
     parser.add_argument("--seq", type=int, default=128)
     parser.add_argument("--ckpt-dir", type=str, default="")
     parser.add_argument("--grad-accum", type=int, default=1)
+    parser.add_argument("--spec", type=str, default="auto",
+                        help='"auto" lets the strategy search pick the '
+                        'mesh (and reconfigure the model); "data" pins '
+                        "pure data parallelism")
     args = parser.parse_args()
 
     dtrain.init_training()
@@ -55,9 +59,10 @@ def main():
             )
 
     sample = next(batches())
+    spec = "auto" if args.spec == "auto" else ParallelSpec(data=n_dev)
     trainer = Trainer(
         Llama(cfg), optax.adamw(3e-4), token_loss, sample,
-        spec=ParallelSpec(data=n_dev),
+        spec=spec,
         checkpoint_dir=args.ckpt_dir, persist_every=10,
         grad_accum=args.grad_accum,
     )
