@@ -211,7 +211,7 @@ def section_small(peak, steps):
         result.train_step, state, tokens, max(3, steps // 2)
     )
     t0 = time.perf_counter()
-    assert engine.wait_staged(timeout=1500.0), "async snapshot never landed"
+    assert engine.wait_staged(timeout=600.0), "async snapshot never landed"
     staging_rest_s = time.perf_counter() - t0
     n_during = max(3, steps // 2)
     staging_s = save_block_s + n_during * step_during_s + staging_rest_s
