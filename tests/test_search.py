@@ -335,3 +335,34 @@ class TestHierarchyAwareness:
         spec = ranked[0][0]
         assert spec.fsdp <= 8, f"host-crossing gathers chosen: {spec}"
         assert spec.total == 16
+
+
+class TestProfiledSearch:
+    def test_dry_run_top_k_picks_and_trains(self):
+        """spec="auto" + profile=True: the search's top-K candidates are
+        compiled and timed on the real (virtual) mesh and the winner is
+        built — the reference dry-runner path end-to-end."""
+        cfg = dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)
+        model = GPT(cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size
+        )
+
+        def token_loss(module, params, b):
+            return loss_fn(module.apply({"params": params}, b), b)
+
+        res = auto_accelerate(
+            model, optax.adamw(1e-3), tokens, token_loss, spec="auto",
+            profile=True, profile_steps=2, search_top_k=3,
+        )
+        assert res.spec.total == 8
+        assert res.search_ranking is not None
+        assert 1 <= len(res.search_ranking) <= 3
+        state = res.state
+        batch = jax.device_put(tokens, res.batch_sharding)
+        losses = []
+        for _ in range(3):
+            state, m = res.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
