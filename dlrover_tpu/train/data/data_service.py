@@ -194,5 +194,6 @@ class CoworkerDataService:
             w.join(timeout=max(0.1, deadline - time.time()))
             if w.is_alive():
                 w.terminate()
+                w.join(timeout=5.0)  # reap: is_alive() must settle
         self._tasks.close()
         self._ring.destroy()
