@@ -41,7 +41,13 @@ def default_transport(
             data=json.dumps(body).encode() if body is not None else None,
             method=method,
         )
-        req.add_header("Content-Type", "application/json")
+        # Custom resources reject application/json on PATCH (415); the
+        # apiserver accepts merge-patch or json-patch for CRDs, and the
+        # bodies this client builds are merge patches.
+        if method == "PATCH":
+            req.add_header("Content-Type", "application/merge-patch+json")
+        else:
+            req.add_header("Content-Type", "application/json")
         if token:
             req.add_header("Authorization", f"Bearer {token}")
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -119,8 +125,10 @@ class K8sElasticJobClient:
     # ------------- elasticjobs -------------
     def patch_elasticjob_replicas(self, job_name: str,
                                   replicas: Dict[str, int]) -> Dict:
-        """Strategic-merge patch of an ElasticJob's replica counts (the
-        reference's elasticjob_scaler patch shape)."""
+        """Merge-patch of an ElasticJob's replica counts (the
+        reference's elasticjob_scaler patch shape). Sent as
+        ``application/merge-patch+json`` — CRDs do not support
+        strategic merge."""
         body = {
             "spec": {
                 "replicaSpecs": {

@@ -190,6 +190,7 @@ class JobManager:
                     )
                     if adjusted is None:
                         node.relaunchable = False
+                        relaunch_node = False
             self.update_node_status(node_id, NodeStatus.FAILED, reason)
         return relaunch_node
 

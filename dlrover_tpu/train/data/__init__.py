@@ -10,6 +10,7 @@ that were missing in rounds 1-2.
 
 from dlrover_tpu.train.data.data_service import (
     CoworkerDataService,
+    CoworkerTaskError,
     ShmBatchRing,
 )
 from dlrover_tpu.train.data.dataloader import ElasticDataLoader
@@ -21,6 +22,7 @@ from dlrover_tpu.train.data.sharding_client import (
 
 __all__ = [
     "CoworkerDataService",
+    "CoworkerTaskError",
     "ShmBatchRing",
     "ElasticDataLoader",
     "ElasticSampler",

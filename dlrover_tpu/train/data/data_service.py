@@ -33,7 +33,22 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.comm import SharedQueue
 from dlrover_tpu.common.shared_memory import SharedMemory
 
-__all__ = ["ShmBatchRing", "CoworkerDataService"]
+__all__ = ["ShmBatchRing", "CoworkerDataService", "CoworkerTaskError"]
+
+
+class CoworkerTaskError(RuntimeError):
+    """A coworker's ``preprocess`` raised: the failure travels through
+    the ready queue as a sentinel descriptor so the consumer sees the
+    error immediately instead of timing out waiting for a batch that
+    will never arrive."""
+
+    def __init__(self, worker_id: int, task_repr: str, error: str):
+        super().__init__(
+            f"coworker {worker_id} failed on task {task_repr}: {error}"
+        )
+        self.worker_id = worker_id
+        self.task_repr = task_repr
+        self.error = error
 
 
 class ShmBatchRing:
@@ -79,8 +94,18 @@ class ShmBatchRing:
             off += a.nbytes
         self._ready.put({"slot": slot, "desc": desc})
 
+    def put_error(self, worker_id: int, task_repr: str, error: str):
+        """Publish a failure sentinel (no slot consumed)."""
+        self._ready.put({
+            "error": error, "worker": worker_id, "task": task_repr,
+        })
+
     def get(self, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
         meta = self._ready.get(timeout=timeout)
+        if "error" in meta:
+            raise CoworkerTaskError(
+                meta["worker"], meta["task"], meta["error"]
+            )
         slot = meta["slot"]
         off = slot * self.slot_bytes
         out = {}
@@ -117,10 +142,19 @@ def _worker_main(name: str, slot_bytes: int, num_slots: int, job: str,
         try:
             arrays = preprocess(task)
             ring.put(arrays)
-        except Exception:
+        except Exception as e:
             logger.exception(
                 "data coworker %s failed on task %r", worker_id, task
             )
+            try:
+                ring.put_error(
+                    worker_id, repr(task), f"{type(e).__name__}: {e}"
+                )
+            except Exception:
+                # The ready queue may already be gone (consumer stopped
+                # mid-task); never let the sentinel kill the worker loop.
+                logger.exception("coworker %s could not publish error",
+                                 worker_id)
     ring.close()
     tasks.close()
 
@@ -172,7 +206,13 @@ class CoworkerDataService:
         self._submitted += 1
 
     def get_batch(self, timeout: float = 60.0) -> Dict[str, np.ndarray]:
-        batch = self._ring.get(timeout=timeout)
+        try:
+            batch = self._ring.get(timeout=timeout)
+        except CoworkerTaskError:
+            # The failed task is still a terminal outcome for one
+            # submission — count it so batches() bookkeeping stays exact.
+            self._consumed += 1
+            raise
         self._consumed += 1
         return batch
 

@@ -113,16 +113,29 @@ class TestCoworkerDataService:
         finally:
             svc.stop()
 
-    def test_worker_crash_does_not_wedge_service(self):
+    def test_worker_crash_surfaces_error_not_hang(self):
+        """A failed preprocess travels through the ready queue as a
+        sentinel: the consumer sees CoworkerTaskError immediately (not a
+        60 s timeout), the worker survives, and good tasks still flow."""
+        from dlrover_tpu.train.data.data_service import CoworkerTaskError
+
         svc = CoworkerDataService(
             tokenize_task, num_workers=2, slot_mb=1, num_slots=4,
             name="t-cw-crash",
         )
         try:
-            svc.submit("not-a-tuple")  # preprocess raises, worker logs on
+            svc.submit("not-a-tuple")  # preprocess raises in the worker
             svc.submit((5, 8))
-            out = svc.get_batch(timeout=30)
-            assert int(out["weight"][0]) == 5
+            good, errors = [], []
+            for _ in range(2):
+                try:
+                    good.append(svc.get_batch(timeout=30))
+                except CoworkerTaskError as e:
+                    errors.append(e)
+            assert len(errors) == 1
+            assert "not-a-tuple" in errors[0].task_repr
+            assert len(good) == 1
+            assert int(good[0]["weight"][0]) == 5
             assert svc.alive_workers == 2
         finally:
             svc.stop()

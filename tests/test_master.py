@@ -372,8 +372,9 @@ class TestJobResource:
         )
         g = mgr.job_resource.get_node_group_resource("worker")
         assert g.node_resource.memory_mb == 8192
-        # budget spent: second OOM marks the node non-relaunchable
-        assert jm.process_error(
+        # budget spent: second OOM marks the node non-relaunchable and
+        # the API must report the actual decision (no relaunch).
+        assert not jm.process_error(
             0, 1, "RESOURCE_EXHAUSTED: out of memory",
             TrainingExceptionLevel.PROCESS_ERROR,
         )

@@ -1,6 +1,7 @@
 """Scaling stack tests: scalers, watcher, auto-scaler, resource
 optimizer (SURVEY §2.2 scalers/watchers/auto-scaler/optimizer)."""
 
+import json
 import sys
 import time
 
@@ -336,6 +337,85 @@ class TestK8sClientContract:
             client.create_scaleplan(
                 scaleplan_from_plan(ScalePlan(), "j", 1)
             )
+
+
+class TestDefaultTransportLiveHTTP:
+    """Exercise ``default_transport`` (the urllib path a real cluster
+    uses) against a live in-test HTTP server: verbs, paths, auth header,
+    and the CRD PATCH content-type (merge-patch, not application/json —
+    a real apiserver 415s the latter on custom resources)."""
+
+    @pytest.fixture()
+    def server(self):
+        import http.server
+        import threading
+
+        seen = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _respond(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                seen.append({
+                    "method": self.command,
+                    "path": self.path,
+                    "content_type": self.headers.get("Content-Type"),
+                    "auth": self.headers.get("Authorization"),
+                    "body": json.loads(body) if body else None,
+                })
+                payload = json.dumps({"ok": True, "items": []}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_PATCH = _respond
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            yield f"http://127.0.0.1:{httpd.server_address[1]}", seen
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_post_and_patch_over_live_server(self, server):
+        from dlrover_tpu.master.crd import scaleplan_from_plan
+        from dlrover_tpu.master.k8s import (
+            K8sElasticJobClient,
+            default_transport,
+        )
+
+        url, seen = server
+        client = K8sElasticJobClient(
+            default_transport(url, token="sekrit"), namespace="ml"
+        )
+        client.create_scaleplan(
+            scaleplan_from_plan(
+                ScalePlan(launch_nodes=[Node("worker", 1)]), "job-h", 3
+            )
+        )
+        client.update_scaleplan_status("job-h-scaleplan-3", "Succeeded")
+        client.patch_elasticjob_replicas("job-h", {"worker": 2})
+        client.list_scaleplans()
+
+        post, patch_status, patch_job, listed = seen
+        assert post["method"] == "POST"
+        assert post["content_type"] == "application/json"
+        assert post["auth"] == "Bearer sekrit"
+        assert post["body"]["kind"] == "ScalePlan"
+        assert patch_status["method"] == "PATCH"
+        assert patch_status["content_type"] == "application/merge-patch+json"
+        assert patch_status["path"].endswith("/status")
+        assert patch_job["content_type"] == "application/merge-patch+json"
+        assert patch_job["body"]["spec"]["replicaSpecs"]["worker"][
+            "replicas"] == 2
+        assert listed["method"] == "GET"
 
 
 class TestActorScaler:
