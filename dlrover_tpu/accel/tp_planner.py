@@ -11,15 +11,20 @@ abstract trace — no solver required:
 1. a flax method interceptor records every projection call (path, in/out
    widths, and the *identity* of its input tracer, in call order);
 2. classification per scope:
+   - sibling projections sharing one input tracer form column-parallel
+     branch groups: >=2 same-input squares (MHA q/k/v), and twin
+     contractions of identical out width plus their lone square sibling
+     (GQA q/k/v — k/v are contractions, out = kv_heads x head_dim <
+     d_model, that the width rule alone would wrongly mark row-parallel
+     and split the Megatron col->row pair). Singleton contractions
+     sharing an input (a d->1 value head next to the LM head) stay with
+     the width rule;
    - expansion kernels (out > in) are column-parallel — shard the
      output dim;
    - contraction kernels (in > out) are row-parallel — shard the input
      dim (the Megatron pair: no resharding between them);
-   - square kernels are disambiguated by dataflow: siblings sharing one
-     input tracer (q/k/v projections read the same normed hidden state)
-     are a column-parallel branch group; a later square kernel in a
-     scope that already has column shards is its row-parallel closer
-     (the attention output projection);
+   - a square kernel in a scope that already has column shards is
+     their row-parallel closer (the attention output projection);
 3. the result is a :class:`ShardingRegistry` whose rules name the
    ``mlp`` logical axis on those dims (mapped to the ``tensor`` mesh
    axis by the sharding rules), stacked on the FSDP defaults.
@@ -50,11 +55,19 @@ class _ProjRecord:
 
 def _trace_projections(module, rng, *example_args) -> List[_ProjRecord]:
     """One abstract init trace; record every call that looks like a
-    projection (last-dim-to-last-dim map on a >=2D input)."""
+    projection (last-dim-to-last-dim map on a >=2D input) on a module
+    that actually owns a ``kernel`` param — LayerNorm/RMSNorm are
+    width-preserving ``__call__``s too, but they have scale/bias, not a
+    kernel, and must not participate in col/row pairing."""
     import flax.linen as nn
 
     records: List[_ProjRecord] = []
     counter = [0]
+    # Input tracers are kept alive for the duration of the trace so
+    # ``id(x)`` cannot be reused by the allocator after a tracer is
+    # collected mid-trace (two different inputs colliding on one id
+    # would merge unrelated records into a false sibling group).
+    live_inputs: List[Any] = []
 
     def interceptor(next_fn, args, kwargs, context):
         out = next_fn(*args, **kwargs)
@@ -68,7 +81,9 @@ def _trace_projections(module, rng, *example_args) -> List[_ProjRecord]:
                 and getattr(y, "ndim", 0) >= 2
                 and x.shape[:-1] == y.shape[:-1]
                 and context.module.path
+                and context.module.has_variable("params", "kernel")
             ):
+                live_inputs.append(x)
                 records.append(_ProjRecord(
                     path=tuple(context.module.path),
                     in_features=int(x.shape[-1]),
@@ -86,6 +101,7 @@ def _trace_projections(module, rng, *example_args) -> List[_ProjRecord]:
             return module.init(rng, *example_args)
 
     jax.eval_shape(trace)
+    del live_inputs
     return records
 
 
@@ -97,17 +113,45 @@ def _classify(records: List[_ProjRecord]):
 
     for scope, rs in by_scope.items():
         rs.sort(key=lambda r: r.order)
-        # dataflow: same-input square siblings = column branch group
+        # dataflow first. Two same-input sibling shapes are column
+        # branch groups:
+        #   - >=2 squares reading one tracer (MHA q/k/v);
+        #   - twin contractions with identical out widths (GQA/cross-
+        #     attention k/v: out = kv_heads x head_dim < d_model — the
+        #     width rule alone would wrongly mark them row-parallel,
+        #     but they must shard over kv heads to compose with head-
+        #     sharded attention), plus their lone square sibling (the
+        #     GQA q).
+        # A *singleton* contraction sharing an input (e.g. a d->1 value
+        # head next to the LM head) is NOT pulled into the group — it
+        # stays with the width rule, whose row placement never shards
+        # the tiny output dim.
         by_input: Dict[int, List[_ProjRecord]] = defaultdict(list)
         for r in rs:
             by_input[r.input_id].append(r)
         for group in by_input.values():
+            if len(group) < 2:
+                continue
             squares = [
                 g for g in group if g.in_features == g.out_features
+            ]
+            contractions = [
+                g for g in group if g.out_features < g.in_features
+            ]
+            widths = defaultdict(int)
+            for g in contractions:
+                widths[g.out_features] += 1
+            twins = [
+                g for g in contractions if widths[g.out_features] >= 2
             ]
             if len(squares) >= 2:
                 for g in squares:
                     g.role = "col"
+            if twins:
+                for g in twins:
+                    g.role = "col"
+                if len(squares) == 1:
+                    squares[0].role = "col"
         for r in rs:
             if r.role is not None:
                 continue

@@ -33,6 +33,7 @@ def default_transport(
 ) -> Transport:
     """urllib transport for a real apiserver (bearer-token auth, the
     in-cluster service-account pattern)."""
+    import urllib.error
     import urllib.request
 
     def send(method: str, path: str, body: Optional[Dict]):
@@ -50,9 +51,21 @@ def default_transport(
             req.add_header("Content-Type", "application/json")
         if token:
             req.add_header("Authorization", f"Bearer {token}")
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            payload = resp.read()
-            return resp.status, (json.loads(payload) if payload else {})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                payload = resp.read()
+                return resp.status, (
+                    json.loads(payload) if payload else {}
+                )
+        except urllib.error.HTTPError as e:
+            # urlopen raises on >=300; the client's error handling wants
+            # (status, parsed apiserver Status body), not an exception.
+            payload = e.read()
+            try:
+                body = json.loads(payload) if payload else {}
+            except ValueError:
+                body = {"raw": payload.decode(errors="replace")}
+            return e.code, body
 
     return send
 

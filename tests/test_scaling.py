@@ -363,6 +363,20 @@ class TestDefaultTransportLiveHTTP:
                     "auth": self.headers.get("Authorization"),
                     "body": json.loads(body) if body else None,
                 })
+                if "conflict" in self.path:
+                    payload = json.dumps(
+                        {"reason": "AlreadyExists", "code": 409}
+                    ).encode()
+                    self.send_response(409)
+                    self.send_header(
+                        "Content-Type", "application/json"
+                    )
+                    self.send_header(
+                        "Content-Length", str(len(payload))
+                    )
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 payload = json.dumps({"ok": True, "items": []}).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -416,6 +430,20 @@ class TestDefaultTransportLiveHTTP:
         assert patch_job["body"]["spec"]["replicaSpecs"]["worker"][
             "replicas"] == 2
         assert listed["method"] == "GET"
+
+    def test_non_2xx_surfaces_as_status_not_exception(self, server):
+        """urlopen raises HTTPError on >=300; the transport must turn
+        that back into (status, parsed apiserver Status body) so the
+        client's error branches actually fire."""
+        from dlrover_tpu.master.k8s import (
+            K8sElasticJobClient,
+            default_transport,
+        )
+
+        url, seen = server
+        client = K8sElasticJobClient(default_transport(url))
+        with pytest.raises(RuntimeError, match="409"):
+            client.update_scaleplan_status("conflict-plan", "Succeeded")
 
 
 class TestActorScaler:
