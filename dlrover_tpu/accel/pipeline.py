@@ -25,9 +25,9 @@ Two schedules:
   ``C`` passes around the ring. Ticks: ``C*M + P - 1`` at ``1/C`` the
   per-tick work — the drain bubble shrinks from ``(P-1)`` full-stage
   ticks to ``(P-1)`` chunk ticks, cutting the bubble fraction ~``C``×.
-  Per-tick chunk selection is a one-hot contraction over the local
-  ``C`` dim of the weight bank (reads the same bytes/tick as GPipe —
-  each device touches its resident layers once per full pass).
+  Per-tick chunk selection is a per-stage dynamic index (batched
+  gather) into the local ``C`` dim of the weight bank, reading only
+  the selected ``1/C`` of the resident layers each tick.
 
 Both schedules carry an auxiliary scalar (MoE load-balance loss)
 alongside the activations, so expert-parallel MoE composes with pipeline
@@ -245,10 +245,13 @@ class CircularPipeline(nn.Module):
 
     The per-tick weight for stage position ``p`` is chunk
     ``c = clip((t-p)//M, 0, C-1)``, selected from the ``[P, C, ...]``
-    weight bank by a one-hot contraction — per tick each device reads
-    ``1/C`` of its resident layers, so total weight traffic per full
-    pass equals GPipe's. Gradients scatter back through the same
-    contraction.
+    weight bank by a per-stage dynamic index (batched gather) — per
+    tick each device reads ``1/C`` of its resident layers, so weight
+    traffic per full pass is ``(C*M+P-1)/(C*(M+P-1))`` of GPipe's
+    (slightly *below* 1 for C>1; measured on-chip — see the table in
+    ``docs/pipeline_schedules.md``; ``tests/test_pipeline.py``
+    pins per-tick FLOPs at 1/C and slice/onehot bit-exactness).
+    Gradients scatter-add back into just the selected chunk.
 
     Parity: Megatron interleaved 1F1B / reference ``PipelineStage.py``
     virtual stages; the spatial-SPMD formulation follows the praxis
@@ -261,9 +264,20 @@ class CircularPipeline(nn.Module):
     num_repeats: int                       # C (chunks per device)
     num_microbatches: int = 0              # M >= P
     carry_axes: Tuple = ("batch", None, None)
+    # Chunk-selection lowering. "slice" (default) is the per-stage
+    # dynamic index / gather: 1/C of the bank per tick. "onehot" is the
+    # dense contraction kept ONLY as a measurement baseline — it reads
+    # the entire resident bank every tick (C x the weight traffic; see
+    # docs/pipeline_schedules.md for the on-chip numbers).
+    chunk_select: str = "slice"
 
     @nn.compact
     def __call__(self, x):
+        if self.chunk_select not in ("slice", "onehot"):
+            raise ValueError(
+                f"chunk_select must be 'slice' or 'onehot', got "
+                f"{self.chunk_select!r}"
+            )
         p_ = self.num_stages
         c_ = self.num_repeats
         m = self.num_microbatches or p_
@@ -334,15 +348,33 @@ class CircularPipeline(nn.Module):
             )
 
             # --- select chunk weights + compute all stages ---
+            # Per-stage dynamic index into the local C dim: a batched
+            # gather that reads ONLY the selected chunk — 1/C of the
+            # resident bank per tick. (A one-hot contraction would be
+            # numerically identical but touches every chunk every tick:
+            # C x the HBM weight traffic, erasing the bubble win at
+            # memory-bound microbatch sizes. Its transpose also writes
+            # the full-bank gradient per tick; the gather's transpose is
+            # a scatter-add into just the selected chunk.)
             c_per = jnp.clip((t - iota_p) // m, 0, c_ - 1)
-            onehot = jax.nn.one_hot(c_per, c_, dtype=state.dtype)
 
-            selected = jax.tree_util.tree_map(
-                lambda w: jnp.einsum(
-                    "pc...,pc->p...", w, onehot.astype(w.dtype)
-                ),
-                bank,
-            )
+            if self.chunk_select == "onehot":
+                onehot = jax.nn.one_hot(c_per, c_, dtype=state.dtype)
+                selected = jax.tree_util.tree_map(
+                    lambda w: jnp.einsum(
+                        "pc...,pc->p...", w, onehot.astype(w.dtype)
+                    ),
+                    bank,
+                )
+            else:
+                selected = jax.tree_util.tree_map(
+                    lambda w: jax.vmap(
+                        lambda wp, cp: lax.dynamic_index_in_dim(
+                            wp, cp, axis=0, keepdims=False
+                        )
+                    )(w, c_per),
+                    bank,
+                )
             y, chunk_aux = jax.vmap(apply_chunk)(selected, state)
             aux_y = aux_state + chunk_aux
 

@@ -57,6 +57,16 @@ _COLL_LAT = 5e-6
 # latency for mesh axes whose neighbours live on different hosts.
 _DCN_BW = 2.5e9
 _DCN_LAT = 100e-6
+# HBM bandwidth (bytes/s), v5e spec sheet. Used for the pipeline
+# weight-traffic floor: each schedule tick re-reads the device's
+# resident stage weights, so a pipelined step cannot run faster than
+# ticks x resident-bytes / HBM — the term that stops the search from
+# picking deep pipelines at memory-bound (small-batch) operating points
+# where the bubble model alone looks fine. The circular schedule with
+# the default "slice" chunk selection has the same per-pass weight
+# traffic as GPipe (measured on-chip, docs/pipeline_schedules.md), so
+# one term covers both.
+_HBM_BW = 8.19e11
 
 
 def _axis_links(spec, devices_per_host: int):
@@ -155,6 +165,8 @@ class CostEstimate:
                              # stage transfers: on the activation critical
                              # path, largely exposed
     bubble: float            # pipeline multiplier on compute, >= 1
+    hbm_s: float = 0.0       # HBM weight-traffic floor (pipeline ticks
+                             # re-read resident stage weights)
 
     @property
     def total_bytes(self) -> float:
@@ -166,7 +178,10 @@ class CostEstimate:
 
     @property
     def step_s(self) -> float:
-        return (self.compute_s * self.bubble
+        # Roofline: the pipelined compute cannot beat its weight-traffic
+        # floor (hbm_s is 0 for non-pipeline specs, where the single
+        # fwd+bwd weight pass is inside _MFU_DERATE).
+        return (max(self.compute_s * self.bubble, self.hbm_s)
                 + 0.15 * self.comm_overlap_s
                 + 0.5 * self.comm_critical_s)
 
@@ -244,6 +259,7 @@ def estimate(
     microbatches: int = 0,
     devices_per_host: int = 0,
     dcn_bw: float = _DCN_BW,
+    hbm_bw: float = _HBM_BW,
 ) -> CostEstimate:
     """Analytic memory + roofline cost for one candidate spec.
 
@@ -339,6 +355,7 @@ def estimate(
                       * p.moe_top_k * (spec.expert - 1) / spec.expert
                       / bw("expert"))
         comm_cp_s += 4.0 * layers_dev * lat("expert")
+    hbm_s = 0.0
     if spec.pipe > 1:
         # stage-boundary activation transfers: m microbatches cross each
         # boundary fwd + bwd (one permute per schedule tick each way) —
@@ -346,11 +363,22 @@ def estimate(
         # DCN.
         comm_cp_s += 2.0 * tokens_dev * p.d_model * dtype_b / bw("pipe")
         comm_cp_s += 2.0 * (m + spec.pipe - 1) * lat("pipe")
+        # Weight-traffic floor: every tick each device re-reads its
+        # resident stage weights (fwd scan), and the backward replay
+        # reads them again plus the grad-bank read-modify-write — ~3
+        # resident passes per tick over (M+P-1) ticks. A non-pipelined
+        # step reads weights once fwd + twice bwd regardless of batch,
+        # so the pipeline's *extra* traffic scales with the microbatch
+        # count — this is what sinks deep pipelines at small batch.
+        resident_b = dtype_b * p.param_count / (
+            spec.pipe * spec.tensor * spec.expert
+        )
+        hbm_s = 3.0 * (m + spec.pipe - 1) * resident_b / hbm_bw
 
     return CostEstimate(
         state_bytes=state_b, grad_bytes=grad_b, act_bytes=act_b,
         compute_s=compute_s, comm_overlap_s=comm_ov_s,
-        comm_critical_s=comm_cp_s, bubble=bubble,
+        comm_critical_s=comm_cp_s, bubble=bubble, hbm_s=hbm_s,
     )
 
 
