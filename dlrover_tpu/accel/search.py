@@ -287,10 +287,12 @@ def estimate(
     act_b = (
         layers_dev * _act_floats_per_token_layer(p) * tokens_dev * dtype_b
     )
-    # fp32 loss path: logits + logsumexp live once, sharded over tensor
-    # (vocab axis) — dominant for small models, real for all.
+    # fp32 loss path: logits + logsumexp live once, sharded over the
+    # vocab axis (tensor x pipe — see logical_rules) — dominant for
+    # small models, real for all.
     if p.vocab_size:
-        act_b += tokens_dev * p.vocab_size / spec.tensor * (4.0 + dtype_b)
+        act_b += (tokens_dev * p.vocab_size / (spec.tensor * spec.pipe)
+                  * (4.0 + dtype_b))
 
     # --- compute ---
     flops_step = p.flops_per_token * batch_size * max(p.seq_len, 1)
@@ -370,7 +372,11 @@ def estimate(
         # step reads weights once fwd + twice bwd regardless of batch,
         # so the pipeline's *extra* traffic scales with the microbatch
         # count — this is what sinks deep pipelines at small batch.
-        resident_b = dtype_b * p.param_count / (
+        # Only the stage-bank layers re-read per tick; the embedding and
+        # LM head (~2*V*d) run once per step outside the pipe.
+        vocab_params = 2.0 * p.vocab_size * p.d_model
+        layer_params = max(p.param_count - vocab_params, 0.0)
+        resident_b = dtype_b * layer_params / (
             spec.pipe * spec.tensor * spec.expert
         )
         hbm_s = 3.0 * (m + spec.pipe - 1) * resident_b / hbm_bw
@@ -421,7 +427,11 @@ def enumerate_specs(
                 continue
             if p.ff_dim and p.ff_dim % tensor:
                 continue
-            if p.vocab_size and p.vocab_size % tensor:
+        if tensor * pipe > 1 and p.vocab_size:
+            # vocab shards over tensor x pipe (logical_rules): the dim
+            # must divide evenly or materialization fails. Models with
+            # awkward vocabs should pad (the standard TPU practice).
+            if p.vocab_size % (tensor * pipe):
                 continue
         if seq > 1:
             if not p.supports_ring or not p.seq_len:

@@ -39,13 +39,23 @@ def logical_rules(
     same degrees they built the mesh with.
     """
     batch_axes = [a for a, n in (("data", data), ("fsdp", fsdp)) if n > 1]
+    # Vocab shards over tensor AND pipe: under pipeline parallelism the
+    # embedding/LM-head live outside the stage bank, and without this
+    # every pipe device would replicate both vocab x d_model tensors —
+    # the two largest in the model. Sharding vocab over the pipe axis is
+    # the SPMD analog of the reference's first/last-stage placement
+    # (PipelineStage.py graph-split stages): per-device vocab memory is
+    # V/(tensor*pipe), balanced across stages instead of dumped on two.
+    vocab_axes = [
+        a for a, n in (("tensor", tensor), ("pipe", pipe)) if n > 1
+    ]
     rules: List[Tuple[str, Any]] = [
         ("batch", tuple(batch_axes) if batch_axes else None),
         ("layers", None),
         ("embed", "fsdp" if fsdp > 1 else None),
         ("heads", "tensor" if tensor > 1 else None),
         ("mlp", "tensor" if tensor > 1 else None),
-        ("vocab", "tensor" if tensor > 1 else None),
+        ("vocab", tuple(vocab_axes) if vocab_axes else None),
         ("kv", None),
         ("seq", "seq" if seq > 1 else None),
         ("expert", "expert" if expert > 1 else None),

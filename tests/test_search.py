@@ -166,9 +166,11 @@ class TestChoices:
         )
         assert no_pipe.hbm_s == 0.0
         assert pipe.hbm_s > 0.0
-        # ticks x resident bytes / HBM_BW, resident = params/pipe in bf16
+        # ticks x resident bytes / HBM_BW; resident = stage-bank layer
+        # params (vocab tensors run once per step outside the pipe)
         m = 4  # _pipe_microbatches(4, 8, 2): per-shard batch 4 -> M=4
-        resident = 2.0 * p.param_count / 4
+        layer_params = p.param_count - 2.0 * p.vocab_size * p.d_model
+        resident = 2.0 * layer_params / 4
         assert pipe.hbm_s == pytest.approx(
             3.0 * (m + 4 - 1) * resident / 8.19e11, rel=1e-6
         )
