@@ -264,6 +264,21 @@ def section_medium(peak):
     row, result, state, _ = build_and_time(cfg, 8, 6, peak=peak)
     del result, state
     log(f"bench[medium]: {row}")
+
+    # ---- AQT int8 MLP matmuls (VERDICT r5 #3): measured uplift ----
+    try:
+        qcfg = dataclasses.replace(cfg, mlp_precision="int8")
+        qrow, result, state, _ = build_and_time(qcfg, 8, 6, peak=peak)
+        del result, state
+        row["int8_step_time_ms"] = qrow["step_time_ms"]
+        row["int8_tokens_per_s"] = qrow["tokens_per_s"]
+        row["int8_speedup"] = round(
+            row["step_time_ms"] / qrow["step_time_ms"], 3
+        )
+        log(f"bench[medium]: int8 MLP {qrow['step_time_ms']}ms "
+            f"({row['int8_speedup']}x vs bf16)")
+    except Exception as e:
+        log(f"bench[medium]: int8 row skipped ({e})")
     return row
 
 

@@ -222,6 +222,7 @@ def auto_accelerate(
     registry=None,
     search_top_k: int = 4,
     offload_optimizer: bool = False,
+    precision: str = "bf16",
 ) -> AccelerateResult:
     """Analyze → choose strategy → build sharded state + train step.
 
@@ -234,13 +235,35 @@ def auto_accelerate(
     excludes it for plain ones; True enables planner-driven TP for
     plain models; False forbids tensor candidates outright.
     ``offload_optimizer=True`` keeps optimizer state at rest in host
-    memory (``optim/offload.py``).
+    memory (``optim/offload.py``). ``precision="int8"`` switches the
+    model's MLP contractions to AQT-style quantized int8 matmuls
+    (``ops/quantized.py``; the TPU analog of the reference's fp8
+    training, ``amp_optimization.py:193``) — requires a model whose
+    config carries ``mlp_precision``.
     """
     import jax
 
     devices = list(devices if devices is not None else jax.devices())
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     n = len(devices)
+
+    if precision not in ("bf16", "int8"):
+        raise ValueError(f"precision must be 'bf16' or 'int8', got "
+                         f"{precision!r}")
+    if precision == "int8":
+        import dataclasses as _dcq
+
+        cfg_q = getattr(module, "cfg", None)
+        if cfg_q is None or not hasattr(cfg_q, "mlp_precision"):
+            raise ValueError(
+                "precision='int8' needs a model config with "
+                "mlp_precision (GPTConfig/LlamaConfig)"
+            )
+        if cfg_q.mlp_precision != "int8":
+            module = type(module)(
+                cfg=_dcq.replace(cfg_q, mlp_precision="int8")
+            )
+            logger.info("int8 MLP precision enabled (AQT-style)")
 
     def build(sp: ParallelSpec, mod=None) -> AccelerateResult:
         from jax.sharding import NamedSharding, PartitionSpec as P

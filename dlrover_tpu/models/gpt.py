@@ -48,6 +48,11 @@ class GPTConfig:
     attn_block_q: int = 512  # pallas kernel tile sizes
     attn_block_k: int = 512
     dropout: float = 0.0
+    # "bf16" | "int8": int8 runs the MLP contractions as AQT-style
+    # dynamic-quantized int8 matmuls (numerics-parity tested; currently
+    # ~0.93x on v5e via this XLA build, which does not engage the
+    # double-rate int8 MXU mode — see ops/quantized.py for measurements).
+    mlp_precision: str = "bf16"
     # MoE (0 = dense MLP). With num_experts > 0 every block's FFN becomes
     # an expert-parallel MoEMLP and __call__ returns (logits, aux_loss).
     num_experts: int = 0
@@ -99,6 +104,13 @@ class GPTConfig:
         per_layer = 4 * d * d + mlp + 4 * d  # qkvo + ffn/moe + ln
         return v * d + self.max_seq_len * d + l * per_layer + d
 
+    def vocab_param_count(self) -> int:
+        """Params living outside the layer stack (embedding + position
+        table; the LM head is *tied* to the embedding, GPT-2 style) —
+        what the pipeline cost model must not count as per-tick
+        resident weights."""
+        return self.vocab_size * self.d_model + self.max_seq_len * self.d_model
+
     @staticmethod
     def tiny():
         return GPTConfig(vocab_size=256, max_seq_len=64, num_layers=2,
@@ -111,18 +123,29 @@ class GPTConfig:
                          num_heads=25, d_model=1600, remat=True)
 
 
-def _dense(features, name, kernel_axes, cfg: GPTConfig):
+def _dense(features, name, kernel_axes, cfg: GPTConfig,
+           quant: bool = False):
+    kernel_init = nn.with_logical_partitioning(
+        nn.initializers.normal(0.02), kernel_axes
+    )
+    bias_init = nn.with_logical_partitioning(
+        nn.initializers.zeros_init(), (kernel_axes[-1],)
+    )
+    if quant and cfg.mlp_precision == "int8":
+        from dlrover_tpu.ops.quantized import Int8Dense
+
+        return Int8Dense(
+            features, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, kernel_init=kernel_init,
+            bias_init=bias_init, name=name,
+        )
     return nn.Dense(
         features,
         use_bias=True,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
-        kernel_init=nn.with_logical_partitioning(
-            nn.initializers.normal(0.02), kernel_axes
-        ),
-        bias_init=nn.with_logical_partitioning(
-            nn.initializers.zeros_init(), (kernel_axes[-1],)
-        ),
+        kernel_init=kernel_init,
+        bias_init=bias_init,
         name=name,
     )
 
@@ -208,10 +231,10 @@ class Block(nn.Module):
             x = x + y
             x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
             return x, aux
-        y = _dense(cfg.ff_dim, "up", ("embed", "mlp"), cfg)(y)
+        y = _dense(cfg.ff_dim, "up", ("embed", "mlp"), cfg, quant=True)(y)
         y = nn.gelu(y)
         y = nn.with_logical_constraint(y, ("batch", "seq", "mlp"))
-        x = x + _dense(d, "down", ("mlp", "embed"), cfg)(y)
+        x = x + _dense(d, "down", ("mlp", "embed"), cfg, quant=True)(y)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         return x, None
 

@@ -60,6 +60,10 @@ class LlamaConfig:
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # "bf16" | "int8": AQT-style dynamic-quantized int8 MLP matmuls
+    # (ops/quantized.py, same contract + measured caveats as
+    # GPTConfig.mlp_precision).
+    mlp_precision: str = "bf16"
     # Pipeline parallelism (0 = off): same contract as GPTConfig —
     # stages run as GPipe (repeats == 1) or the circular/interleaved
     # schedule (repeats > 1); pair with ParallelSpec(pipe=stages).
@@ -102,6 +106,11 @@ class LlamaConfig:
         per_layer = d * d + 2 * d * kv + d * d + 3 * d * f + 2 * d
         return 2 * v * d + l * per_layer + d
 
+    def vocab_param_count(self) -> int:
+        """Embedding + *untied* LM head (LLaMA convention): the params
+        outside the layer stack for the pipeline cost model."""
+        return 2 * self.vocab_size * self.d_model
+
     def flops_per_token(self) -> float:
         attn = 12 * self.num_layers * self.d_model * self.max_seq_len
         return 6 * self.param_count() + attn
@@ -124,15 +133,25 @@ def _rms_norm(name: str, cfg: LlamaConfig):
     )
 
 
-def _dense(features, name, kernel_axes, cfg: LlamaConfig):
+def _dense(features, name, kernel_axes, cfg: LlamaConfig,
+           quant: bool = False):
+    kernel_init = nn.with_logical_partitioning(
+        nn.initializers.normal(0.02), kernel_axes
+    )
+    if quant and cfg.mlp_precision == "int8":
+        from dlrover_tpu.ops.quantized import Int8Dense
+
+        return Int8Dense(
+            features, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, kernel_init=kernel_init,
+            name=name,
+        )
     return nn.Dense(
         features,
         use_bias=False,  # LLaMA projections carry no biases
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
-        kernel_init=nn.with_logical_partitioning(
-            nn.initializers.normal(0.02), kernel_axes
-        ),
+        kernel_init=kernel_init,
         name=name,
     )
 
@@ -201,11 +220,14 @@ class LlamaBlock(nn.Module):
             x = x + y
             x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
             return x, aux
-        gate = _dense(cfg.ff_dim, "gate_proj", ("embed", "mlp"), cfg)(y)
-        up = _dense(cfg.ff_dim, "up_proj", ("embed", "mlp"), cfg)(y)
+        gate = _dense(cfg.ff_dim, "gate_proj", ("embed", "mlp"), cfg,
+                      quant=True)(y)
+        up = _dense(cfg.ff_dim, "up_proj", ("embed", "mlp"), cfg,
+                    quant=True)(y)
         y = nn.silu(gate) * up
         y = nn.with_logical_constraint(y, ("batch", "seq", "mlp"))
-        x = x + _dense(d, "down_proj", ("mlp", "embed"), cfg)(y)
+        x = x + _dense(d, "down_proj", ("mlp", "embed"), cfg,
+                       quant=True)(y)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         return x, None
 

@@ -254,3 +254,133 @@ class TestInt8WeightOnly:
         assert isinstance(q["w"], QuantizedWeight) is False or True
         q2 = quantize_params(params, min_elems=256)
         assert isinstance(q2["w"], QuantizedWeight)
+
+
+class TestInt8Training:
+    """AQT-style int8 training matmuls (VERDICT r4 #3 — the TPU analog
+    of the reference's fp8 training, amp_optimization.py:193)."""
+
+    def test_int8_dot_close_to_exact(self):
+        from dlrover_tpu.ops.quantized import int8_dot
+
+        k = jax.random.PRNGKey(0)
+        x = jax.random.normal(k, (4, 16, 64), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (64, 128)) * 0.05
+        exact = x @ w
+        q = int8_dot(x, w)
+        err = jnp.abs(q - exact).max() / jnp.abs(exact).max()
+        assert float(err) < 0.02, float(err)
+
+    def test_backward_is_straight_through(self):
+        """Grads equal the exact bf16 product grads (not quantized):
+        quantization noise is a forward-only perturbation."""
+        from dlrover_tpu.ops.quantized import int8_dot
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 32), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (32, 16)) * 0.1
+
+        gq = jax.grad(lambda x, w: int8_dot(x, w).sum(), argnums=(0, 1))
+        ge = jax.grad(lambda x, w: (x @ w).sum(), argnums=(0, 1))
+        for a, b in zip(gq(x, w), ge(x, w)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+            )
+
+    def test_int8_training_tracks_bf16(self):
+        """Tiny GPT: 10 steps of int8-MLP training must track the bf16
+        run (loss within a few percent — the AQT promise)."""
+        import dataclasses
+        import optax
+        from dlrover_tpu.accel import auto_accelerate, ParallelSpec
+        from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+
+        def run(precision):
+            cfg = dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(2), (8, 32), 0, cfg.vocab_size
+            )
+            res = auto_accelerate(
+                GPT(cfg), optax.adamw(1e-2), tokens,
+                lambda mod, p, b: loss_fn(
+                    mod.apply({"params": p}, b), b
+                ),
+                spec=ParallelSpec(), precision=precision,
+            )
+            state = res.state
+            batch = jax.device_put(tokens, res.batch_sharding)
+            losses = []
+            for _ in range(10):
+                state, m = res.train_step(state, batch)
+                losses.append(float(m["loss"]))
+            return losses
+
+        bf16 = run("bf16")
+        int8 = run("int8")
+        # same trajectory within a few percent at every step
+        for a, b in zip(int8, bf16):
+            assert abs(a - b) / b < 0.05, (int8, bf16)
+        assert int8[-1] < int8[0] * 0.8  # actually learning
+
+    def test_int8_param_tree_identical(self):
+        """Precision is a pure compute swap: the param tree (names,
+        shapes, logical axes) matches the bf16 model, so sharding
+        rules, FSDP, TP and checkpoints are unaffected."""
+        import dataclasses
+        from dlrover_tpu.models.gpt import GPT, GPTConfig
+
+        cfg = GPTConfig.tiny()
+        qcfg = dataclasses.replace(cfg, mlp_precision="int8")
+        tokens = jnp.zeros((2, 8), jnp.int32)
+        a = jax.eval_shape(
+            lambda: GPT(cfg).init(jax.random.PRNGKey(0), tokens)
+        )
+        b = jax.eval_shape(
+            lambda: GPT(qcfg).init(jax.random.PRNGKey(0), tokens)
+        )
+        ta = jax.tree_util.tree_structure(a)
+        tb = jax.tree_util.tree_structure(b)
+        assert ta == tb
+        for la, lb in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        ):
+            assert la.shape == lb.shape and la.dtype == lb.dtype
+
+    def test_int8_composes_with_tp_fsdp(self):
+        """int8 MLP under dp x fsdp x tp trains and the kernels stay
+        sharded as planned."""
+        import dataclasses
+        import optax
+        from dlrover_tpu.accel import auto_accelerate, ParallelSpec
+        from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+
+        cfg = dataclasses.replace(
+            GPTConfig.tiny(), dtype=jnp.float32, num_heads=4
+        )
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(2), (8, 32), 0, cfg.vocab_size
+        )
+        res = auto_accelerate(
+            GPT(cfg), optax.adamw(1e-2), tokens,
+            lambda mod, p, b: loss_fn(mod.apply({"params": p}, b), b),
+            spec=ParallelSpec(data=2, fsdp=2, tensor=2),
+            precision="int8",
+        )
+        state, m = res.train_step(
+            res.state, jax.device_put(tokens, res.batch_sharding)
+        )
+        assert np.isfinite(float(m["loss"]))
+        up = state["params"]["blocks"]["up"]["kernel"]
+        assert (up.addressable_shards[0].data.shape[-1]
+                == up.shape[-1] // 2)
+
+    def test_plain_model_rejected(self):
+        import flax.linen as nn
+        import optax
+        from dlrover_tpu.accel import auto_accelerate
+
+        with pytest.raises(ValueError, match="mlp_precision"):
+            auto_accelerate(
+                nn.Dense(4), optax.sgd(0.1), jnp.zeros((2, 4)),
+                lambda m, p, b: m.apply({"params": p}, b).sum(),
+                precision="int8",
+            )

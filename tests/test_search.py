@@ -167,9 +167,10 @@ class TestChoices:
         assert no_pipe.hbm_s == 0.0
         assert pipe.hbm_s > 0.0
         # ticks x resident bytes / HBM_BW; resident = stage-bank layer
-        # params (vocab tensors run once per step outside the pipe)
+        # params. GPT ties its LM head, so the out-of-pipe vocab params
+        # are V*d + seq*d (cfg.vocab_param_count), not 2*V*d.
         m = 4  # _pipe_microbatches(4, 8, 2): per-shard batch 4 -> M=4
-        layer_params = p.param_count - 2.0 * p.vocab_size * p.d_model
+        layer_params = p.param_count - cfg.vocab_param_count()
         resident = 2.0 * layer_params / 4
         assert pipe.hbm_s == pytest.approx(
             3.0 * (m + 4 - 1) * resident / 8.19e11, rel=1e-6
