@@ -251,8 +251,6 @@ def auto_accelerate(
         raise ValueError(f"precision must be 'bf16' or 'int8', got "
                          f"{precision!r}")
     if precision == "int8":
-        import dataclasses as _dcq
-
         cfg_q = getattr(module, "cfg", None)
         if cfg_q is None or not hasattr(cfg_q, "mlp_precision"):
             raise ValueError(
@@ -260,8 +258,9 @@ def auto_accelerate(
                 "mlp_precision (GPTConfig/LlamaConfig)"
             )
         if cfg_q.mlp_precision != "int8":
-            module = type(module)(
-                cfg=_dcq.replace(cfg_q, mlp_precision="int8")
+            # clone() keeps any other module attributes intact
+            module = module.clone(
+                cfg=dataclasses.replace(cfg_q, mlp_precision="int8")
             )
             logger.info("int8 MLP precision enabled (AQT-style)")
 

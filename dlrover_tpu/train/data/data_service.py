@@ -7,10 +7,13 @@ Capability parity with the reference's coworker stack
 processes must not burn their step budget on tokenization/decode —
 TPU-VM hosts have weak CPUs relative to the chips, so the capability
 matters *more* here, not less. Preprocessing runs in dedicated worker
-processes (same host or, with the queues' socket transport, other
-hosts); finished batches travel through a fixed-slot shared-memory ring
-with queue-based flow control, so the training process pays one memcpy
-per batch and zero pickling of array payloads.
+processes; finished batches travel through a fixed-slot shared-memory
+ring with queue-based flow control, so the training process pays one
+memcpy per batch and zero pickling of array payloads. Coworkers on
+OTHER hosts connect over TCP (``listen_remote`` +
+``remote_coworker_main``): tasks go out pickled, batches come back as
+length-prefixed raw tensor frames and land in the same ring, so the
+consumer API is source-agnostic.
 
 Pieces:
 
@@ -24,8 +27,11 @@ Pieces:
 
 import multiprocessing as mp
 import pickle
+import socket
+import struct
+import threading
 import time
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -33,7 +39,61 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.comm import SharedQueue
 from dlrover_tpu.common.shared_memory import SharedMemory
 
-__all__ = ["ShmBatchRing", "CoworkerDataService", "CoworkerTaskError"]
+__all__ = [
+    "ShmBatchRing",
+    "CoworkerDataService",
+    "CoworkerTaskError",
+    "remote_coworker_main",
+]
+
+_LEN = struct.Struct(">Q")
+
+
+def _sock_send_obj(sock: socket.socket, obj: Any):
+    data = pickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _sock_recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _sock_recv_obj(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_sock_recv_exact(sock, _LEN.size))
+    return pickle.loads(_sock_recv_exact(sock, n))
+
+
+def _sock_send_batch(sock: socket.socket, arrays: Dict[str, np.ndarray]):
+    """Length-prefixed tensor frame: a pickled descriptor header (keys,
+    shapes, dtypes, byte counts), then the raw array bytes concatenated
+    — the payload crosses the wire as bytes, never pickled."""
+    desc = []
+    bufs = []
+    for key, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        desc.append((key, a.shape, a.dtype.str, a.nbytes))
+        bufs.append(a)
+    _sock_send_obj(sock, {"desc": desc})
+    for a in bufs:
+        sock.sendall(memoryview(a).cast("B"))
+
+
+def _sock_recv_batch(sock: socket.socket, header: Dict
+                     ) -> Dict[str, np.ndarray]:
+    out = {}
+    for key, shape, dtype, nbytes in header["desc"]:
+        raw = _sock_recv_exact(sock, nbytes)
+        out[key] = np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(
+            shape
+        )
+    return out
 
 
 class CoworkerTaskError(RuntimeError):
@@ -159,6 +219,39 @@ def _worker_main(name: str, slot_bytes: int, num_slots: int, job: str,
     tasks.close()
 
 
+def remote_coworker_main(host: str, port: int, fn_bytes: bytes,
+                         worker_id: int = 0):
+    """Cross-host coworker body (parity: the reference's gRPC coworker,
+    ``atorch/atorch/data/coworker_dataset.py`` +
+    ``service/data_info_service.py``): connect to the consumer's remote
+    listener, then loop task -> preprocess -> tensor frame. Runs on a
+    DIFFERENT host than the training process — only TCP crosses the
+    boundary, no shared memory."""
+    preprocess = pickle.loads(fn_bytes)
+    sock = socket.create_connection((host, port))
+    logger.info("remote coworker %s connected to %s:%s",
+                worker_id, host, port)
+    try:
+        while True:
+            task = _sock_recv_obj(sock)
+            if task is None:
+                break
+            try:
+                arrays = preprocess(task)
+                _sock_send_batch(sock, arrays)
+            except Exception as e:
+                logger.exception(
+                    "remote coworker %s failed on task %r",
+                    worker_id, task,
+                )
+                _sock_send_obj(sock, {
+                    "error": f"{type(e).__name__}: {e}",
+                    "worker": worker_id, "task": repr(task),
+                })
+    finally:
+        sock.close()
+
+
 class CoworkerDataService:
     """Spawn N preprocessing coworkers feeding a shm batch ring.
 
@@ -200,10 +293,136 @@ class CoworkerDataService:
             w.start()
         self._submitted = 0
         self._consumed = 0
+        self._remote_srv: Optional[socket.socket] = None
+        self._remote_conns: List[socket.socket] = []
+        self._remote_lock = threading.Lock()
 
     def submit(self, task: Any):
         self._tasks.put(task)
         self._submitted += 1
+
+    # ------------- cross-host coworkers -------------
+    def listen_remote(self, host: str = "0.0.0.0",
+                      port: int = 0) -> Tuple[str, int]:
+        """Open a TCP listener for coworkers on OTHER hosts
+        (``remote_coworker_main``). Each connection gets a feeder
+        thread that pulls tasks from the same queue the local workers
+        drain and copies returned tensor frames into the shm ring, so
+        ``get_batch``/``batches`` are source-agnostic. Returns a
+        *connectable* ``(host, port)`` to advertise (e.g. through the
+        master's kv store) — when bound to the wildcard address the
+        host part is this machine's resolvable name.
+
+        Trust boundary: peers are job-internal (the same trust domain
+        as ``jax.distributed``'s control plane — frames are pickled,
+        so the port must not be reachable from untrusted networks;
+        bind the job's private interface).
+        """
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(32)
+        self._remote_srv = srv
+        threading.Thread(
+            target=self._accept_remote, name=f"{self._name}-remote",
+            daemon=True,
+        ).start()
+        bound_host, bound_port = srv.getsockname()[:2]
+        if bound_host in ("0.0.0.0", "::", ""):
+            try:
+                bound_host = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                bound_host = "127.0.0.1"
+        return bound_host, bound_port
+
+    def _accept_remote(self):
+        while True:
+            try:
+                conn, addr = self._remote_srv.accept()
+            except OSError:
+                return  # listener closed
+            with self._remote_lock:
+                self._remote_conns.append(conn)
+            logger.info("remote coworker connected from %s", addr)
+            threading.Thread(
+                target=self._feed_remote, args=(conn,), daemon=True
+            ).start()
+
+    def _recv_reply(self, conn: socket.socket, pending):
+        """Receive one frame for the oldest in-flight task; failed
+        batches surface as sentinels, never as silent drops."""
+        header = _sock_recv_obj(conn)
+        task = pending.popleft()
+        if not isinstance(header, dict) or (
+            "error" not in header and "desc" not in header
+        ):
+            raise ConnectionError(f"malformed frame header {header!r}")
+        if "error" in header:
+            self._ring.put_error(
+                header.get("worker", -1),
+                header.get("task", repr(task)), header["error"],
+            )
+            return
+        arrays = _sock_recv_batch(conn, header)
+        try:
+            self._ring.put(arrays)
+        except Exception as e:  # e.g. batch exceeds slot_bytes
+            self._ring.put_error(
+                -1, repr(task), f"{type(e).__name__}: {e}"
+            )
+
+    def _feed_remote(self, conn: socket.socket):
+        """One-deep pipelined task/reply loop: the next task is on the
+        wire while the coworker preprocesses the previous one, so the
+        RTT hides under compute. In-flight tasks are requeued on
+        connection loss so a healthy worker reprocesses them."""
+        import queue as _q
+        from collections import deque
+
+        pending = deque()
+        task = None
+        try:
+            while True:
+                if pending:
+                    # With a reply outstanding, poll briefly for the
+                    # next task; when the queue is idle, drain the
+                    # reply instead of sitting on it.
+                    try:
+                        task = self._tasks.get(timeout=0.05)
+                    except _q.Empty:
+                        self._recv_reply(conn, pending)
+                        continue
+                else:
+                    task = self._tasks.get()
+                if task is None:
+                    while pending:
+                        self._recv_reply(conn, pending)
+                    _sock_send_obj(conn, None)
+                    return
+                _sock_send_obj(conn, task)
+                pending.append(task)
+                task = None
+                while len(pending) > 2:
+                    self._recv_reply(conn, pending)
+        except Exception as e:
+            logger.warning("remote coworker connection lost: %s", e)
+            try:
+                if task is not None:
+                    self._tasks.put(task)
+                for t in pending:
+                    self._tasks.put(t)
+            except Exception:
+                pass  # queue already closed during stop()
+        finally:
+            with self._remote_lock:
+                if conn in self._remote_conns:
+                    self._remote_conns.remove(conn)
+            conn.close()
+
+    @property
+    def remote_workers(self) -> int:
+        with self._remote_lock:
+            return len(self._remote_conns)
 
     def get_batch(self, timeout: float = 60.0) -> Dict[str, np.ndarray]:
         try:
@@ -227,13 +446,33 @@ class CoworkerDataService:
         return sum(1 for w in self._workers if w.is_alive())
 
     def stop(self, timeout: float = 10.0):
+        # Close the listener FIRST so no feeder can appear after the
+        # stop-sentinel count is taken.
+        if self._remote_srv is not None:
+            try:
+                self._remote_srv.close()
+            except OSError:
+                pass
         for _ in self._workers:
             self._tasks.put(None)
+        with self._remote_lock:
+            n_remote = len(self._remote_conns)
+        for _ in range(n_remote):
+            self._tasks.put(None)  # each feeder forwards one stop
         deadline = time.time() + timeout
         for w in self._workers:
             w.join(timeout=max(0.1, deadline - time.time()))
             if w.is_alive():
                 w.terminate()
                 w.join(timeout=5.0)  # reap: is_alive() must settle
+        while time.time() < deadline and self.remote_workers:
+            time.sleep(0.05)
+        with self._remote_lock:
+            for conn in list(self._remote_conns):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._remote_conns.clear()
         self._tasks.close()
         self._ring.destroy()

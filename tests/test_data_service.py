@@ -146,3 +146,144 @@ class TestCoworkerDataService:
         )
         svc.stop()
         assert svc.alive_workers == 0
+
+
+def _remote_worker_proc(host, port, wid):
+    """Spawned as a separate process: simulates a coworker on another
+    host (only TCP crosses the boundary)."""
+    import pickle
+    from dlrover_tpu.train.data.data_service import remote_coworker_main
+
+    remote_coworker_main(host, port, pickle.dumps(tokenize_task), wid)
+
+
+def poison_task(task):
+    raise RuntimeError("remote boom")
+
+
+def _remote_poison_proc(host, port):
+    import pickle
+    from dlrover_tpu.train.data.data_service import remote_coworker_main
+
+    remote_coworker_main(host, port, pickle.dumps(poison_task), 9)
+
+
+class TestRemoteCoworkers:
+    """Cross-host data service (VERDICT r4 #5, parity:
+    atorch coworker_dataset.py + data_info_service.py): batch payloads
+    cross a TCP socket as length-prefixed tensor frames; the consumer
+    API is identical to the local-shm path."""
+
+    def test_remote_coworker_feeds_batches(self):
+        import multiprocessing as mp
+
+        svc = CoworkerDataService(
+            tokenize_task, num_workers=0, slot_mb=1, num_slots=4,
+            name="t-cw-remote",
+        )
+        proc = None
+        try:
+            host, port = svc.listen_remote("127.0.0.1")
+            ctx = mp.get_context("spawn")
+            proc = ctx.Process(
+                target=_remote_worker_proc, args=(host, port, 1),
+                daemon=True,
+            )
+            proc.start()
+            deadline = time.time() + 30
+            while svc.remote_workers == 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert svc.remote_workers == 1
+
+            tasks = [(i * 10, 8) for i in range(6)]
+            for t in tasks:
+                svc.submit(t)
+            got = [svc.get_batch(timeout=30) for _ in range(6)]
+            starts = sorted(int(b["weight"][0]) for b in got)
+            assert starts == [t[0] for t in tasks]
+            for b in got:
+                s = int(b["weight"][0])
+                np.testing.assert_array_equal(
+                    b["tokens"][0], np.arange(s, s + 8, dtype=np.int32)
+                )
+        finally:
+            svc.stop()
+            if proc is not None:
+                proc.join(timeout=10)
+                assert not proc.is_alive()
+
+    def test_remote_feeds_training_loop(self):
+        """The done-criterion: a remote coworker feeds an actual
+        training loop end to end."""
+        import multiprocessing as mp
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        svc = CoworkerDataService(
+            tokenize_task, num_workers=0, slot_mb=1, num_slots=4,
+            name="t-cw-rtrain",
+        )
+        proc = None
+        try:
+            host, port = svc.listen_remote("127.0.0.1")
+            ctx = mp.get_context("spawn")
+            proc = ctx.Process(
+                target=_remote_worker_proc, args=(host, port, 1),
+                daemon=True,
+            )
+            proc.start()
+
+            table = jnp.zeros((2048, 4))
+            opt = optax.sgd(0.1)
+            opt_state = opt.init(table)
+
+            @jax.jit
+            def step(table, opt_state, tokens):
+                def loss(t):
+                    emb = t[tokens]
+                    return ((emb - 1.0) ** 2).mean()
+
+                g = jax.grad(loss)(table)
+                upd, opt_state = opt.update(g, opt_state)
+                return optax.apply_updates(table, upd), opt_state
+
+            losses = []
+            for _ in range(5):
+                svc.submit((0, 16))  # same shard: loss must shrink
+            for _ in range(5):
+                batch = svc.get_batch(timeout=30)
+                tokens = jnp.asarray(batch["tokens"][0])
+                emb = table[tokens]
+                losses.append(float(((emb - 1.0) ** 2).mean()))
+                table, opt_state = step(table, opt_state, tokens)
+            assert losses[-1] < losses[0]
+        finally:
+            svc.stop()
+            if proc is not None:
+                proc.join(timeout=10)
+
+    def test_remote_error_surfaces_as_sentinel(self):
+        import multiprocessing as mp
+        from dlrover_tpu.train.data.data_service import CoworkerTaskError
+
+        svc = CoworkerDataService(
+            tokenize_task, num_workers=0, slot_mb=1, num_slots=2,
+            name="t-cw-rerr",
+        )
+        proc = None
+        try:
+            host, port = svc.listen_remote("127.0.0.1")
+            ctx = mp.get_context("spawn")
+            proc = ctx.Process(
+                target=_remote_poison_proc, args=(host, port),
+                daemon=True,
+            )
+            proc.start()
+            svc.submit((0, 4))
+            with pytest.raises(CoworkerTaskError, match="remote boom"):
+                svc.get_batch(timeout=30)
+        finally:
+            svc.stop()
+            if proc is not None:
+                proc.join(timeout=10)
