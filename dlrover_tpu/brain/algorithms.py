@@ -27,11 +27,23 @@ def register_algorithm(name: str):
 
 
 def run_all(records: List[Dict]) -> Dict:
+    """Run every registered algorithm and merge their partial plans.
+
+    The merged plan carries per-algorithm provenance: ``provenance``
+    maps each top-level plan key to the algorithm that (last) wrote it,
+    so a consumer can see which of the library's strategies produced
+    each recommendation (parity: the reference's per-optalgorithm
+    OptimizeJobMeta attribution)."""
     plan: Dict = {}
+    provenance: Dict[str, str] = {}
     for name, fn in _ALGORITHMS.items():
         out = fn(records)
         if out:
             plan.update(out)
+            for key in out:
+                provenance[key] = name
+    if plan:
+        plan["provenance"] = provenance
     return plan
 
 
@@ -112,3 +124,114 @@ def hot_node_resource(
         base.pop("samples", None)
         plan.update(base)
     return plan
+
+
+@register_algorithm("completion_time")
+def completion_time(records: List[Dict],
+                    degraded_ratio: float = 0.8) -> Dict:
+    """Job completion-time prediction from the training-speed history
+    (parity: the reference's job-completion/resource-trend optalgorithm
+    family). Records: ``kind="training_speed"`` with ``step``,
+    ``samples_per_s`` and optional ``total_steps``.
+
+    - remaining time = (total_steps - step) / recent speed, where the
+      recent speed is the median of the last window (robust to single
+      stalls);
+    - a recent speed below ``degraded_ratio`` x the job's historical
+      median is flagged ``speed_degraded`` — the signal the reference
+      uses to trigger a resource re-optimization."""
+    rows = [
+        r for r in records
+        if r.get("kind") == "training_speed"
+        and r.get("samples_per_s", 0) > 0
+    ]
+    if len(rows) < 3:
+        return {}
+    speeds = [r["samples_per_s"] for r in rows]
+    recent = statistics.median(speeds[-8:])
+    historical = statistics.median(speeds)
+    out: Dict = {
+        "speed_samples_per_s": round(recent, 3),
+        "speed_degraded": bool(
+            historical > 0 and recent < degraded_ratio * historical
+        ),
+    }
+    last = rows[-1]
+    total = last.get("total_steps", 0)
+    step = last.get("step", 0)
+    batch = last.get("batch_size", 0)
+    if total and total > step and recent > 0:
+        steps_per_s = (
+            recent / batch if batch else recent
+        )
+        out["predicted_remaining_s"] = round(
+            (total - step) / max(steps_per_s, 1e-9), 1
+        )
+        out["predicted_total_steps"] = total
+    return out
+
+
+@register_algorithm("straggler_history")
+def straggler_history(records: List[Dict],
+                      slow_ratio: float = 1.3,
+                      exclude_score: float = 3.0) -> Dict:
+    """Straggler-history node scoring (parity: the reference's
+    hot/straggler node optimization + the device-check straggler
+    diagnosis, made persistent). Two evidence streams:
+
+    - ``kind="straggler_event"`` (``node_id``): a detector (device
+      check, speed monitor) flagged the node — worth 1 point each;
+    - ``kind="node_step"`` (``node_id``, ``step_time_s``): per-node
+      step-time reports — a node whose median step time exceeds
+      ``slow_ratio`` x the cross-node median earns points equal to its
+      overshoot.
+
+    Nodes with ``score >= exclude_score`` land in ``exclude_nodes`` —
+    the input for ``elastic_run --exclude-straggler`` style scheduling
+    (a persistent offender is excluded, one bad step is not)."""
+    scores: Dict = defaultdict(float)
+    for r in records:
+        if r.get("kind") == "straggler_event" and "node_id" in r:
+            scores[r["node_id"]] += 1.0
+    per_node = defaultdict(list)
+    for r in records:
+        if r.get("kind") == "node_step" and "node_id" in r:
+            per_node[r["node_id"]].append(
+                float(r.get("step_time_s", 0.0))
+            )
+    if len(per_node) >= 2:
+        medians = {
+            node: statistics.median(v[-32:])
+            for node, v in per_node.items() if v
+        }
+        overall = statistics.median(medians.values())
+        if overall > 0:
+            for node, med in medians.items():
+                ratio = med / overall
+                if ratio > slow_ratio:
+                    scores[node] += ratio
+    if not scores:
+        return {}
+    out: Dict = {
+        "straggler_scores": {
+            node: round(s, 2) for node, s in sorted(scores.items())
+        },
+    }
+    # Exclusion is capped at a third of the nodes the history has seen:
+    # a fleet-wide event (network hiccup, storage stall) scores every
+    # node, and "exclude 100% of capacity" is never the right plan —
+    # cap first, worst offenders win.
+    seen = {
+        r["node_id"] for r in records
+        if "node_id" in r and r.get("kind") in (
+            "straggler_event", "node_step", "node_resource"
+        )
+    }
+    cap = max(1, len(seen) // 3)
+    offenders = sorted(
+        (node for node, s in scores.items() if s >= exclude_score),
+        key=lambda n: -scores[n],
+    )
+    if offenders:
+        out["exclude_nodes"] = sorted(offenders[:cap])
+    return out

@@ -41,10 +41,14 @@ class BrainReporter:
     def __call__(self, kind: str, payload: Dict):
         if kind == "node_resource":
             self._client.persist_metrics(self._job, kind, {
+                "node_id": payload.get("node_id"),
                 "memory_mb": payload.get("memory_mb", 0),
                 "cpu": payload.get("cpu", 0.0),
             })
-        elif kind == "model_info":
+        elif kind in ("model_info", "training_speed",
+                      "straggler_event", "node_step"):
+            # training_speed feeds completion_time; straggler_event /
+            # node_step feed straggler_history (brain/algorithms.py).
             self._client.persist_metrics(self._job, kind, payload)
 
 

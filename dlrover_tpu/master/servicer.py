@@ -141,6 +141,12 @@ class MasterServicer:
         self._speed_monitor.collect_global_step(
             req.step, req.timestamp or time.time(), req.node_id
         )
+        if self._metric_collector:
+            # Training-speed history feeds the Brain's completion-time
+            # prediction (brain/algorithms.py::completion_time).
+            self._metric_collector.collect_training_speed(
+                req.step, self._speed_monitor.running_speed()
+            )
         return m.Response()
 
     def _report_resource(self, req: m.NodeResourceStats):

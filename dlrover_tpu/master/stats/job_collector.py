@@ -69,6 +69,28 @@ class JobMetricCollector:
                     info["params_count"], info["flops_per_step"])
         self._emit("model_info", info)
 
+    def collect_training_speed(self, step: int,
+                               steps_per_s: float) -> None:
+        """Speed history for the Brain's completion-time prediction.
+
+        The master measures steps/s (SpeedMonitor); samples/s is
+        derived from the reported model info's batch size so the
+        record's units are honest, and ``total_steps`` rides along
+        when the trainer put it in the model-info extras."""
+        if steps_per_s <= 0:
+            return
+        with self._lock:
+            info = dict(self._model_info or {})
+        batch = int(info.get("batch_size", 0))
+        extra = info.get("extra") or {}
+        self._emit("training_speed", {
+            "step": int(step),
+            "steps_per_s": float(steps_per_s),
+            "samples_per_s": float(steps_per_s) * max(batch, 1),
+            "total_steps": int(extra.get("total_steps", 0)),
+            "batch_size": batch or 1,
+        })
+
     def collect_device_stats(self, node_id: int, device_stats) -> None:
         """Per-node accelerator stats (forwarded from workers' metric
         records; host cpu/mem arrive separately via the resource loop)."""

@@ -128,3 +128,156 @@ class TestHotNodeAlgorithm:
             assert out["dummy"] == 1
         finally:
             alg._ALGORITHMS.pop("_test_dummy")
+
+
+class TestCompletionTime:
+    """Completion-time prediction from speed history (parity: the
+    reference's job-completion/resource-trend optalgorithms)."""
+
+    def test_predicts_remaining_time(self):
+        from dlrover_tpu.brain.algorithms import completion_time
+
+        records = [
+            {"kind": "training_speed", "step": s, "samples_per_s": 64.0,
+             "batch_size": 32, "total_steps": 1000}
+            for s in range(100, 600, 100)
+        ]
+        out = completion_time(records)
+        # 64 samples/s at batch 32 = 2 steps/s; 500 steps left -> 250 s
+        assert out["predicted_remaining_s"] == pytest.approx(250.0)
+        assert out["speed_degraded"] is False
+
+    def test_flags_speed_degradation(self):
+        from dlrover_tpu.brain.algorithms import completion_time
+
+        fast = [
+            {"kind": "training_speed", "step": s, "samples_per_s": 100.0}
+            for s in range(20)
+        ]
+        slow = [
+            {"kind": "training_speed", "step": 20 + s,
+             "samples_per_s": 40.0}
+            for s in range(10)
+        ]
+        out = completion_time(fast + slow)
+        assert out["speed_degraded"] is True
+
+    def test_too_little_history_is_silent(self):
+        from dlrover_tpu.brain.algorithms import completion_time
+
+        assert completion_time(
+            [{"kind": "training_speed", "samples_per_s": 10.0}]
+        ) == {}
+
+
+class TestStragglerHistory:
+    """Persistent-straggler node scoring (parity: device-check
+    diagnosis made persistent over the Brain store)."""
+
+    def test_repeat_offender_excluded(self):
+        from dlrover_tpu.brain.algorithms import straggler_history
+
+        records = [
+            {"kind": "straggler_event", "node_id": 2} for _ in range(3)
+        ] + [
+            {"kind": "straggler_event", "node_id": 0}  # one-off
+        ]
+        out = straggler_history(records)
+        assert out["straggler_scores"][2] == 3.0
+        assert out["exclude_nodes"] == [2]
+        assert 0 not in out["exclude_nodes"]
+
+    def test_slow_step_times_accumulate_score(self):
+        from dlrover_tpu.brain.algorithms import straggler_history
+
+        records = []
+        for step in range(8):
+            for node in range(3):
+                records.append({"kind": "node_step", "node_id": node,
+                                "step_time_s": 1.0})
+            records.append({"kind": "node_step", "node_id": 3,
+                            "step_time_s": 2.0})
+        out = straggler_history(records)
+        assert out["straggler_scores"][3] == pytest.approx(2.0)
+        assert 0 not in out["straggler_scores"]
+
+    def test_no_evidence_is_silent(self):
+        from dlrover_tpu.brain.algorithms import straggler_history
+
+        assert straggler_history(
+            [{"kind": "node_resource", "node_id": 0}]
+        ) == {}
+
+
+class TestProvenance:
+    def test_run_all_merges_four_with_provenance(self, brain):
+        """The done-criterion: all four algorithms contribute to one
+        plan and every key names its author."""
+        client = BrainClient(brain.addr)
+        job = "job-full"
+        for step in range(5):
+            for node in range(3):
+                client.persist_metrics(job, "node_resource",
+                                       {"node_id": node,
+                                        "cpu": 100.0, "memory_mb": 1000})
+            client.persist_metrics(job, "node_resource",
+                                   {"node_id": 3, "cpu": 400.0,
+                                    "memory_mb": 4000})
+            client.persist_metrics(job, "training_speed",
+                                   {"step": step * 100,
+                                    "samples_per_s": 64.0,
+                                    "batch_size": 32,
+                                    "total_steps": 1000})
+        for _ in range(3):
+            client.persist_metrics(job, "straggler_event", {"node_id": 3})
+        plan = client.get_optimization_plan(job)
+        client.close()
+        prov = plan["provenance"]
+        assert prov["worker_memory_mb"] == "hot_node_resource"
+        assert prov["hot_nodes"] == "hot_node_resource"
+        assert prov["speed_samples_per_s"] == "completion_time"
+        assert prov["predicted_remaining_s"] == "completion_time"
+        assert prov["straggler_scores"] == "straggler_history"
+        assert plan["exclude_nodes"] == [3]
+        authors = set(prov.values())
+        assert authors >= {"percentile_sizing", "hot_node_resource",
+                           "completion_time", "straggler_history"}
+
+
+class TestTrainingSpeedPipeline:
+    def test_collector_to_brain_carries_speed(self, brain):
+        """End to end through the REAL pipeline: collector -> reporter
+        sink -> Brain store -> completion_time (no direct
+        persist_metrics shortcuts)."""
+        from dlrover_tpu.common.messages import ModelInfo
+        from dlrover_tpu.master.stats import JobMetricCollector
+
+        client = BrainClient(brain.addr)
+        collector = JobMetricCollector()
+        collector.add_sink(BrainReporter(client, "job-speed"))
+        collector.collect_model_info(ModelInfo(
+            params_count=1000, flops_per_step=1e9, batch_size=32,
+            seq_len=128, extra={"total_steps": "1000"},
+        ))
+        for step in range(100, 600, 100):
+            collector.collect_training_speed(step, steps_per_s=2.0)
+        plan = client.get_optimization_plan("job-speed")
+        client.close()
+        # 2 steps/s * batch 32 = 64 samples/s; 500 steps left -> 250 s
+        assert plan["speed_samples_per_s"] == pytest.approx(64.0)
+        assert plan["predicted_remaining_s"] == pytest.approx(250.0)
+        assert plan["provenance"]["predicted_remaining_s"] == (
+            "completion_time"
+        )
+
+    def test_fleet_wide_event_capped(self):
+        from dlrover_tpu.brain.algorithms import straggler_history
+
+        records = []
+        for node in range(6):
+            for _ in range(4):  # everyone over the exclude threshold
+                records.append(
+                    {"kind": "straggler_event", "node_id": node}
+                )
+        out = straggler_history(records)
+        assert len(out["exclude_nodes"]) <= 2  # 6 seen nodes -> cap 2
