@@ -46,3 +46,12 @@ def get_job_name() -> str:
 
 def get_master_addr() -> str:
     return os.getenv(NodeEnv.MASTER_ADDR, "")
+
+
+def default_compile_cache_dir(job_name: str = "") -> str:
+    """One persistent XLA compile-cache dir per job: the agent exports
+    it (DLROVER_TPU_COMPILE_CACHE) and the worker bootstrap falls back
+    to it, so every incarnation of every worker on a host shares one
+    cache — the restart-cheapness lever."""
+    job = job_name or os.getenv(NodeEnv.JOB_NAME, "local-job")
+    return os.path.join("/tmp", "dlrover_tpu_cache", job)

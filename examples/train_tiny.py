@@ -41,6 +41,10 @@ def main():
                         "engine is benchmarked against)")
     parser.add_argument("--resume-marker", type=str, default="",
                         help="file to record the step resumed from")
+    parser.add_argument("--restart-breakdown", type=str, default="",
+                        help="append a JSON line of restart-latency "
+                        "phases (spawn/init/restore/first-step) per "
+                        "incarnation to this file")
     parser.add_argument("--expect-world", type=int, default=0)
     parser.add_argument("--step-sleep", type=float, default=0.0,
                         help="sleep per step (lets tests kill mid-run)")
@@ -136,7 +140,9 @@ def main():
 
     ckpt = None
     start = 0
+    restore_s = 0.0
     if args.ckpt_dir:
+        t_restore0 = time.perf_counter()
         # Multi-process worlds store one shard per process (the commit
         # needs every node's done-file under one tracker); single-process
         # uses the replicated-state DDP-style checkpointer.
@@ -145,6 +151,7 @@ def main():
         else:
             ckpt = FlashCheckpointer(args.ckpt_dir)
         last_step, state = ckpt.load_checkpoint(state)
+        restore_s = time.perf_counter() - t_restore0
         start = max(0, last_step)
         if args.resume_marker and start > 0:
             with open(args.resume_marker, "w") as f:
@@ -188,7 +195,25 @@ def main():
             print(f"rank {rank}: dataset exhausted at step {step}",
                   flush=True)
             break
+        t_step0 = time.perf_counter()
         state, loss = step_fn(state, bx, by)
+        if step == start and args.restart_breakdown:
+            # First step of this incarnation: its wall is the compile
+            # phase (cache-cold) or near-zero (cache-hit on restart).
+            jax.block_until_ready(state["w"])
+            import json
+
+            rec = {
+                "incarnation": dtrain.restart_count(),
+                **dtrain.bootstrap_timings(),
+                "restore_s": round(restore_s, 3),
+                "first_step_s": round(
+                    time.perf_counter() - t_step0, 3
+                ),
+            }
+            with open(args.restart_breakdown, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            print(f"rank {rank}: restart breakdown {rec}", flush=True)
         if args.step_sleep:
             time.sleep(args.step_sleep)
         if ckpt is not None:

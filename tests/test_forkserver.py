@@ -1,0 +1,93 @@
+"""Preloaded fork-server tests (the spawn_s lever of the goodput
+work; see dlrover_tpu/agent/forkserver.py)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.agent.forkserver import ForkServer
+
+
+@pytest.fixture()
+def server():
+    fs = ForkServer()
+    fs.start()
+    yield fs
+    fs.stop()
+
+
+def test_spawn_runs_script_with_env(server, tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os, sys\n"
+        "print('hello from', os.environ['WHO'])\n"
+        "sys.exit(int(os.environ.get('CODE', '0')))\n"
+    )
+    log = tmp_path / "w.log"
+    env = {"WHO": "forked-worker", "PATH": os.environ.get("PATH", "")}
+    w = server.spawn(str(script), [], env, log_path=str(log))
+    assert w.wait(timeout=30) == 0
+    assert "hello from forked-worker" in log.read_text()
+
+
+def test_exit_codes_propagate(server, tmp_path):
+    script = tmp_path / "f.py"
+    script.write_text("import sys\nsys.exit(3)\n")
+    w = server.spawn(str(script), [], {"PATH": os.environ.get("PATH", "")})
+    assert w.wait(timeout=30) == 3
+
+
+def test_spawn_is_fast_after_preload(server, tmp_path):
+    """The point of the fork server: a worker that imports jax must
+    start in a fraction of a cold python+jax start."""
+    script = tmp_path / "j.py"
+    script.write_text(
+        "import time\nt0 = time.time()\n"
+        "import jax\nimport optax\n"
+        "print('imports took', time.time() - t0)\n"
+    )
+    log = tmp_path / "j.log"
+    env = {k: v for k, v in os.environ.items()}
+    env["JAX_PLATFORMS"] = "cpu"
+    t0 = time.perf_counter()
+    w = server.spawn(str(script), [], env, log_path=str(log))
+    assert w.wait(timeout=60) == 0
+    wall = time.perf_counter() - t0
+    took = float(log.read_text().split()[-1])
+    assert took < 0.3, f"imports not preloaded: {took:.2f}s"
+    assert wall < 3.0, f"forked start too slow: {wall:.2f}s"
+
+
+def test_workers_survive_parallel_spawns(server, tmp_path):
+    script = tmp_path / "p.py"
+    script.write_text(
+        "import os, sys\nsys.exit(int(os.environ['RANK']) % 2)\n"
+    )
+    ws = [
+        server.spawn(str(script), [], {"RANK": str(i),
+                                       "PATH": os.environ.get("PATH", "")})
+        for i in range(4)
+    ]
+    codes = [w.wait(timeout=30) for w in ws]
+    assert codes == [0, 1, 0, 1]
+    assert len({w.pid for w in ws}) == 4
+
+
+def test_setsid_gives_own_process_group(server, tmp_path):
+    script = tmp_path / "g.py"
+    script.write_text(
+        "import os, time\n"
+        "assert os.getpgid(0) == os.getpid()\n"
+    )
+    w = server.spawn(str(script), [], {"PATH": os.environ.get("PATH", "")})
+    assert w.wait(timeout=30) == 0
+
+
+def test_opt_out_env(monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_FORKSERVER", "0")
+    assert not ForkServer.enabled()
+    monkeypatch.delenv("DLROVER_TPU_FORKSERVER")
+    assert ForkServer.enabled()
