@@ -340,6 +340,17 @@ def section_large(peak):
     row["fp32_adam_equiv_gb"] = round(
         row["params_m"] * 1e6 * 16 / 1e9, 1
     )
+    # Update-phase memory: the pallas adam8bit kernel streams tiles
+    # through VMEM, so the step peak ~ state + grads + activations (no
+    # dequantized fp32 moments ever materialize in HBM).
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        if "peak_bytes_in_use" in stats:
+            row["peak_hbm_gb"] = round(
+                stats["peak_bytes_in_use"] / 1e9, 2
+            )
+    except Exception:
+        pass
     del result, state
     log(f"bench[large]: {row}")
     return row

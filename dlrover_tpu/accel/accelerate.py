@@ -189,10 +189,18 @@ def make_train_step(module, optimizer, loss, mesh, rules,
                 )
             else:
                 lv, grads = grads_of(state["params"], batch)
-            updates, opt_state = optimizer.update(
-                grads, state["opt"], state["params"]
-            )
-            params = optax.apply_updates(state["params"], updates)
+            fused = getattr(optimizer, "update_and_apply", None)
+            if fused is not None:
+                # One kernel pass produces the new params (saves the
+                # separate apply_updates HBM sweep; optim/low_bit.py).
+                params, opt_state = fused(
+                    grads, state["opt"], state["params"]
+                )
+            else:
+                updates, opt_state = optimizer.update(
+                    grads, state["opt"], state["params"]
+                )
+                params = optax.apply_updates(state["params"], updates)
             new_state = {
                 "params": params, "opt": opt_state,
                 "step": state["step"] + 1,

@@ -321,6 +321,21 @@ class ElasticTrainingAgent:
         self._workers = []
         use_forkserver = ForkServer.enabled()
         if use_forkserver:
+            # The template imports jax with the AGENT's env; per-worker
+            # overrides of import-sensitive vars would silently not
+            # apply in a forked child — fall back to real spawns.
+            sensitive = {
+                k: v for k, v in self._config.worker_env.items()
+                if k.startswith(("JAX_", "XLA_"))
+            }
+            if any(os.environ.get(k) != v for k, v in sensitive.items()):
+                logger.warning(
+                    "worker_env overrides import-sensitive vars %s; "
+                    "disabling the fork server for this job",
+                    sorted(sensitive),
+                )
+                use_forkserver = False
+        if use_forkserver:
             if getattr(self, "_forkserver", None) is None:
                 self._forkserver = ForkServer()
             try:

@@ -302,7 +302,12 @@ class ForkServer:
             "log_path": log_path or None,
         })
         reply = self._take_reply()
-        return ForkedWorker(int(reply["pid"]), self)
+        pid = int(reply["pid"])
+        with self._lock:
+            # The OS can recycle pids: a stale exit record from a
+            # long-dead worker must not be attributed to this one.
+            self._exits.pop(pid, None)
+        return ForkedWorker(pid, self)
 
     def exit_code(self, pid: int) -> Optional[int]:
         with self._lock:

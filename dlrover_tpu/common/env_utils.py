@@ -49,9 +49,17 @@ def get_master_addr() -> str:
 
 
 def default_compile_cache_dir(job_name: str = "") -> str:
-    """One persistent XLA compile-cache dir per job: the agent exports
-    it (DLROVER_TPU_COMPILE_CACHE) and the worker bootstrap falls back
-    to it, so every incarnation of every worker on a host shares one
-    cache — the restart-cheapness lever."""
+    """One persistent XLA compile-cache dir per (user, job): the agent
+    exports it (DLROVER_TPU_COMPILE_CACHE) and the worker bootstrap
+    falls back to it, so every incarnation of every worker on a host
+    shares one cache — the restart-cheapness lever. The root is
+    per-uid: compiled executables are code, and a world-shared /tmp
+    path would let another user pre-plant them."""
     job = job_name or os.getenv(NodeEnv.JOB_NAME, "local-job")
-    return os.path.join("/tmp", "dlrover_tpu_cache", job)
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    root = os.path.join("/tmp", f"dlrover_tpu_cache-{uid}")
+    try:
+        os.makedirs(root, mode=0o700, exist_ok=True)
+    except OSError:
+        pass
+    return os.path.join(root, job)

@@ -44,6 +44,11 @@ class GPTConfig:
     # throughput/memory point when activations almost fit).
     remat_policy: str = "nothing"
     scan_layers: bool = True
+    # Layers per unrolled scan iteration: >1 cuts the XLA while-loop's
+    # per-layer control overhead and widens the scheduler's window at
+    # the cost of a proportionally larger program. Must divide
+    # num_layers.
+    scan_unroll: int = 1
     attn_impl: str = "xla"  # "xla" | "pallas" | "ring" | "ulysses"
     attn_block_q: int = 512  # pallas kernel tile sizes
     attn_block_k: int = 512
@@ -213,6 +218,8 @@ class Block(nn.Module):
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
         attn = _attention(q, k, v, cfg).reshape(b, s, d)
+        from jax.ad_checkpoint import checkpoint_name
+        attn = checkpoint_name(attn, "attn_out")
         x = x + _dense(d, "proj", ("heads", "embed"), cfg)(attn)
 
         y = _layernorm("ln2", cfg)(x)
@@ -233,6 +240,7 @@ class Block(nn.Module):
             return x, aux
         y = _dense(cfg.ff_dim, "up", ("embed", "mlp"), cfg, quant=True)(y)
         y = nn.gelu(y)
+        y = checkpoint_name(y, "ffn_act")
         y = nn.with_logical_constraint(y, ("batch", "seq", "mlp"))
         x = x + _dense(d, "down", ("mlp", "embed"), cfg, quant=True)(y)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
@@ -245,6 +253,12 @@ def _remat_policy(cfg):
 
     - "nothing": recompute everything (min HBM);
     - "dots": save matmul outputs (usual throughput/memory sweet spot);
+    - "dots_lite": save ONLY the two expensive tensors per block — the
+      attention output and the post-activation FFN tensor (named via
+      ``checkpoint_name``) — and recompute the cheap qkv projections.
+      ~55% of "dots"' activation bytes at a few percent recompute: the
+      policy that buys batch 8 for the 1.5B single-chip preset
+      (measured in bench.py's large section);
     - "offload": save matmul outputs to *host* memory — activations
       leave HBM between fwd and bwd (parity: the reference's
       ``selective_offloading_checkpoint.py``); XLA streams them back
@@ -252,6 +266,10 @@ def _remat_policy(cfg):
     """
     if cfg.remat_policy == "dots":
         return jax.checkpoint_policies.checkpoint_dots
+    if cfg.remat_policy == "dots_lite":
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "ffn_act"
+        )
     if cfg.remat_policy == "offload":
         return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
             "device", "pinned_host"
@@ -379,6 +397,7 @@ class GPT(nn.Module):
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
+                unroll=max(cfg.scan_unroll, 1),
             )(cfg, name="blocks")(x)
             aux_total = jnp.mean(aux) if aux is not None else None
         else:

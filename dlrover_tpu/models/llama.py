@@ -201,6 +201,8 @@ class LlamaBlock(nn.Module):
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
         attn = _attention(q, k, v, cfg).reshape(b, s, d)
+        from jax.ad_checkpoint import checkpoint_name
+        attn = checkpoint_name(attn, "attn_out")
         x = x + _dense(d, "o_proj", ("heads", "embed"), cfg)(attn)
 
         y = _rms_norm("mlp_norm", cfg)(x)
@@ -225,6 +227,7 @@ class LlamaBlock(nn.Module):
         up = _dense(cfg.ff_dim, "up_proj", ("embed", "mlp"), cfg,
                     quant=True)(y)
         y = nn.silu(gate) * up
+        y = checkpoint_name(y, "ffn_act")
         y = nn.with_logical_constraint(y, ("batch", "seq", "mlp"))
         x = x + _dense(d, "down_proj", ("mlp", "embed"), cfg,
                        quant=True)(y)

