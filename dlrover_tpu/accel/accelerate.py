@@ -68,6 +68,8 @@ class AccelerateResult:
     init_fn: Callable            # (rng) -> sharded state (for re-init)
     search_ranking: Any = None   # [(ParallelSpec, CostEstimate)] from the
                                  # strategy search (None for explicit specs)
+    module: Any = None           # the (possibly reconfigured) flax module
+                                 # the step was built for
 
 
 def _device_hbm(devices) -> float:
@@ -386,7 +388,7 @@ def auto_accelerate(
         return AccelerateResult(
             spec=sp, mesh=mesh, rules=rules, state=state,
             shardings=shardings, batch_sharding=batch_sharding,
-            train_step=train_step, init_fn=materialize,
+            train_step=train_step, init_fn=materialize, module=mod,
         )
 
     if isinstance(spec, ParallelSpec):

@@ -152,20 +152,23 @@ def template_main():
                     inp.close()   # protocol dups only — fds 0/1 are
                     out.close()   # already /dev/null + stderr alias
                     _child_main(req)  # never returns
-                children.append(pid)
-                _write_msg(out, {"pid": pid})
+                # Exits are keyed by the caller's unique token, not the
+                # pid: pids recycle, tokens never do, and a token can't
+                # collide with an exit event already in flight.
+                children.append((pid, req.get("token")))
+                _write_msg(out, {"pid": pid, "token": req.get("token")})
             elif req.get("cmd") == "stop":
                 break
-        for pid in list(children):
+        for pid, token in list(children):
             done, status = os.waitpid(pid, os.WNOHANG)
             if done:
-                children.remove(pid)
+                children.remove((pid, token))
                 code = (
                     os.waitstatus_to_exitcode(status)
                     if hasattr(os, "waitstatus_to_exitcode")
                     else (status >> 8)
                 )
-                _write_msg(out, {"exit": pid, "code": code})
+                _write_msg(out, {"exit": token, "code": code})
 
 
 # --------------------------------------------------------------------
@@ -175,14 +178,15 @@ def template_main():
 class ForkedWorker:
     """Popen-shaped handle for a fork-server child."""
 
-    def __init__(self, pid: int, server: "ForkServer"):
+    def __init__(self, pid: int, token: int, server: "ForkServer"):
         self.pid = pid
+        self.token = token
         self._server = server
         self.returncode: Optional[int] = None
 
     def poll(self) -> Optional[int]:
         if self.returncode is None:
-            code = self._server.exit_code(self.pid)
+            code = self._server.exit_code(self.token)
             if code is None and not self._server.alive():
                 # Template gone: exit events can never arrive and the
                 # child (reparented to init) cannot be waited from
@@ -219,8 +223,9 @@ class ForkServer:
     def __init__(self):
         self._proc: Optional[subprocess.Popen] = None
         self._lock = threading.Lock()
-        self._exits: Dict[int, int] = {}
+        self._exits: Dict[int, int] = {}   # spawn token -> exit code
         self._reader: Optional[threading.Thread] = None
+        self._next_token = 0
 
     @staticmethod
     def enabled() -> bool:
@@ -233,7 +238,9 @@ class ForkServer:
 
         if self._proc is not None and self._proc.poll() is None:
             return
-        self._exits.clear()
+        # _exits survives a template restart: tokens are unique across
+        # templates, and clearing would drop codes of already-exited
+        # workers nobody polled yet.
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "dlrover_tpu.agent.forkserver"],
             stdin=subprocess.PIPE,
@@ -294,24 +301,22 @@ class ForkServer:
               log_path: str = "") -> ForkedWorker:
         with self._lock:
             alive = self._proc is not None and self._proc.poll() is None
+            self._next_token += 1
+            token = self._next_token
         if not alive:
             self.start()
         _write_msg(self._proc.stdin, {
             "cmd": "spawn", "entrypoint": entrypoint,
             "args": list(args), "env": dict(env),
             "log_path": log_path or None,
+            "token": token,
         })
         reply = self._take_reply()
-        pid = int(reply["pid"])
-        with self._lock:
-            # The OS can recycle pids: a stale exit record from a
-            # long-dead worker must not be attributed to this one.
-            self._exits.pop(pid, None)
-        return ForkedWorker(pid, self)
+        return ForkedWorker(int(reply["pid"]), token, self)
 
-    def exit_code(self, pid: int) -> Optional[int]:
+    def exit_code(self, token: int) -> Optional[int]:
         with self._lock:
-            return self._exits.get(pid)
+            return self._exits.get(token)
 
     def alive(self) -> bool:
         with self._lock:

@@ -55,11 +55,19 @@ def default_compile_cache_dir(job_name: str = "") -> str:
     shares one cache — the restart-cheapness lever. The root is
     per-uid: compiled executables are code, and a world-shared /tmp
     path would let another user pre-plant them."""
+    import stat
+    import tempfile
+
     job = job_name or os.getenv(NodeEnv.JOB_NAME, "local-job")
     uid = os.getuid() if hasattr(os, "getuid") else 0
     root = os.path.join("/tmp", f"dlrover_tpu_cache-{uid}")
     try:
         os.makedirs(root, mode=0o700, exist_ok=True)
+        st = os.stat(root)
+        if st.st_uid != uid or st.st_mode & (stat.S_IWGRP | stat.S_IWOTH):
+            # Pre-existing dir we don't exclusively own (pre-planted or
+            # loosened): compiled executables must not load from it.
+            root = tempfile.mkdtemp(prefix="dlrover_tpu_cache-")
     except OSError:
-        pass
+        root = tempfile.mkdtemp(prefix="dlrover_tpu_cache-")
     return os.path.join(root, job)

@@ -25,7 +25,6 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax import lax
 from jax.experimental import pallas as pl
 
 
@@ -64,11 +63,6 @@ def _quantize(x: jnp.ndarray, block: int) -> _QTensor:
         jnp.round(blocks / safe[:, None] * 127.0), -127, 127
     ).astype(jnp.int8)
     return _QTensor(q=q, scale=scale.astype(jnp.float32))
-
-
-def _dequantize(qt: _QTensor, shape, size) -> jnp.ndarray:
-    blocks = qt.q.astype(jnp.float32) * (qt.scale[:, None] / 127.0)
-    return blocks.reshape(-1)[:size].reshape(shape)
 
 
 def _chunked(shape) -> bool:

@@ -1,12 +1,25 @@
 """High-level training orchestration — the AtorchTrainer analog.
 
 Parity: reference ``atorch/atorch/trainer/atorch_trainer.py`` (a
-HF-Trainer-shaped loop wiring accelerate, checkpointing, logging and
-resume into one object). The TPU version composes the framework's own
-pieces — ``auto_accelerate`` (or ``ElasticTrainer`` for grad accum), the
-flash-checkpoint engines, the elastic data layer, the profiler and the
-master metric reports — into a ``fit()`` loop, so the per-user training
-script shrinks to model + loss + data.
+HF-Trainer-shaped loop wiring accelerate, checkpointing, evaluation,
+schedulers, callbacks, logging and resume into one object). The TPU
+version composes the framework's own pieces — ``auto_accelerate`` (or
+``ElasticTrainer`` for grad accum), the flash-checkpoint engines, the
+elastic data layer, the profiler and the master metric reports — into
+a ``fit()`` loop, so the per-user training script shrinks to model +
+loss + data. HF-Trainer-shaped surface:
+
+- **callbacks**: :class:`TrainerCallback` hooks (train begin/end, step
+  end, evaluate, save) with a ``trainer.should_stop`` flag for early
+  stopping; :class:`LoggingCallback` ships interval logging with
+  loss / tokens-per-second / learning rate;
+- **evaluation**: ``evaluate()`` runs a jitted forward-only loss over
+  an eval stream (no grads, params not donated); ``fit(eval_batches=,
+  eval_every=)`` interleaves it and reports ``eval_loss``;
+- **LR schedules**: pass any optax schedule inside the optimizer as
+  usual; hand the same callable to ``lr_schedule=`` and the trainer
+  surfaces the current LR in step metrics/logs (the reference logs
+  ``lr_scheduler.get_last_lr()`` the same way).
 
 The loop is crash-safe by construction: MEMORY snapshots every step
 (async, ~ms), DISK persists on a cadence, and a restart resumes from
@@ -15,9 +28,57 @@ whatever the agent flushed.
 
 import os
 import time
-from typing import Any, Callable, Iterable, Optional
+from typing import Any, Callable, Iterable, Optional, Sequence
 
 from dlrover_tpu.common.log import logger
+
+
+class TrainerCallback:
+    """Hook points mirroring the reference's HF-style callbacks. Any
+    hook may set ``trainer.should_stop = True`` to end ``fit`` after
+    the current step (early stopping, budget exhaustion, ...)."""
+
+    def on_train_begin(self, trainer, start_step: int):
+        pass
+
+    def on_step_end(self, trainer, step: int, metrics: dict):
+        pass
+
+    def on_evaluate(self, trainer, step: int, metrics: dict):
+        pass
+
+    def on_save(self, trainer, step: int, storage: str):
+        pass
+
+    def on_train_end(self, trainer, step: int):
+        pass
+
+
+class LoggingCallback(TrainerCallback):
+    """Interval logging: loss, step time, tokens/s, and the current
+    learning rate when the trainer knows the schedule."""
+
+    def __init__(self, every: int = 10):
+        self.every = max(1, every)
+        self._t0 = None
+
+    def on_step_end(self, trainer, step, metrics):
+        if step % self.every:
+            return
+        parts = [f"step {step}", f"loss {metrics['loss']:.4f}"]
+        if "step_time_s" in metrics:
+            parts.append(f"{metrics['step_time_s'] * 1e3:.0f} ms/step")
+        if "tokens_per_s" in metrics:
+            parts.append(f"{metrics['tokens_per_s'] / 1e3:.1f}k tok/s")
+        if "lr" in metrics:
+            parts.append(f"lr {metrics['lr']:.2e}")
+        logger.info("train | %s", " | ".join(parts))
+
+    def on_evaluate(self, trainer, step, metrics):
+        logger.info(
+            "eval  | step %s | eval_loss %.4f (%s batches)",
+            step, metrics["eval_loss"], metrics["eval_batches"],
+        )
 
 
 class Trainer:
@@ -33,6 +94,8 @@ class Trainer:
         grad_accum: int = 1,
         profiler=None,
         report_metrics: bool = True,
+        callbacks: Sequence[TrainerCallback] = (),
+        lr_schedule: Optional[Callable[[int], float]] = None,
         **accel_kwargs,
     ):
         import jax
@@ -44,6 +107,11 @@ class Trainer:
             grad_accum=grad_accum, **accel_kwargs,
         )
         self.state = self._result.state
+        self._loss = loss
+        self._callbacks = list(callbacks)
+        self._lr_schedule = lr_schedule
+        self._eval_step = None
+        self.should_stop = False
         self._persist_every = persist_every
         self._profiler = profiler
         self._report = report_metrics
@@ -86,16 +154,59 @@ class Trainer:
             logger.info("trainer resumed from step %s", step)
         return max(0, step)
 
+    def _fire(self, hook: str, *args):
+        for cb in self._callbacks:
+            try:
+                getattr(cb, hook)(self, *args)
+            except Exception:
+                logger.exception("trainer callback %s failed", hook)
+
+    def evaluate(self, batches: Iterable,
+                 max_batches: int = 0) -> dict:
+        """Forward-only loss over an eval stream (params NOT donated):
+        returns {'eval_loss': mean, 'eval_batches': n}."""
+        import jax
+
+        if self._eval_step is None:
+            module = self._result.module
+            loss = self._loss
+            self._eval_step = jax.jit(
+                lambda params, b: loss(module, params, b),
+                in_shardings=(
+                    self._result.shardings["params"],
+                    self.batch_sharding,
+                ),
+            )
+        total, n = 0.0, 0
+        for batch in batches:
+            if max_batches and n >= max_batches:
+                break
+            batch = jax.device_put(batch, self.batch_sharding)
+            total += float(self._eval_step(self.state["params"], batch))
+            n += 1
+        out = {
+            "eval_loss": total / max(n, 1),
+            "eval_batches": n,
+        }
+        return out
+
     def fit(self, batches: Iterable, steps: int,
-            start_step: Optional[int] = None) -> dict:
-        """Run the loop; returns {'step': last, 'loss': last}.
+            start_step: Optional[int] = None,
+            eval_batches: Optional[Callable[[], Iterable]] = None,
+            eval_every: int = 0,
+            eval_max_batches: int = 0) -> dict:
+        """Run the loop; returns {'step': last, 'loss': last[, 'eval_loss']}.
 
         ``batches`` yields device-puttable batches; the loop consumes one
-        per optimizer step and stops at ``steps`` or when data runs out.
+        per optimizer step and stops at ``steps``, when data runs out, or
+        when a callback sets ``should_stop``. ``eval_batches`` is a
+        zero-arg callable returning a fresh eval iterable (evaluated
+        every ``eval_every`` steps and once at the end).
         """
         import contextlib
 
         import jax
+        import numpy as np
 
         from dlrover_tpu import train as dtrain
         from dlrover_tpu.train import report_training_metrics
@@ -104,7 +215,11 @@ class Trainer:
         start = self.restore() if start_step is None else start_step
         it = iter(batches)
         last_loss = float("nan")
+        last_eval: dict = {}
+        evaluated_at = -1
         done = start
+        self.should_stop = False  # a previous fit's stop must not leak
+        self._fire("on_train_begin", start)
         for step in range(start, steps):
             try:
                 batch = next(it)
@@ -115,6 +230,7 @@ class Trainer:
                 self._profiler.step() if self._profiler is not None
                 else contextlib.nullcontext()
             )
+            t_step0 = time.perf_counter()
             with ctx:
                 batch = jax.device_put(batch, self.batch_sharding)
                 self.state, metrics = self.train_step(self.state, batch)
@@ -124,6 +240,7 @@ class Trainer:
                     self._ckpt.save_checkpoint(
                         done, self.state, StorageType.DISK
                     )
+                    self._fire("on_save", done, "disk")
                 else:
                     self._ckpt.save_checkpoint(
                         done, self.state, StorageType.MEMORY
@@ -136,9 +253,39 @@ class Trainer:
                         pass
                 report_training_metrics(done)
             last_loss = metrics["loss"]
+            step_metrics = {
+                "loss": float(last_loss),
+                "step_time_s": time.perf_counter() - t_step0,
+            }
+            tokens = int(np.prod(np.shape(batch)))
+            if tokens:
+                step_metrics["tokens_per_s"] = (
+                    tokens / step_metrics["step_time_s"]
+                )
+            if self._lr_schedule is not None:
+                step_metrics["lr"] = float(self._lr_schedule(done))
+            self._fire("on_step_end", done, step_metrics)
+            if (eval_batches is not None and eval_every
+                    and done % eval_every == 0):
+                last_eval = self.evaluate(
+                    eval_batches(), max_batches=eval_max_batches
+                )
+                evaluated_at = done
+                self._fire("on_evaluate", done, last_eval)
+            if self.should_stop:
+                logger.info("callback requested stop at step %s", done)
+                break
+        if eval_batches is not None and evaluated_at != done:
+            last_eval = self.evaluate(
+                eval_batches(), max_batches=eval_max_batches
+            )
+            self._fire("on_evaluate", done, last_eval)
+        self._fire("on_train_end", done)
         loss = float(last_loss)
         logger.info("trainer finished at step %s (loss %.5f)", done, loss)
-        return {"step": done, "loss": loss}
+        out = {"step": done, "loss": loss}
+        out.update(last_eval)
+        return out
 
     def close(self):
         if self._ckpt is not None:

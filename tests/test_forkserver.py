@@ -71,7 +71,7 @@ def test_workers_survive_parallel_spawns(server, tmp_path):
                                        "PATH": os.environ.get("PATH", "")})
         for i in range(4)
     ]
-    codes = [w.wait(timeout=30) for w in ws]
+    codes = [w.wait(timeout=90) for w in ws]
     assert codes == [0, 1, 0, 1]
     assert len({w.pid for w in ws}) == 4
 
