@@ -154,16 +154,20 @@ class ScalePlanCRD:
     labels: Dict[str, str] = field(default_factory=dict)
     spec: ScaleSpec = field(default_factory=ScaleSpec)
     status: ScalePlanStatus = field(default_factory=ScalePlanStatus)
+    resource_version: str = ""   # metadata.resourceVersion (watch resume)
 
     def to_manifest(self) -> Dict:
+        meta = {
+            "name": self.name,
+            "namespace": self.namespace,
+            "labels": dict(self.labels),
+        }
+        if self.resource_version:
+            meta["resourceVersion"] = self.resource_version
         return {
             "apiVersion": API_VERSION,
             "kind": "ScalePlan",
-            "metadata": {
-                "name": self.name,
-                "namespace": self.namespace,
-                "labels": dict(self.labels),
-            },
+            "metadata": meta,
             "spec": self.spec.to_manifest(),
             "status": self.status.to_manifest(),
         }
@@ -177,6 +181,7 @@ class ScalePlanCRD:
             namespace=meta.get("namespace", "default"),
             labels=dict(meta.get("labels", {})),
             spec=ScaleSpec.from_manifest(doc.get("spec", {})),
+            resource_version=str(meta.get("resourceVersion", "")),
         )
         out.status = ScalePlanStatus(
             create_time=status.get("createTime"),
@@ -302,6 +307,12 @@ class ScalePlanReconciler:
             crd.status.phase = PHASE_FAILED
         crd.status.finish_time = time.time()
         self._store.applied.append(crd)
+        # A cluster-backed store pushes the phase to the apiserver's
+        # status subresource (K8sScalePlanSource.update); the local
+        # store records it in `applied` alone.
+        update = getattr(self._store, "update", None)
+        if update is not None:
+            update(crd)
         logger.info(
             "reconciled %s: create=%s remove=%s -> %s",
             crd.name,

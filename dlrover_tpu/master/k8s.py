@@ -15,13 +15,29 @@ real apiserver is a transport swap, not a rewrite.
 """
 
 import json
+import queue
+import threading
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import (
+    Callable,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Tuple,
+)
 
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.master.crd import API_VERSION, ScalePlanCRD
+from dlrover_tpu.master.crd import (
+    API_VERSION,
+    PHASE_PENDING,
+    ScalePlanCRD,
+)
 
 Transport = Callable[[str, str, Optional[Dict]], Tuple[int, Dict]]
+#: Streaming transport: GET `path`, yield response lines (the chunked
+#: watch stream). Raises on connection errors; returning ends the watch.
+StreamTransport = Callable[[str], Iterator[str]]
 
 _GROUP, _VERSION = API_VERSION.split("/")
 
@@ -70,15 +86,44 @@ def default_transport(
     return send
 
 
+def default_stream_transport(
+    api_server: str,
+    token: str = "",
+    timeout: float = 330.0,
+) -> StreamTransport:
+    """urllib streaming GET for the watch protocol: yields response
+    lines as they arrive (one JSON watch event per line). The timeout
+    is the whole-watch read budget — the apiserver closes watches
+    itself around 5 minutes, so set this slightly above."""
+    import urllib.request
+
+    def stream(path: str) -> Iterator[str]:
+        req = urllib.request.Request(
+            f"{api_server.rstrip('/')}{path}", method="GET"
+        )
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            for raw in resp:
+                line = raw.decode().strip()
+                if line:
+                    yield line
+
+    return stream
+
+
 class K8sElasticJobClient:
-    """CRUD over the ElasticJob / ScalePlan custom resources.
+    """CRUD + list/watch over the ElasticJob / ScalePlan custom
+    resources.
 
     Request paths follow the apiserver's custom-resource convention:
     ``/apis/{group}/{version}/namespaces/{ns}/{plural}[/{name}]``.
     """
 
-    def __init__(self, transport: Transport, namespace: str = "default"):
+    def __init__(self, transport: Transport, namespace: str = "default",
+                 stream_transport: Optional[StreamTransport] = None):
         self._send = transport
+        self._stream = stream_transport
         self.namespace = namespace
 
     # ------------- paths -------------
@@ -124,16 +169,56 @@ class K8sElasticJobClient:
         return out
 
     def list_scaleplans(self, label_selector: str = "") -> List[ScalePlanCRD]:
+        plans, _ = self.list_scaleplans_rv(label_selector)
+        return plans
+
+    def list_scaleplans_rv(
+        self, label_selector: str = ""
+    ) -> Tuple[List[ScalePlanCRD], str]:
+        """List plus the collection resourceVersion — the token a watch
+        resumes from (the k8s list+watch contract)."""
         path = self._path("scaleplans")
         if label_selector:
             path += f"?labelSelector={label_selector}"
         status, body = self._send("GET", path, None)
         if status >= 300:
             raise RuntimeError(f"list scaleplans: HTTP {status}")
+        rv = str(
+            body.get("metadata", {}).get("resourceVersion", "")
+        )
         return [
             ScalePlanCRD.from_manifest(item)
             for item in body.get("items", [])
-        ]
+        ], rv
+
+    def watch_scaleplans(
+        self, resource_version: str = "",
+        label_selector: str = "",
+    ) -> Iterator[Tuple[str, ScalePlanCRD]]:
+        """One watch connection (parity: ``k8s_watcher.py:151``'s
+        list+watch): yields ``(event_type, plan)`` until the server
+        closes the stream. Raises ``WatchExpired`` on HTTP 410 (the
+        resourceVersion aged out — re-list and start over)."""
+        if self._stream is None:
+            raise RuntimeError(
+                "watch needs a stream_transport "
+                "(default_stream_transport for a real apiserver)"
+            )
+        path = self._path("scaleplans") + "?watch=1"
+        if resource_version:
+            path += f"&resourceVersion={resource_version}"
+        if label_selector:
+            path += f"&labelSelector={label_selector}"
+        for line in self._stream(path):
+            event = json.loads(line)
+            if event.get("type") == "ERROR":
+                obj = event.get("object", {})
+                if obj.get("code") == 410:
+                    raise WatchExpired(resource_version)
+                raise RuntimeError(f"watch error event: {obj}")
+            yield event["type"], ScalePlanCRD.from_manifest(
+                event["object"]
+            )
 
     # ------------- elasticjobs -------------
     def patch_elasticjob_replicas(self, job_name: str,
@@ -157,6 +242,112 @@ class K8sElasticJobClient:
                 f"patch elasticjob {job_name}: HTTP {status}"
             )
         return out
+
+
+class WatchExpired(Exception):
+    """The watch resourceVersion is too old (HTTP 410): re-list."""
+
+
+class K8sScalePlanSource:
+    """List+watch pump with the local ``ScalePlanStore``'s consumption
+    contract (``watch(timeout) -> plan-or-None``), so
+    ``ScalePlanReconciler`` runs unchanged against a live apiserver:
+    the initial list seeds pending plans, watch events stream the rest,
+    EOF reconnects from the last resourceVersion, and a 410 falls back
+    to a fresh list (exactly ``k8s_watcher.py``'s loop)."""
+
+    def __init__(self, client: K8sElasticJobClient,
+                 job_name: str = "",
+                 reconnect_delay: float = 1.0):
+        import collections
+
+        self._client = client
+        # Scope to THIS job's plans: two masters in one namespace must
+        # not realize (or double-realize) each other's ScalePlans.
+        self._selector = (
+            f"elasticjob-name={job_name}" if job_name else ""
+        )
+        self._delay = reconnect_delay
+        self._queue: "queue.Queue[ScalePlanCRD]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seen: set = set()  # plan names already queued (dedup)
+        # reconciler contract; bounded — status write-back is update()
+        self.applied = collections.deque(maxlen=64)
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._pump, name="k8s-scaleplan-watch", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        """Signal the pump to exit. A pump blocked inside an idle watch
+        read cannot be interrupted mid-read; it notices the stop at the
+        next event / EOF / transport timeout and exits then (it is a
+        daemon thread and queues nothing after the stop)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @staticmethod
+    def _unrealized(plan: ScalePlanCRD) -> bool:
+        return plan.status.phase in ("", PHASE_PENDING)
+
+    # ScalePlanStore consumption contract
+    def watch(self, timeout: float = 0.2) -> Optional[ScalePlanCRD]:
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def update(self, crd: ScalePlanCRD):
+        """Reconciler status write-back -> apiserver status subresource."""
+        try:
+            self._client.update_scaleplan_status(
+                crd.name, crd.status.phase, crd.status.finish_time
+            )
+        except Exception as e:
+            logger.warning("scaleplan %s status update failed: %s",
+                           crd.name, e)
+
+    def _offer(self, plan: ScalePlanCRD):
+        """Queue a plan at most once (a still-Pending plan can arrive
+        from the initial list AND a MODIFIED event AND a 410 re-list —
+        realizing it twice would double-launch its nodes)."""
+        if self._stop.is_set() or not self._unrealized(plan):
+            return
+        if plan.name in self._seen:
+            return
+        self._seen.add(plan.name)
+        self._queue.put(plan)
+
+    def _pump(self):
+        rv = ""
+        seeded = False
+        while not self._stop.is_set():
+            try:
+                if not seeded or not rv:
+                    plans, rv = self._client.list_scaleplans_rv(
+                        self._selector
+                    )
+                    for plan in plans:
+                        self._offer(plan)
+                    seeded = True
+                for etype, plan in self._client.watch_scaleplans(
+                    rv, self._selector
+                ):
+                    rv = plan.resource_version or rv
+                    if self._stop.is_set():
+                        return
+                    if etype in ("ADDED", "MODIFIED"):
+                        self._offer(plan)
+                # clean EOF: server closed the watch; reconnect from rv
+            except WatchExpired:
+                rv = ""  # too old: re-list
+            except Exception as e:
+                logger.warning("scaleplan watch error: %s; retrying", e)
+                self._stop.wait(self._delay)
 
 
 @dataclass

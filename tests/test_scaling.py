@@ -537,3 +537,229 @@ class TestClusterWatcher:
         assert [f for f in failures if f[0] == 1] == [
             (1, "failed"), (1, "failed")
         ]
+
+
+class TestK8sListWatch:
+    """List+watch parity (k8s_watcher.py:151) against a LIVE chunked
+    HTTP server: initial list seeds pending plans, watch events stream,
+    EOF reconnects from the last resourceVersion, 410 re-lists, and the
+    unchanged ScalePlanReconciler realizes plans + pushes status."""
+
+    @pytest.fixture()
+    def apiserver(self):
+        import http.server
+        import threading
+
+        from dlrover_tpu.master.crd import scaleplan_from_plan
+
+        def plan_doc(seq, rv, phase=""):
+            crd = scaleplan_from_plan(
+                ScalePlan(launch_nodes=[Node("worker", seq)]),
+                "job-w", seq,
+            )
+            doc = crd.to_manifest()
+            doc["metadata"]["resourceVersion"] = str(rv)
+            doc["status"]["phase"] = phase
+            return doc
+
+        state = {
+            "watch_calls": [], "status_patches": [],
+            "expire_first_watch": False,
+        }
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if "watch=1" in self.path:
+                    state["watch_calls"].append(self.path)
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/json"
+                    )
+                    self.end_headers()
+                    if (state["expire_first_watch"]
+                            and len(state["watch_calls"]) == 1):
+                        self.wfile.write((json.dumps({
+                            "type": "ERROR",
+                            "object": {"code": 410,
+                                       "reason": "Expired"},
+                        }) + "\n").encode())
+                        return
+                    n = len(state["watch_calls"])
+                    # two events per connection, then EOF
+                    for i in range(2):
+                        seq = 10 * n + i
+                        self.wfile.write((json.dumps({
+                            "type": "ADDED",
+                            "object": plan_doc(seq, 100 * n + i),
+                        }) + "\n").encode())
+                        self.wfile.flush()
+                    return
+                body = json.dumps({
+                    "metadata": {"resourceVersion": "5"},
+                    "items": [plan_doc(1, 4),
+                              plan_doc(2, 5, phase="Succeeded")],
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_PATCH(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                state["status_patches"].append(
+                    (self.path,
+                     json.loads(self.rfile.read(length)))
+                )
+                body = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            yield f"http://127.0.0.1:{httpd.server_address[1]}", state
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def make_client(self, url):
+        from dlrover_tpu.master.k8s import (
+            K8sElasticJobClient,
+            default_stream_transport,
+            default_transport,
+        )
+
+        return K8sElasticJobClient(
+            default_transport(url),
+            stream_transport=default_stream_transport(url, timeout=10),
+        )
+
+    def test_watch_streams_events(self, apiserver):
+        url, _ = apiserver
+        client = self.make_client(url)
+        events = list(client.watch_scaleplans("5"))
+        assert [e[0] for e in events] == ["ADDED", "ADDED"]
+        assert events[0][1].spec.create_pods[0].id == 10
+
+    def test_source_lists_then_watches_and_reconciler_realizes(
+        self, apiserver
+    ):
+        from dlrover_tpu.master.crd import ScalePlanReconciler
+        from dlrover_tpu.master.k8s import K8sScalePlanSource
+
+        url, state = apiserver
+        source = K8sScalePlanSource(self.make_client(url),
+                                    reconnect_delay=0.05)
+        realized = []
+
+        class FakeScaler:
+            def scale(self, plan):
+                realized.append(
+                    [n.id for n in plan.launch_nodes]
+                )
+
+        rec = ScalePlanReconciler(source, FakeScaler())
+        source.start()
+        rec.start()
+        deadline = time.time() + 20
+        # list seeds plan 1 (plan 2 already Succeeded -> skipped);
+        # watch connections deliver 10, 11, then reconnect 20, 21...
+        while time.time() < deadline and len(realized) < 3:
+            time.sleep(0.05)
+        rec.stop()
+        source.stop()
+        flat = [i for ids in realized for i in ids]
+        assert 1 in flat           # from the initial list
+        assert 10 in flat and 11 in flat  # from the first watch
+        assert 2 not in flat       # already-realized plan skipped
+        assert len(state["watch_calls"]) >= 2  # reconnected after EOF
+        # resumed from the last seen resourceVersion
+        assert "resourceVersion=101" in state["watch_calls"][1]
+        # reconciler pushed phases back to the status subresource
+        assert any(
+            "/status" in path and body["status"]["phase"] == "Succeeded"
+            for path, body in state["status_patches"]
+        )
+
+    def test_410_triggers_relist(self, apiserver):
+        from dlrover_tpu.master.k8s import K8sScalePlanSource
+
+        url, state = apiserver
+        state["expire_first_watch"] = True
+        source = K8sScalePlanSource(self.make_client(url),
+                                    reconnect_delay=0.05)
+        source.start()
+        got = []
+        deadline = time.time() + 20
+        while time.time() < deadline and len(got) < 2:
+            plan = source.watch(timeout=0.2)
+            if plan is not None:
+                got.append(plan)
+        source.stop()
+        # survived the 410: re-listed (plan 1 seen twice is fine) and
+        # went on to receive watch events
+        assert len(state["watch_calls"]) >= 2
+        assert got
+
+
+class TestWatchSourceScoping:
+    def test_plans_queue_exactly_once(self):
+        """A still-Pending plan arriving from list AND watch (or a 410
+        re-list) must realize once, not twice."""
+        from dlrover_tpu.master.crd import scaleplan_from_plan
+        from dlrover_tpu.master.k8s import (
+            K8sElasticJobClient,
+            K8sScalePlanSource,
+        )
+
+        crd = scaleplan_from_plan(
+            ScalePlan(launch_nodes=[Node("worker", 1)]), "job-d", 1
+        )
+        src = K8sScalePlanSource(
+            K8sElasticJobClient(lambda m, p, b: (200, {}))
+        )
+        src._offer(crd)
+        src._offer(crd)  # watch duplicate
+        assert src.watch(timeout=0.1) is not None
+        assert src.watch(timeout=0.1) is None
+
+    def test_selector_scopes_to_job(self):
+        """Two masters in one namespace: the source only lists/watches
+        its own job's plans (elasticjob-name label selector)."""
+        from dlrover_tpu.master.k8s import (
+            K8sElasticJobClient,
+            K8sScalePlanSource,
+        )
+
+        paths = []
+
+        def transport(method, path, body):
+            paths.append(path)
+            return 200, {"metadata": {"resourceVersion": "1"},
+                         "items": []}
+
+        def stream(path):
+            paths.append(path)
+            return iter(())  # immediate EOF
+
+        client = K8sElasticJobClient(
+            transport, stream_transport=stream
+        )
+        source = K8sScalePlanSource(client, job_name="job-a",
+                                    reconnect_delay=0.01)
+        source.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and len(paths) < 3:
+            time.sleep(0.02)
+        source.stop()
+        assert any("labelSelector=elasticjob-name%3Djob-a" in p
+                   or "labelSelector=elasticjob-name=job-a" in p
+                   for p in paths if "watch" not in p)
+        assert any("labelSelector" in p for p in paths
+                   if "watch=1" in p)
