@@ -17,7 +17,6 @@ dispatch. Fallback is the pure-numpy pool; behavior is identical.
 
 import ctypes
 import os
-import subprocess
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
@@ -105,13 +104,6 @@ def _threads_locked() -> int:
     return _THREADS
 
 
-def _csrc_dir() -> str:
-    return os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "ops", "csrc",
-    )
-
-
 def _native():
     """The C++ engine, built on first use; None when unavailable."""
     global _NATIVE, _NATIVE_TRIED
@@ -128,28 +120,22 @@ def _native_locked():
     _NATIVE_TRIED = True
     if os.getenv("DLROVER_TPU_DISABLE_NATIVE_COPY"):
         return None
-    so = os.path.join(_csrc_dir(), "libdtfastcopy.so")
-    if not os.path.exists(so):
-        try:
-            subprocess.run(
-                ["make", "-C", _csrc_dir()], check=True,
-                capture_output=True, timeout=120,
-            )
-        except Exception as e:
-            logger.info("native copy engine unavailable (%s); using the "
-                        "numpy pool", e)
-            return None
-    try:
-        lib = ctypes.CDLL(so)
-        lib.dt_copy_many.argtypes = [
-            ctypes.POINTER(_DtCopyTask), ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int32,
-        ]
-        lib.dt_copy_many.restype = None
-        _NATIVE = lib
-        logger.info("native copy engine loaded: %s", so)
-    except OSError as e:
-        logger.info("native copy engine failed to load (%s)", e)
+    # The general op-builder (ops/builder.py) owns build + staleness +
+    # load; this module owns only the symbol signatures.
+    from dlrover_tpu.ops.builder import get_op
+
+    lib = get_op("dtfastcopy")
+    if lib is None:
+        logger.info("native copy engine unavailable; using the "
+                    "numpy pool")
+        return None
+    lib.dt_copy_many.argtypes = [
+        ctypes.POINTER(_DtCopyTask), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32,
+    ]
+    lib.dt_copy_many.restype = None
+    _NATIVE = lib
+    logger.info("native copy engine loaded")
     return _NATIVE
 
 

@@ -155,6 +155,7 @@ class ScalePlanCRD:
     spec: ScaleSpec = field(default_factory=ScaleSpec)
     status: ScalePlanStatus = field(default_factory=ScalePlanStatus)
     resource_version: str = ""   # metadata.resourceVersion (watch resume)
+    uid: str = ""                # metadata.uid (identity across recreate)
 
     def to_manifest(self) -> Dict:
         meta = {
@@ -164,6 +165,8 @@ class ScalePlanCRD:
         }
         if self.resource_version:
             meta["resourceVersion"] = self.resource_version
+        if self.uid:
+            meta["uid"] = self.uid
         return {
             "apiVersion": API_VERSION,
             "kind": "ScalePlan",
@@ -182,6 +185,7 @@ class ScalePlanCRD:
             labels=dict(meta.get("labels", {})),
             spec=ScaleSpec.from_manifest(doc.get("spec", {})),
             resource_version=str(meta.get("resourceVersion", "")),
+            uid=str(meta.get("uid", "")),
         )
         out.status = ScalePlanStatus(
             create_time=status.get("createTime"),

@@ -271,7 +271,11 @@ class K8sScalePlanSource:
         self._queue: "queue.Queue[ScalePlanCRD]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._seen: set = set()  # plan names already queued (dedup)
+        # (name, uid) already queued — dedup across list/watch/re-list.
+        # uid changes when a plan is deleted and recreated under the
+        # same name, so recreations still realize. Insertion-ordered +
+        # capped: plans are transient, the set must not grow forever.
+        self._seen: Dict[Tuple[str, str], bool] = {}
         # reconciler contract; bounded — status write-back is update()
         self.applied = collections.deque(maxlen=64)
 
@@ -301,15 +305,27 @@ class K8sScalePlanSource:
         except queue.Empty:
             return None
 
-    def update(self, crd: ScalePlanCRD):
-        """Reconciler status write-back -> apiserver status subresource."""
-        try:
-            self._client.update_scaleplan_status(
-                crd.name, crd.status.phase, crd.status.finish_time
-            )
-        except Exception as e:
-            logger.warning("scaleplan %s status update failed: %s",
-                           crd.name, e)
+    def update(self, crd: ScalePlanCRD, attempts: int = 3):
+        """Reconciler status write-back -> apiserver status subresource.
+
+        Retried: a plan realized locally but left Pending at the
+        apiserver would be re-listed — and re-realized — by a restarted
+        master. (A crash between realize and the last retry is still
+        that hazard; exactly-once across master restarts needs the
+        realized nodes themselves as the source of truth.)"""
+        for i in range(attempts):
+            try:
+                self._client.update_scaleplan_status(
+                    crd.name, crd.status.phase, crd.status.finish_time
+                )
+                return
+            except Exception as e:
+                logger.warning(
+                    "scaleplan %s status update failed (%s/%s): %s",
+                    crd.name, i + 1, attempts, e,
+                )
+                if self._stop.wait(self._delay):
+                    return
 
     def _offer(self, plan: ScalePlanCRD):
         """Queue a plan at most once (a still-Pending plan can arrive
@@ -317,9 +333,12 @@ class K8sScalePlanSource:
         realizing it twice would double-launch its nodes)."""
         if self._stop.is_set() or not self._unrealized(plan):
             return
-        if plan.name in self._seen:
+        key = (plan.name, plan.uid)
+        if key in self._seen:
             return
-        self._seen.add(plan.name)
+        if len(self._seen) >= 4096:
+            self._seen.pop(next(iter(self._seen)))
+        self._seen[key] = True
         self._queue.put(plan)
 
     def _pump(self):
@@ -342,10 +361,19 @@ class K8sScalePlanSource:
                         return
                     if etype in ("ADDED", "MODIFIED"):
                         self._offer(plan)
-                # clean EOF: server closed the watch; reconnect from rv
+                # clean EOF: server closed the watch; reconnect from
+                # rv — throttled, or an instantly-closing stream (dead
+                # proxy) busy-loops the apiserver.
+                self._stop.wait(self._delay)
             except WatchExpired:
                 rv = ""  # too old: re-list
             except Exception as e:
+                if getattr(e, "code", None) == 410:
+                    # the apiserver may answer the watch GET itself
+                    # with HTTP 410 instead of a 200 stream carrying
+                    # an ERROR event: same meaning, re-list.
+                    rv = ""
+                    continue
                 logger.warning("scaleplan watch error: %s; retrying", e)
                 self._stop.wait(self._delay)
 

@@ -1,0 +1,134 @@
+"""Native op builder — build, cache, and load C++ extensions on use.
+
+Parity: the reference's op_builder framework
+(``atorch/atorch/ops/op_builder/`` — per-op builder classes that
+compile CUDA/C++ sources on first use and dlopen the result, with
+graceful degradation when no toolchain exists). The TPU runtime has no
+CUDA to build, but the same need exists for host-side native pieces
+(the checkpoint copy engine today, IO/codec helpers tomorrow):
+
+- an :class:`OpBuilder` names its sources and compile flags;
+- ``load()`` compiles on first use **and whenever a source is newer
+  than the built library** (mtime staleness — editing the .cpp never
+  ships a stale .so), then ``ctypes``-loads it;
+- results are cached per builder; a missing/broken toolchain returns
+  ``None`` so every native op keeps a pure-Python fallback;
+- ``DLROVER_TPU_DISABLE_NATIVE`` turns every builder off (the
+  reference's op-building kill switch).
+
+Builders register by name (:func:`register_builder`) and load via
+:func:`get_op` — the discovery surface the reference exposes through
+``op_builder.ALL_OPS``.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from dlrover_tpu.common.log import logger
+
+__all__ = ["OpBuilder", "register_builder", "get_op", "all_ops"]
+
+_LOCK = threading.Lock()
+_BUILDERS: Dict[str, "OpBuilder"] = {}
+
+
+class OpBuilder:
+    """One native extension: sources -> shared library -> ctypes CDLL."""
+
+    def __init__(self, name: str, sources: Sequence[str],
+                 output: str = "", extra_flags: Sequence[str] = ()):
+        self.name = name
+        self.sources = [os.path.abspath(s) for s in sources]
+        out_dir = os.path.dirname(self.sources[0])
+        self.output = output or os.path.join(
+            out_dir, f"lib{name}.so"
+        )
+        self.extra_flags = list(extra_flags)
+        self._lib: Optional[ctypes.CDLL] = None
+        self._tried = False
+
+    # ------------- build -------------
+    def stale(self) -> bool:
+        if not os.path.exists(self.output):
+            return True
+        built = os.path.getmtime(self.output)
+        return any(
+            os.path.exists(s) and os.path.getmtime(s) > built
+            for s in self.sources
+        )
+
+    def build_command(self) -> List[str]:
+        cxx = os.getenv("CXX", "g++")
+        return [
+            cxx, "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+            *self.extra_flags, "-o", self.output, *self.sources,
+        ]
+
+    def build(self) -> bool:
+        cmd = self.build_command()
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=300
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            logger.warning("op %s: toolchain unavailable (%s)",
+                           self.name, e)
+            return False
+        if proc.returncode != 0:
+            logger.warning("op %s: build failed:\n%s", self.name,
+                           proc.stderr[-2000:])
+            return False
+        logger.info("op %s: built %s", self.name, self.output)
+        return True
+
+    # ------------- load -------------
+    def load(self) -> Optional[ctypes.CDLL]:
+        """Build (if stale) and load; None = use the Python fallback."""
+        with _LOCK:
+            if self._tried:
+                return self._lib
+            self._tried = True
+            if os.getenv("DLROVER_TPU_DISABLE_NATIVE"):
+                return None
+            if self.stale() and not self.build():
+                return None
+            try:
+                self._lib = ctypes.CDLL(self.output)
+            except OSError as e:
+                logger.warning("op %s: load failed: %s", self.name, e)
+                self._lib = None
+            return self._lib
+
+
+def register_builder(builder: OpBuilder) -> OpBuilder:
+    _BUILDERS[builder.name] = builder
+    return builder
+
+
+def get_op(name: str) -> Optional[ctypes.CDLL]:
+    """Load a registered op by name (None when unbuildable)."""
+    builder = _BUILDERS.get(name)
+    if builder is None:
+        raise KeyError(
+            f"no op builder named {name!r}; registered: "
+            f"{sorted(_BUILDERS)}"
+        )
+    return builder.load()
+
+
+def all_ops() -> Dict[str, "OpBuilder"]:
+    return dict(_BUILDERS)
+
+
+def _csrc(name: str) -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "csrc", name)
+
+
+# ---- built-in ops ----
+register_builder(OpBuilder(
+    "dtfastcopy", sources=[_csrc("fastcopy.cpp")],
+))
