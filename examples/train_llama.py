@@ -1,17 +1,20 @@
 """LLaMA pretraining through the high-level Trainer.
 
 The "switch from the reference" demo: elastic launch + data-parallel
-sharded training in ~40 lines, with flash checkpointing one flag away
-(``--ckpt-dir``). For the master-fed elastic data path see
+sharded training in ~50 lines, with flash checkpointing one flag away
+(``--ckpt-dir``), a warmup-cosine schedule surfaced in the step logs,
+interleaved evaluation (``--eval-every``), and the HF-style callback
+hooks. For the master-fed elastic data path see
 ``train_tiny.py --use-dataloader``.
 
 Run::
 
     python -m dlrover_tpu.cli --standalone --nproc_per_node=1 \
-        examples/train_llama.py -- --steps 30
+        examples/train_llama.py -- --steps 30 --eval-every 10
 """
 
 import argparse
+import itertools
 
 import jax
 import numpy as np
@@ -20,7 +23,7 @@ import optax
 from dlrover_tpu import train as dtrain
 from dlrover_tpu.accel import ParallelSpec
 from dlrover_tpu.models.llama import Llama, LlamaConfig, loss_fn
-from dlrover_tpu.train.trainer import Trainer
+from dlrover_tpu.train.trainer import LoggingCallback, Trainer
 
 
 def main():
@@ -30,6 +33,7 @@ def main():
     parser.add_argument("--seq", type=int, default=128)
     parser.add_argument("--ckpt-dir", type=str, default="")
     parser.add_argument("--grad-accum", type=int, default=1)
+    parser.add_argument("--eval-every", type=int, default=0)
     parser.add_argument("--spec", type=str, default="auto",
                         help='"auto" lets the strategy search pick the '
                         'mesh (and reconfigure the model); "data" pins '
@@ -51,8 +55,12 @@ def main():
     def token_loss(module, params, batch):
         return loss_fn(module.apply({"params": params}, batch), batch)
 
-    def batches():
-        rng = np.random.default_rng(dtrain.global_rank())
+    def batches(seed_offset: int = 0):
+        # seed_offset=1 is the held-out eval stream: evaluation must
+        # score data the model has not trained on.
+        rng = np.random.default_rng(
+            dtrain.global_rank() + 100_000 * seed_offset
+        )
         while True:
             yield rng.integers(
                 0, cfg.vocab_size, (args.batch, args.seq), dtype=np.int32
@@ -60,15 +68,30 @@ def main():
 
     sample = next(batches())
     spec = "auto" if args.spec == "auto" else ParallelSpec(data=n_dev)
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, 3e-4, warmup_steps=10,
+        decay_steps=max(args.steps, 11),
+    )
     trainer = Trainer(
-        Llama(cfg), optax.adamw(3e-4), token_loss, sample,
+        Llama(cfg), optax.adamw(schedule), token_loss, sample,
         spec=spec,
         checkpoint_dir=args.ckpt_dir, persist_every=10,
         grad_accum=args.grad_accum,
+        callbacks=[LoggingCallback(every=10)],
+        lr_schedule=schedule,
     )
-    out = trainer.fit(batches(), steps=args.steps)
+    out = trainer.fit(
+        batches(), steps=args.steps,
+        eval_batches=(
+            (lambda: itertools.islice(batches(seed_offset=1), 2))
+            if args.eval_every else None
+        ),
+        eval_every=args.eval_every,
+    )
     print(f"rank {dtrain.global_rank()}: done at step {out['step']}, "
-          f"loss {out['loss']:.4f}", flush=True)
+          f"loss {out['loss']:.4f}"
+          + (f", eval {out['eval_loss']:.4f}" if "eval_loss" in out
+             else ""), flush=True)
     trainer.close()
 
 
