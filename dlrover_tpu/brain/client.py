@@ -17,7 +17,12 @@ from dlrover_tpu.master.scaling import ResourcePlan
 
 class BrainClient:
     def __init__(self, addr: str):
-        self._rpc = RpcClient(addr)
+        # Brain is advisory: degrade fast when it is unreachable
+        # instead of riding the master-failover retry window (short
+        # per-attempt timeouts too — a blackholed endpoint must not
+        # stall metric reporting for a minute).
+        self._rpc = RpcClient(addr, timeout=5.0, retry_deadline=2.0,
+                              connect_timeout=2.0)
 
     def persist_metrics(self, job_name: str, kind: str, payload: Dict):
         return self._rpc.call(

@@ -173,45 +173,102 @@ class RpcServer:
 
 
 class RpcClient:
-    """Persistent-connection client with automatic reconnect."""
+    """Persistent-connection client with automatic reconnect.
 
-    def __init__(self, addr: str, timeout: float = 60.0):
+    Connection-dead failures retry with backoff until
+    ``retry_deadline`` elapses — the master-failover contract: when the
+    master process dies and is relaunched at the same address (the
+    reference's operator relaunching the master pod), agents and
+    workers ride out the outage instead of crashing on the first
+    refused connection. Timeouts of in-flight requests are never
+    retried (the first attempt may still be executing server-side and a
+    retried envelope could miss the dedup cache).
+    """
+
+    def __init__(self, addr: str, timeout: float = 60.0,
+                 retry_deadline: float = 120.0,
+                 connect_timeout: float = 5.0):
         host, port = addr.rsplit(":", 1)
         self._addr: Tuple[str, int] = (host, int(port))
         self._timeout = timeout
+        self._retry_deadline = retry_deadline
+        self._connect_timeout = connect_timeout
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # First-failure timestamp of the CURRENT outage, shared by all
+        # threads on this client: every caller measures the retry
+        # window from the same start, so N threads queued on a dead
+        # master fail after ~retry_deadline total, not N x deadline.
+        self._down_since: Optional[float] = None
 
     def _connect(self):
-        s = socket.create_connection(self._addr, timeout=self._timeout)
+        # Short connect timeout: a dead pod IP that blackholes SYNs
+        # (no RST) must register as a retryable outage quickly, not eat
+        # the whole request timeout per attempt.
+        s = socket.create_connection(
+            self._addr, timeout=self._connect_timeout
+        )
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(self._timeout)
         self._sock = s
 
     def call(self, request: Any, timeout: Optional[float] = None) -> Any:
         envelope = (uuid.uuid4().hex, request)
-        with self._lock:
-            for attempt in (0, 1):
+        delay = 0.1
+        reported = False
+        while True:
+            outage_err = None
+            with self._lock:
                 try:
                     if self._sock is None:
+                        # Connect failures — including connect
+                        # TIMEOUTS (blackholed address) — sent
+                        # nothing: provably safe to retry.
                         self._connect()
-                    self._sock.settimeout(timeout or self._timeout)
-                    _send(self._sock, envelope)
-                    ok, payload = _recv(self._sock)
-                    break
-                except socket.timeout:
-                    # Never retry a timeout: the first attempt may still be
-                    # executing on the server, so a retried envelope could
-                    # miss the dedup cache and run the handler concurrently.
-                    self._close_locked()
-                    raise
-                except (ConnectionError, OSError, EOFError):
-                    # Safe to retry: the connection is dead (the server is
-                    # not still processing it) and the server dedups on the
-                    # request id, so a request that was applied before the
-                    # connection died is answered from cache, not re-applied.
-                    self._close_locked()
-                    if attempt:
+                except OSError as e:
+                    outage_err = e
+                if outage_err is None:
+                    try:
+                        self._sock.settimeout(timeout or self._timeout)
+                        _send(self._sock, envelope)
+                        ok, payload = _recv(self._sock)
+                        self._down_since = None
+                        break
+                    except socket.timeout:
+                        # Never retry an in-flight timeout: the attempt
+                        # may still be executing on the server, so a
+                        # retried envelope could miss the dedup cache
+                        # and run the handler concurrently.
+                        self._close_locked()
                         raise
+                    except (ConnectionError, OSError, EOFError) as e:
+                        # Safe to retry: the connection is dead (the
+                        # server is not still processing it) and the
+                        # server dedups on the request id, so a request
+                        # applied before the connection died is
+                        # answered from cache, not re-applied.
+                        self._close_locked()
+                        outage_err = e
+                now = time.monotonic()
+                if self._down_since is None:
+                    self._down_since = now
+                expired = (
+                    now + delay
+                    > self._down_since + self._retry_deadline
+                )
+            if expired:
+                raise outage_err
+            if not reported:
+                logger.warning(
+                    "master %s unreachable (%s); retrying for up to "
+                    "%.0f s", self._addr, outage_err,
+                    self._retry_deadline,
+                )
+                reported = True
+            # Sleep OUTSIDE the lock: other threads (heartbeat,
+            # monitors) must not serialize behind this backoff.
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
         if not ok:
             raise RuntimeError(f"master rejected {type(request).__name__}: {payload}")
         return payload

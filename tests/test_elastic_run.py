@@ -345,3 +345,82 @@ class TestElasticRun:
                     a.kill()
             master.terminate()
             master.wait(timeout=10)
+
+
+class TestMasterFailover:
+    def test_master_killed_and_relaunched_job_completes(self, tmp_path):
+        """The master is the one per-job singleton: kill it mid-run and
+        relaunch it at the same address (the reference's operator
+        relaunching the master pod). Workers ride out the outage via
+        the RPC client's retry window — the job must complete and the
+        RELAUNCHED master must see the success report and exit 0."""
+        job = f"mfail-{uuid.uuid4().hex[:6]}"
+        port_file = str(tmp_path / "port")
+
+        def start_master(port=0):
+            args = [
+                sys.executable, "-m", "dlrover_tpu.master.main",
+                "--node_num", "1", "--job_name", job,
+            ]
+            if port:
+                args += ["--port", str(port)]
+            else:
+                args += ["--port_file", port_file]
+            return subprocess.Popen(args, env=_env())
+
+        master = start_master()
+        agent = None
+        master2 = None
+        try:
+            deadline = time.monotonic() + 30
+            while not os.path.exists(port_file):
+                assert time.monotonic() < deadline, "master never started"
+                time.sleep(0.05)
+            with open(port_file) as f:
+                port = int(f.read().strip())
+            addr = f"127.0.0.1:{port}"
+
+            agent = subprocess.Popen(
+                [
+                    sys.executable, "-m", "dlrover_tpu.cli",
+                    "--nnodes=1", "--nproc_per_node=1",
+                    "--node_rank=0", f"--master_addr={addr}",
+                    f"--job_name={job}", "--monitor_interval=0.2",
+                    "--max_restarts=2",
+                    SCRIPT, "--", "--steps", "40",
+                    "--step-sleep", "0.25",
+                    "--ckpt-dir", str(tmp_path / "ckpts"),
+                    "--persist-every", "50",
+                ],
+                env=_env(), stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+            # Let the worker actually train (first flash snapshot lands),
+            # then kill the master mid-job.
+            import glob
+
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if glob.glob(f"/dev/shm/ckpt_{job}_n*_rank0"):
+                    break
+                time.sleep(0.5)
+            assert glob.glob(f"/dev/shm/ckpt_{job}_n*_rank0"), (
+                "worker never started saving snapshots"
+            )
+            time.sleep(2)
+            master.kill()
+            master.wait(timeout=10)
+            time.sleep(3)  # a real outage, not an instant flip
+            master2 = start_master(port=port)
+
+            out, _ = agent.communicate(timeout=240)
+            assert agent.returncode == 0, out[-4000:]
+            master2.wait(timeout=30)
+            assert master2.returncode == 0, (
+                "relaunched master did not exit success"
+            )
+        finally:
+            for p in (agent, master, master2):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
