@@ -56,11 +56,21 @@ class KvVariable:
         initializer: Optional[Callable] = None,
         seed: int = 0,
         max_capacity: Optional[int] = None,
+        host_capacity: Optional[int] = None,
+        disk_dir: str = "",
     ):
+        """``host_capacity`` + ``disk_dir`` enable the third tier
+        (parity: tfplus ``storage_table.h``'s hybrid DRAM/SSD storage):
+        when the host tier exceeds ``host_capacity`` entries, the
+        oldest-spilled rows move to an append-only log under
+        ``disk_dir`` and restore transparently on next touch —
+        device HBM > host RAM > disk, all behind one ``lookup``."""
         if capacity <= 0 or dim <= 0:
             raise ValueError("capacity and dim must be positive")
         if max_capacity is not None and max_capacity < capacity:
             raise ValueError("max_capacity must be >= capacity")
+        if host_capacity is not None and not disk_dir:
+            raise ValueError("host_capacity needs disk_dir to spill to")
         self.dim = dim
         self.dtype = dtype
         self._initializer = initializer or (
@@ -73,13 +83,67 @@ class KvVariable:
         self._slots: Dict[int, int] = {}     # id -> slot (device-resident)
         self._next_slot = 0
         self.table = self._init_rows(capacity)
-        # host tier: id -> (value_row, {listener_name: payload_row})
+        # host tier: id -> (value_row, {listener_name: payload_row});
+        # insertion order == spill order (oldest first) for disk demote.
         self._host_store: Dict[int, tuple] = {}
         # LRU order: oldest-touched first (OrderedDict keyed by id).
         from collections import OrderedDict
 
         self._lru: "OrderedDict[int, None]" = OrderedDict()
         self._listeners: Dict[str, object] = {}
+        # disk tier
+        self._host_capacity = host_capacity
+        self._disk_index: Dict[int, tuple] = {}   # id -> (offset, length)
+        self._disk_path = ""
+        self._disk_file = None
+        if disk_dir:
+            import os
+
+            os.makedirs(disk_dir, exist_ok=True)
+            self._disk_path = os.path.join(disk_dir, "kv_spill.log")
+            self._disk_file = open(self._disk_path, "a+b")
+
+    # ------------- disk tier -------------
+    def _demote_to_disk(self):
+        """Move the oldest host-tier entries to the append-only log
+        until the host tier fits. Overwritten/removed entries leak log
+        space by design (an LSM-style compactor is the reference's
+        ~21 kLoC answer; the capability here is capacity, not GC)."""
+        if self._host_capacity is None or self._disk_file is None:
+            return
+        import pickle
+
+        while len(self._host_store) > self._host_capacity:
+            key = next(iter(self._host_store))
+            entry = self._host_store.pop(key)
+            blob = pickle.dumps(entry, protocol=pickle.HIGHEST_PROTOCOL)
+            self._disk_file.seek(0, 2)
+            off = self._disk_file.tell()
+            self._disk_file.write(blob)
+            self._disk_index[key] = (off, len(blob))
+        self._disk_file.flush()
+
+    def _take_spilled(self, key: int) -> tuple:
+        """Pop a spilled entry from whichever tier holds it."""
+        if key in self._host_store:
+            return self._host_store.pop(key)
+        import pickle
+
+        off, length = self._disk_index.pop(key)
+        self._disk_file.seek(off)
+        return pickle.loads(self._disk_file.read(length))
+
+    def _peek_spilled_disk(self, key: int) -> tuple:
+        """Read a disk entry WITHOUT popping it (export path: a
+        checkpoint is read-only and must not rewrite the log)."""
+        import pickle
+
+        off, length = self._disk_index[key]
+        self._disk_file.seek(off)
+        return pickle.loads(self._disk_file.read(length))
+
+    def _spilled_contains(self, key: int) -> bool:
+        return key in self._host_store or key in self._disk_index
 
     # ------------- slot listeners (optimizer tables) -------------
     def attach_slot_listener(self, name: str, listener):
@@ -151,7 +215,7 @@ class KvVariable:
             key = int(raw)
             slot = self._slots.get(key)
             if slot is None:
-                known = key in self._host_store
+                known = self._spilled_contains(key)
                 if not allocate and not known:
                     out[i] = -1
                     continue
@@ -205,7 +269,7 @@ class KvVariable:
                 self._host_store[key] = (rows[i], per_key)
         if restore:
             slots_arr = np.asarray([s for _, s in restore])
-            stored = [self._host_store.pop(k) for k, _ in restore]
+            stored = [self._take_spilled(k) for k, _ in restore]
             self.table = self.table.at[jnp.asarray(slots_arr)].set(
                 jnp.asarray(
                     np.stack([row for row, _ in stored]),
@@ -242,6 +306,10 @@ class KvVariable:
             )
             for listener in self._listeners.values():
                 listener.reset_rows(slots_arr)
+        # Demote AFTER restores popped their keys: demoting first could
+        # push a restore-pending key to disk only to read it right back
+        # (and leak a dead blob).
+        self._demote_to_disk()
 
     def lookup(self, ids, allocate: bool = True):
         """Gather rows for ids; shape ``ids.shape + (dim,)``. Unknown ids
@@ -273,7 +341,8 @@ class KvVariable:
     # ------------- introspection / checkpoint -------------
     @property
     def size(self) -> int:
-        return len(self._slots) + len(self._host_store)
+        return (len(self._slots) + len(self._host_store)
+                + len(self._disk_index))
 
     @property
     def resident_size(self) -> int:
@@ -281,7 +350,11 @@ class KvVariable:
 
     @property
     def spilled_size(self) -> int:
-        return len(self._host_store)
+        return len(self._host_store) + len(self._disk_index)
+
+    @property
+    def disk_size(self) -> int:
+        return len(self._disk_index)
 
     @property
     def capacity(self) -> int:
@@ -308,11 +381,16 @@ class KvVariable:
             values[: len(res_ids)] = np.asarray(jnp.take(
                 self.table, jnp.asarray(slots), axis=0
             ))
-        for i, (key, (row, _)) in enumerate(
-            self._host_store.items(), start=len(self._slots)
-        ):
+        i = len(self._slots)
+        for key, (row, _) in self._host_store.items():
             ids[i] = key
             values[i] = row
+            i += 1
+        for key in self._disk_index:
+            row, _ = self._peek_spilled_disk(key)  # read-only
+            ids[i] = key
+            values[i] = row
+            i += 1
         return ids, values
 
     def import_(self, ids, values):
@@ -330,6 +408,10 @@ class KvVariable:
         self._capacity = cap
         self.table = self._init_rows(cap)
         self._host_store = {}
+        self._disk_index = {}
+        if self._disk_file is not None:
+            # fresh log: the old index is void
+            self._disk_file.truncate(0)
         n_resident = min(len(ids), cap)
         self._slots = {
             int(k): i for i, k in enumerate(ids[:n_resident])
@@ -342,6 +424,9 @@ class KvVariable:
             )
         for k, row in zip(ids[n_resident:], values[n_resident:]):
             self._host_store[int(k)] = (np.asarray(row), {})
+        # A restore larger than host_capacity must not sit in RAM — the
+        # exact OOM the disk tier exists to prevent.
+        self._demote_to_disk()
 
 
 class SparseAdam:

@@ -371,3 +371,75 @@ class TestVocabChurnScale:
         assert elapsed < 300, f"churn took {elapsed:.1f}s"
         ids_, _ = var.export()
         assert len(ids_) == len(seen)
+
+
+class TestDiskTier:
+    """Third storage tier (parity: tfplus storage_table.h hybrid
+    DRAM/SSD): device HBM > host RAM > disk, one lookup surface."""
+
+    def test_three_tier_spill_and_restore(self, tmp_path):
+        kv = KvVariable(dim=4, capacity=4, max_capacity=4,
+                        host_capacity=3, disk_dir=str(tmp_path),
+                        seed=1)
+        # Touch 12 ids: 4 resident, 3 host, 5 on disk.
+        first = {}
+        for i in range(12):
+            first[i] = np.asarray(kv.lookup([i]))[0].copy()
+        assert kv.resident_size == 4
+        assert kv.spilled_size == 8
+        assert kv.disk_size == 5
+        assert kv.size == 12
+        # Every id restores bit-exact from whichever tier held it.
+        for i in range(12):
+            np.testing.assert_array_equal(
+                np.asarray(kv.lookup([i]))[0], first[i]
+            )
+
+    def test_disk_rows_keep_their_values_through_updates(self, tmp_path):
+        kv = KvVariable(dim=2, capacity=2, max_capacity=2,
+                        host_capacity=1, disk_dir=str(tmp_path))
+        kv.lookup([0, 1])
+        kv.scatter_update([0, 1], np.array([[1., 1.], [2., 2.]]))
+        kv.lookup([2, 3])   # 0,1 spill; one of them lands on disk
+        kv.lookup([4, 5])   # deeper churn
+        assert kv.disk_size >= 1
+        np.testing.assert_array_equal(
+            np.asarray(kv.lookup([0]))[0], [1., 1.]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(kv.lookup([1]))[0], [2., 2.]
+        )
+
+    def test_export_includes_disk_tier(self, tmp_path):
+        kv = KvVariable(dim=2, capacity=2, max_capacity=2,
+                        host_capacity=1, disk_dir=str(tmp_path))
+        for i in range(8):
+            kv.lookup([i])
+        ids, values = kv.export()
+        assert sorted(ids.tolist()) == list(range(8))
+        kv2 = KvVariable(dim=2, capacity=2)
+        kv2.import_(ids, values)
+        for i, row in zip(ids, values):
+            np.testing.assert_array_equal(
+                np.asarray(kv2.lookup([int(i)]))[0], row
+            )
+
+    def test_optimizer_slots_survive_disk_trip(self, tmp_path):
+        kv = KvVariable(dim=2, capacity=2, max_capacity=2,
+                        host_capacity=1, disk_dir=str(tmp_path))
+        opt = SparseAdam(kv, lr=0.1)
+        ids = np.array([0, 1])
+        kv.lookup(ids)
+        opt.update(ids, np.ones((2, 2), np.float32))
+        m_before = opt.extract_rows(kv.to_slots(ids))["m"].copy()
+        # push 0 and 1 through host AND disk tiers
+        kv.lookup([2, 3])
+        kv.lookup([4, 5])
+        assert kv.disk_size >= 1
+        kv.lookup(ids)  # restore both
+        m_after = opt.extract_rows(kv.to_slots(ids))["m"]
+        np.testing.assert_allclose(m_after, m_before)
+
+    def test_host_capacity_requires_disk_dir(self):
+        with pytest.raises(ValueError, match="disk_dir"):
+            KvVariable(dim=2, capacity=2, host_capacity=1)
