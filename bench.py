@@ -300,6 +300,57 @@ def section_medium(peak):
             f"({row['int8_speedup']}x vs bf16)")
     except Exception as e:
         log(f"bench[medium]: int8 row skipped ({e})")
+
+    # ---- async step pipeline A/B (docs/async_pipeline.md): the same
+    # Trainer.fit loop, sync (device_put + float(loss) every step) vs
+    # pipelined (double-buffered device prefetch + lag-1 readback).
+    # Host batches are fresh numpy arrays so every step pays a real
+    # H2D transfer — the traffic the prefetcher exists to hide. ----
+    try:
+        import numpy as np
+        import optax
+
+        from dlrover_tpu.accel import ParallelSpec
+        from dlrover_tpu.models.gpt import GPT, loss_fn
+        from dlrover_tpu.train.trainer import Trainer
+
+        def token_loss(module, params, b):
+            return loss_fn(module.apply({"params": params}, b), b)
+
+        rng = np.random.default_rng(0)
+
+        def host_batches(n):
+            for _ in range(n):
+                yield rng.integers(
+                    0, cfg.vocab_size, (8, cfg.max_seq_len),
+                    dtype=np.int32,
+                )
+
+        trainer = Trainer(
+            GPT(cfg), optax.adamw(3e-4, weight_decay=0.1), token_loss,
+            next(iter(host_batches(1))), spec=ParallelSpec(data=1),
+            report_metrics=False,
+        )
+        trainer.fit(host_batches(1), steps=1, start_step=0,
+                    pipeline=False)  # compile outside the timed arms
+        n = 6
+        t0 = time.perf_counter()
+        trainer.fit(host_batches(n), steps=n, start_step=0,
+                    pipeline=False)
+        sync_s = (time.perf_counter() - t0) / n
+        t0 = time.perf_counter()
+        trainer.fit(host_batches(n), steps=n, start_step=0,
+                    pipeline=True)
+        async_s = (time.perf_counter() - t0) / n
+        del trainer
+        row["pipeline_sync_ms"] = round(sync_s * 1e3, 1)
+        row["pipeline_async_ms"] = round(async_s * 1e3, 1)
+        row["pipeline_speedup"] = round(sync_s / async_s, 3)
+        log(f"bench[medium]: pipeline {row['pipeline_async_ms']}ms vs "
+            f"sync {row['pipeline_sync_ms']}ms "
+            f"({row['pipeline_speedup']}x)")
+    except Exception as e:
+        log(f"bench[medium]: pipeline A/B skipped ({e})")
     return row
 
 

@@ -52,8 +52,10 @@ class ParallelSpec:
             if getattr(self, name) > 1
         ]
 
-    def rules(self):
-        return logical_rules(**dataclasses.asdict(self))
+    def rules(self, vocab_size: int = 0):
+        return logical_rules(
+            **dataclasses.asdict(self), vocab_size=vocab_size
+        )
 
 
 @dataclass
@@ -283,7 +285,11 @@ def auto_accelerate(
         mesh = create_mesh(
             sp.axes() or [("data", 1)], devices=devices[: sp.total]
         )
-        rules = sp.rules()
+        rules = sp.rules(
+            vocab_size=getattr(
+                getattr(mod, "cfg", None), "vocab_size", 0
+            ) or 0
+        )
 
         def init_fn(r):
             variables = mod.init(r, sample_batch)

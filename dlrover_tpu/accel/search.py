@@ -119,6 +119,8 @@ class ModelProfile:
     mlp_int8: bool = False           # AQT int8 MLP matmuls are ACTIVE
     vocab_params: int = 0            # embed (+ untied head) params that
                                      # live outside the layer stack
+    expert_ffn_params: int = 0       # expert-sharded FFN params (all
+                                     # layers, all experts); 0 when dense
     dtype_bytes: int = 2             # activation dtype (bf16)
     state_bytes_per_param: float = 16.0  # fp32 param + adam m/v + grad
     flops_per_token: float = 0.0
@@ -131,6 +133,22 @@ class ModelProfile:
         if count is None:
             count = int(cfg.param_count())
         fields = {f.name for f in dataclasses.fields(cfg)}
+        # Expert-sharded FFN params: only these divide by the expert
+        # degree in per-device residency. Llama's SwiGLU has three
+        # bias-free projections (gate/up/down = 3*d*f); GPT's MLP is two
+        # biased denses (2*d*f + f + d). The router (d*num_experts) is
+        # expert-REPLICATED, so it stays out.
+        n_exp = getattr(cfg, "num_experts", 0)
+        d = getattr(cfg, "d_model", 0)
+        f_dim = getattr(cfg, "ff_dim", 0)
+        per_expert = (
+            3 * d * f_dim if "num_kv_heads" in fields
+            else 2 * d * f_dim + f_dim + d
+        )
+        expert_ffn = (
+            getattr(cfg, "num_layers", 0) * n_exp * per_expert
+            if n_exp > 1 else 0
+        )
         return ModelProfile(
             param_count=count,
             num_layers=getattr(cfg, "num_layers", 0),
@@ -152,6 +170,7 @@ class ModelProfile:
                 else getattr(cfg, "vocab_size", 0)
                 * getattr(cfg, "d_model", 0)
             ),
+            expert_ffn_params=expert_ffn,
             flops_per_token=(
                 float(cfg.flops_per_token())
                 if hasattr(cfg, "flops_per_token") else 6.0 * count
@@ -397,9 +416,16 @@ def estimate(
         # params (embedding, position table, untied LM head — exact
         # count from the config's vocab_param_count, which knows about
         # head tying) run once per step outside the pipe.
+        # Only the expert-sharded FFN weights divide by the expert
+        # degree; attention / norms / router are expert-replicated, so
+        # dividing the WHOLE stack by spec.expert undercounted the
+        # floor and made deep-pipe + high-EP specs look free.
         layer_params = max(p.param_count - p.vocab_params, 0.0)
-        resident_b = dtype_b * layer_params / (
-            spec.pipe * spec.tensor * spec.expert
+        expert_ffn = min(float(p.expert_ffn_params), layer_params)
+        dense_params = layer_params - expert_ffn
+        resident_b = dtype_b * (
+            dense_params / (spec.pipe * spec.tensor)
+            + expert_ffn / (spec.pipe * spec.tensor * spec.expert)
         )
         hbm_s = 3.0 * (m + spec.pipe - 1) * resident_b / hbm_bw
 

@@ -31,12 +31,14 @@ def logical_rules(
     seq: int = 1,
     expert: int = 1,
     pipe: int = 1,
+    vocab_size: int = 0,
 ) -> List[Tuple[str, Any]]:
     """Build flax logical-axis rules for the given parallel degrees.
 
     Only axes with degree > 1 appear in the rules — a rule naming a mesh
     axis that doesn't exist in the Mesh raises in flax, so callers pass the
-    same degrees they built the mesh with.
+    same degrees they built the mesh with. ``vocab_size`` (when known)
+    guards the vocab rule's divisibility; 0 keeps the unguarded rules.
     """
     batch_axes = [a for a, n in (("data", data), ("fsdp", fsdp)) if n > 1]
     # Vocab shards over tensor AND pipe: under pipeline parallelism the
@@ -49,6 +51,20 @@ def logical_rules(
     vocab_axes = [
         a for a, n in (("tensor", tensor), ("pipe", pipe)) if n > 1
     ]
+    vocab_shard = tensor * pipe
+    if vocab_axes and vocab_size and vocab_size % vocab_shard:
+        # The searched path never proposes this (enumerate_specs guards
+        # divisibility), but an explicit spec with e.g. GPT-2's 50257
+        # (prime-ish) vocab would get an uneven shard that fails at
+        # materialization. Replicating the vocab axis is the previous,
+        # correct placement — pay the memory, keep the job running.
+        logger.warning(
+            "vocab %s is not divisible by tensor*pipe=%s; replicating "
+            "the vocab axis instead of sharding it (costs V x d_model "
+            "per device — pad the vocab to a multiple of %s to shard)",
+            vocab_size, vocab_shard, vocab_shard,
+        )
+        vocab_axes = []
     rules: List[Tuple[str, Any]] = [
         ("batch", tuple(batch_axes) if batch_axes else None),
         ("layers", None),

@@ -288,12 +288,16 @@ class ForkServer:
                 else:
                     self._pending.append(msg)
 
-    def _take_reply(self, timeout: float = 30.0) -> Dict:
+    def _take_reply(self, token: int, timeout: float = 30.0) -> Dict:
+        # Match by the echoed token, not FIFO order: two threads calling
+        # spawn() concurrently would otherwise each pop whichever reply
+        # landed first and hand back the OTHER spawn's pid.
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                if self._pending:
-                    return self._pending.pop(0)
+                for i, msg in enumerate(self._pending):
+                    if msg.get("token") == token:
+                        return self._pending.pop(i)
             time.sleep(0.005)
         raise TimeoutError("fork server did not answer")
 
@@ -311,7 +315,7 @@ class ForkServer:
             "log_path": log_path or None,
             "token": token,
         })
-        reply = self._take_reply()
+        reply = self._take_reply(token)
         return ForkedWorker(int(reply["pid"]), token, self)
 
     def exit_code(self, token: int) -> Optional[int]:

@@ -17,6 +17,7 @@ real apiserver is a transport swap, not a rewrite.
 import json
 import queue
 import threading
+import urllib.parse
 from dataclasses import dataclass
 from typing import (
     Callable,
@@ -179,7 +180,9 @@ class K8sElasticJobClient:
         resumes from (the k8s list+watch contract)."""
         path = self._path("scaleplans")
         if label_selector:
-            path += f"?labelSelector={label_selector}"
+            # Selectors contain '=' and ','; encode so e.g. "app=x,tier=y"
+            # survives the query string intact.
+            path += "?labelSelector=" + urllib.parse.quote(label_selector)
         status, body = self._send("GET", path, None)
         if status >= 300:
             raise RuntimeError(f"list scaleplans: HTTP {status}")
@@ -208,7 +211,7 @@ class K8sElasticJobClient:
         if resource_version:
             path += f"&resourceVersion={resource_version}"
         if label_selector:
-            path += f"&labelSelector={label_selector}"
+            path += "&labelSelector=" + urllib.parse.quote(label_selector)
         for line in self._stream(path):
             event = json.loads(line)
             if event.get("type") == "ERROR":

@@ -14,6 +14,7 @@ from dlrover_tpu.train.data.data_service import (
     ShmBatchRing,
 )
 from dlrover_tpu.train.data.dataloader import ElasticDataLoader
+from dlrover_tpu.train.data.device_prefetch import DevicePrefetchIterator
 from dlrover_tpu.train.data.sampler import ElasticSampler
 from dlrover_tpu.train.data.sharding_client import (
     IndexShardingClient,
@@ -24,6 +25,7 @@ __all__ = [
     "CoworkerDataService",
     "CoworkerTaskError",
     "ShmBatchRing",
+    "DevicePrefetchIterator",
     "ElasticDataLoader",
     "ElasticSampler",
     "IndexShardingClient",

@@ -10,17 +10,26 @@ train step (``lax.scan`` over microbatches in
 ``accel.make_train_step(grad_accum=...)``), so one call = one optimizer
 update at the full global batch regardless of world size.
 
-Usage::
+Usage (the async-pipeline idiom, docs/async_pipeline.md)::
 
     trainer = ElasticTrainer(global_batch_size=512, micro_batch_size=8)
     result = trainer.prepare(model, optimizer, sample_micro_batch,
                              token_loss, spec=ParallelSpec(data=8))
-    # per call: feed accum_steps * micro_batch_size samples
-    state, metrics = result.train_step(state, local_batch)
+    # per call: feed accum_steps * micro_batch_size samples.
+    # device_prefetch keeps batches already on device; DeferredMetrics
+    # reads the loss back lag-1 so the host never blocks on the step it
+    # just dispatched.
+    deferred = trainer.deferred_metrics()
+    for step, batch in enumerate(trainer.device_prefetch(host_batches)):
+        state, metrics = result.train_step(state, batch)
+        prev = deferred.push(step, metrics)     # -> step-1's host floats
+        if prev is not None:
+            log_step(*prev)
+    tail = deferred.flush()                     # last step's values
 """
 
 import os
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Iterable, Optional
 
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import logger
@@ -52,6 +61,7 @@ class ElasticTrainer:
         self.accum_steps = global_batch_size // (
             micro_batch_size * self.world_size
         )
+        self.result = None  # set by prepare()
         logger.info(
             "elastic trainer: global batch %s = micro %s x world %s x "
             "accum %s", global_batch_size, micro_batch_size,
@@ -78,7 +88,36 @@ class ElasticTrainer:
             np.asarray(sample_micro_batch),
             self.accum_steps, axis=0,
         ) if self.accum_steps > 1 else sample_micro_batch
-        return auto_accelerate(
+        self.result = auto_accelerate(
             module, optimizer, sample_local, loss, spec=spec,
             grad_accum=self.accum_steps, **accel_kwargs,
         )
+        return self.result
+
+    # ------------- async step pipeline -------------
+    def device_prefetch(self, batches: Iterable, depth: int = 2):
+        """Wrap a host batch iterator so ``depth`` local batches are
+        already ``device_put`` to the prepared step's batch sharding
+        while the current step runs (requires :meth:`prepare`). On an
+        elastic restart, call ``.swap(new_batches)`` on the returned
+        iterator to discard in-flight batches from the old world."""
+        if self.result is None:
+            raise RuntimeError(
+                "device_prefetch needs the prepared train step — call "
+                "prepare() first"
+            )
+        from dlrover_tpu.train.data.device_prefetch import (
+            DevicePrefetchIterator,
+        )
+
+        return DevicePrefetchIterator(
+            batches, self.result.batch_sharding, depth=depth
+        )
+
+    @staticmethod
+    def deferred_metrics():
+        """Lag-1 metric readback buffer for a hand-rolled step loop —
+        see :class:`dlrover_tpu.train.metrics.DeferredMetrics`."""
+        from dlrover_tpu.train.metrics import DeferredMetrics
+
+        return DeferredMetrics()

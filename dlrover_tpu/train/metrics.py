@@ -1,0 +1,86 @@
+"""Deferred (lag-1) metric readback for the async step pipeline.
+
+Under JAX's async dispatch the host thread returns from a jitted train
+step long before the device finishes it; calling ``float(loss)`` every
+step forces a full device sync per step and serializes the pipeline.
+The lag-1 protocol keeps the pipeline full: the loop *pushes* step N's
+device metrics and *receives* step N-1's values as host floats — by the
+time the host blocks on step N-1, step N is already running and the
+loop dispatches N+1 immediately after, so the device never starves.
+
+``DeferredMetrics`` is the reusable piece: ``Trainer.fit`` uses it
+internally and ``ElasticTrainer`` users drive it directly::
+
+    deferred = DeferredMetrics()
+    for step, batch in enumerate(prefetched):
+        state, metrics = train_step(state, batch)     # async dispatch
+        prev = deferred.push(step, metrics)           # lag-1 fence
+        if prev is not None:
+            done_step, host = prev                    # plain floats
+            log(done_step, host["loss"])
+    tail = deferred.flush()                           # last step's values
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["DeferredMetrics", "batch_token_count"]
+
+
+class DeferredMetrics:
+    """One-slot lag-1 buffer of device metrics.
+
+    ``push(step, metrics)`` stores this step's (device-resident) metric
+    pytree and returns the *previous* push as ``(step, {name: float})``
+    — reading the previous step's scalars blocks only until that step
+    completes, which overlaps the step just dispatched. ``flush()``
+    reads whatever is pending (call it after the loop, and before any
+    boundary that must observe up-to-date metrics).
+    """
+
+    def __init__(self):
+        self._pending: Optional[Tuple[int, Dict[str, Any]]] = None
+
+    def push(self, step: int,
+             metrics: Dict[str, Any]) -> Optional[Tuple[int, Dict]]:
+        prev = self.flush()
+        self._pending = (int(step), dict(metrics))
+        return prev
+
+    def flush(self) -> Optional[Tuple[int, Dict]]:
+        if self._pending is None:
+            return None
+        step, metrics = self._pending
+        self._pending = None
+        host: Dict[str, Any] = {}
+        for name, value in metrics.items():
+            try:
+                host[name] = float(value)
+            except (TypeError, ValueError):
+                host[name] = value  # non-scalar: hand back as-is
+        return step, host
+
+    @property
+    def pending_step(self) -> Optional[int]:
+        return self._pending[0] if self._pending is not None else None
+
+
+def batch_token_count(batch: Any) -> int:
+    """Total elements across a batch pytree — the tokens/s basis.
+
+    ``np.prod(np.shape(batch))`` is 1 for dict batches (np.shape of a
+    dict is ``()``), which silently turned tokens_per_s into
+    1/step_time; summing leaf sizes handles arrays, tuples and dicts
+    uniformly.
+    """
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(batch):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        n = 1
+        for dim in shape:
+            n *= int(dim)
+        total += n
+    return total

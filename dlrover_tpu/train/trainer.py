@@ -36,7 +36,27 @@ from dlrover_tpu.common.log import logger
 class TrainerCallback:
     """Hook points mirroring the reference's HF-style callbacks. Any
     hook may set ``trainer.should_stop = True`` to end ``fit`` after
-    the current step (early stopping, budget exhaustion, ...)."""
+    the current step (early stopping, budget exhaustion, ...).
+
+    Metric semantics under the async pipeline (``fit(pipeline=True)``,
+    the default — see docs/async_pipeline.md):
+
+    - ``metrics["loss"]`` is this step's loss as a ``jax.Array``.
+      Reading it (``float(...)`` or formatting) synchronizes on the
+      *current* step — do that only at your own cadence (the built-in
+      ``LoggingCallback`` reads it every ``every`` steps), never
+      unconditionally, or you serialize the pipeline you paid for.
+    - ``metrics["loss_lag1"]`` is the *previous* step's loss as a plain
+      float (None on the first step). It is free: the loop already read
+      it as its lag-1 pacing fence while the current step ran on
+      device. Prefer it for per-step consumers (metric shippers,
+      convergence monitors) that don't need this very step's value.
+    - ``metrics["step_time_s"]`` is the host wall time between
+      consecutive lag-1 fences — in steady state the true device step
+      time, not the (microseconds) async-dispatch time.
+
+    With ``pipeline=False`` the loop syncs every step and
+    ``metrics["loss"]`` is a plain float (``loss_lag1`` is absent)."""
 
     def on_train_begin(self, trainer, start_step: int):
         pass
@@ -177,15 +197,28 @@ class Trainer:
                     self.batch_sharding,
                 ),
             )
+        import itertools
+
+        from dlrover_tpu.train.data.device_prefetch import (
+            DevicePrefetchIterator,
+        )
+
+        # Device-side accumulation + prefetch: one host sync for the
+        # whole eval stream instead of one per batch. max_batches is
+        # applied on the host side so the prefetcher never consumes
+        # batches past the limit from a caller's iterator.
+        src = (
+            itertools.islice(batches, max_batches) if max_batches
+            else batches
+        )
         total, n = 0.0, 0
-        for batch in batches:
-            if max_batches and n >= max_batches:
-                break
-            batch = jax.device_put(batch, self.batch_sharding)
-            total += float(self._eval_step(self.state["params"], batch))
+        for batch in DevicePrefetchIterator(
+            src, self.batch_sharding, depth=2
+        ):
+            total = total + self._eval_step(self.state["params"], batch)
             n += 1
         out = {
-            "eval_loss": total / max(n, 1),
+            "eval_loss": float(total) / max(n, 1),
             "eval_batches": n,
         }
         return out
@@ -194,7 +227,9 @@ class Trainer:
             start_step: Optional[int] = None,
             eval_batches: Optional[Callable[[], Iterable]] = None,
             eval_every: int = 0,
-            eval_max_batches: int = 0) -> dict:
+            eval_max_batches: int = 0,
+            pipeline: bool = True,
+            prefetch_depth: int = 2) -> dict:
         """Run the loop; returns {'step': last, 'loss': last[, 'eval_loss']}.
 
         ``batches`` yields device-puttable batches; the loop consumes one
@@ -202,24 +237,55 @@ class Trainer:
         when a callback sets ``should_stop``. ``eval_batches`` is a
         zero-arg callable returning a fresh eval iterable (evaluated
         every ``eval_every`` steps and once at the end).
+
+        ``pipeline=True`` (default) runs the async step pipeline
+        (docs/async_pipeline.md): batches are double-buffered onto the
+        device ahead of the step that consumes them
+        (:class:`~dlrover_tpu.train.data.DevicePrefetchIterator`,
+        ``prefetch_depth`` in flight), the loss stays a ``jax.Array``
+        (read back lag-1 as the pacing fence), and the host never
+        blocks on the *current* step except at explicit boundaries —
+        the logging cadence of a callback that reads ``metrics["loss"]``,
+        eval, DISK persists, and the final step. The computed loss
+        trajectory is bit-identical to ``pipeline=False``; only when
+        values are read back changes (see :class:`TrainerCallback`).
+        ``pipeline=False`` is the reference synchronous loop:
+        ``device_put`` inside the step context and a full device sync
+        per step — the A/B baseline (bench.py measures both).
         """
         import contextlib
 
         import jax
-        import numpy as np
 
         from dlrover_tpu import train as dtrain
         from dlrover_tpu.train import report_training_metrics
         from dlrover_tpu.train.checkpoint import StorageType
+        from dlrover_tpu.train.data.device_prefetch import (
+            DevicePrefetchIterator,
+        )
+        from dlrover_tpu.train.metrics import (
+            DeferredMetrics,
+            batch_token_count,
+        )
 
         start = self.restore() if start_step is None else start_step
-        it = iter(batches)
-        last_loss = float("nan")
+        if pipeline:
+            it = (
+                batches if isinstance(batches, DevicePrefetchIterator)
+                else DevicePrefetchIterator(
+                    batches, self.batch_sharding, depth=prefetch_depth
+                )
+            )
+        else:
+            it = iter(batches)
+        deferred = DeferredMetrics()
+        last_loss: Any = float("nan")
         last_eval: dict = {}
         evaluated_at = -1
         done = start
         self.should_stop = False  # a previous fit's stop must not leak
         self._fire("on_train_begin", start)
+        t_mark = time.perf_counter()
         for step in range(start, steps):
             try:
                 batch = next(it)
@@ -232,16 +298,29 @@ class Trainer:
             )
             t_step0 = time.perf_counter()
             with ctx:
-                batch = jax.device_put(batch, self.batch_sharding)
+                if not pipeline:
+                    batch = jax.device_put(batch, self.batch_sharding)
                 self.state, metrics = self.train_step(self.state, batch)
+                if self._profiler is not None:
+                    # Honored only when the profiler runs in sync mode;
+                    # otherwise it records async-dispatch time and says so.
+                    self._profiler.fence(metrics["loss"])
             done = step + 1
             if self._ckpt is not None:
                 if self._persist_every and done % self._persist_every == 0:
+                    # DISK persist: an explicit boundary — the engine
+                    # fetches the (dispatched) state; the runtime orders
+                    # those reads after the step that produced it.
                     self._ckpt.save_checkpoint(
                         done, self.state, StorageType.DISK
                     )
                     self._fire("on_save", done, "disk")
                 else:
+                    # MEMORY snapshot: dispatch-only (~ms). The engine
+                    # device_puts engine-owned copies of the new state
+                    # *before* this thread dispatches step N+1, so a
+                    # later donated step can never invalidate the
+                    # snapshot even with the loop running ahead.
                     self._ckpt.save_checkpoint(
                         done, self.state, StorageType.MEMORY
                     )
@@ -253,11 +332,25 @@ class Trainer:
                         pass
                 report_training_metrics(done)
             last_loss = metrics["loss"]
-            step_metrics = {
-                "loss": float(last_loss),
-                "step_time_s": time.perf_counter() - t_step0,
-            }
-            tokens = int(np.prod(np.shape(batch)))
+            if pipeline:
+                # Lag-1 fence: block on step N-1 (already finished or
+                # finishing while step N runs), never on step N. This
+                # paces the host to the device rate, which also makes
+                # the inter-fence wall time an honest step time.
+                prev = deferred.push(done, {"loss": last_loss})
+                now = time.perf_counter()
+                step_metrics = {
+                    "loss": last_loss,  # device array: sync if read
+                    "loss_lag1": prev[1]["loss"] if prev else None,
+                    "step_time_s": now - t_mark,
+                }
+                t_mark = now
+            else:
+                step_metrics = {
+                    "loss": float(last_loss),
+                    "step_time_s": time.perf_counter() - t_step0,
+                }
+            tokens = batch_token_count(batch)
             if tokens:
                 step_metrics["tokens_per_s"] = (
                     tokens / step_metrics["step_time_s"]
@@ -275,13 +368,14 @@ class Trainer:
             if self.should_stop:
                 logger.info("callback requested stop at step %s", done)
                 break
+        deferred.flush()  # drain the lag-1 slot before the boundary work
         if eval_batches is not None and evaluated_at != done:
             last_eval = self.evaluate(
                 eval_batches(), max_batches=eval_max_batches
             )
             self._fire("on_evaluate", done, last_eval)
         self._fire("on_train_end", done)
-        loss = float(last_loss)
+        loss = float(last_loss)  # final sync: bit-identical to the sync loop
         logger.info("trainer finished at step %s (loss %.5f)", done, loss)
         out = {"step": done, "loss": loss}
         out.update(last_eval)

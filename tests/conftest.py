@@ -23,7 +23,13 @@ if "--xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (< 0.5) has no jax_num_cpu_devices option; the
+    # XLA_FLAGS export above already covers it as long as jax was not
+    # imported before this conftest ran.
+    pass
 
 import uuid
 

@@ -1,0 +1,132 @@
+"""DevicePrefetchIterator + DeferredMetrics + batch_token_count units
+(the async step pipeline's building blocks, docs/async_pipeline.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.train.data.device_prefetch import DevicePrefetchIterator
+from dlrover_tpu.train.metrics import DeferredMetrics, batch_token_count
+
+
+def host_batches(n, start=0):
+    for i in range(start, start + n):
+        yield np.full((2, 3), i, dtype=np.int32)
+
+
+class TestDevicePrefetchIterator:
+    def test_order_preserved(self):
+        it = DevicePrefetchIterator(host_batches(5))
+        assert [int(b[0, 0]) for b in it] == [0, 1, 2, 3, 4]
+
+    def test_yields_device_arrays(self):
+        batch = next(DevicePrefetchIterator(host_batches(1)))
+        assert isinstance(batch, jax.Array)
+
+    def test_depth_filled_and_refilled(self):
+        it = DevicePrefetchIterator(host_batches(10), depth=3)
+        assert it.in_flight == 3  # eager fill at construction
+        next(it)
+        assert it.in_flight == 3  # refilled before handing the batch back
+
+    def test_depth_validated(self):
+        with pytest.raises(ValueError):
+            DevicePrefetchIterator(host_batches(1), depth=0)
+
+    def test_source_consumed_lazily(self):
+        pulled = []
+
+        def src():
+            for i in range(100):
+                pulled.append(i)
+                yield np.zeros((1,), np.float32)
+
+        it = DevicePrefetchIterator(src(), depth=2)
+        assert len(pulled) == 2  # never slurps the whole stream
+        next(it)
+        assert len(pulled) == 3
+
+    def test_exhaustion_drains_buffer(self):
+        it = DevicePrefetchIterator(host_batches(3), depth=8)
+        assert it.in_flight == 3
+        assert not it.exhausted  # buffered batches still pending
+        assert len(list(it)) == 3  # nothing dropped at the tail
+        assert it.exhausted
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_pytree_batches(self):
+        def src():
+            yield {"input_ids": np.zeros((2, 4), np.int32),
+                   "labels": np.ones((2, 4), np.int32)}
+
+        batch = next(DevicePrefetchIterator(src()))
+        assert isinstance(batch["input_ids"], jax.Array)
+        assert batch["labels"].shape == (2, 4)
+
+    def test_swap_discards_buffered_batches(self):
+        it = DevicePrefetchIterator(host_batches(10), depth=2)
+        next(it)
+        dropped = it.swap(host_batches(10, start=100))
+        assert dropped == 2  # the old stream's buffer is gone
+        assert int(next(it)[0, 0]) == 100
+        assert it.swaps == 1
+
+    def test_swap_revives_after_exhaustion(self):
+        it = DevicePrefetchIterator(host_batches(1), depth=2)
+        assert len(list(it)) == 1
+        assert it.exhausted
+        it.swap(host_batches(2, start=5))
+        assert not it.exhausted
+        assert [int(b[0, 0]) for b in it] == [5, 6]
+
+    def test_sharding_applied(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+        sh = NamedSharding(mesh, PartitionSpec())
+        batch = next(DevicePrefetchIterator(host_batches(1), sh))
+        assert batch.sharding.is_equivalent_to(sh, batch.ndim)
+
+
+class TestDeferredMetrics:
+    def test_lag1_protocol(self):
+        d = DeferredMetrics()
+        assert d.push(0, {"loss": jnp.asarray(1.5)}) is None
+        assert d.pending_step == 0
+        prev = d.push(1, {"loss": jnp.asarray(2.5)})
+        assert prev == (0, {"loss": 1.5})
+        assert isinstance(prev[1]["loss"], float)
+        assert d.flush() == (1, {"loss": 2.5})
+        assert d.flush() is None
+        assert d.pending_step is None
+
+    def test_non_scalar_values_passed_through(self):
+        d = DeferredMetrics()
+        d.push(3, {"grads": np.zeros((2, 2)), "loss": jnp.asarray(0.5)})
+        step, host = d.flush()
+        assert step == 3
+        assert host["grads"].shape == (2, 2)
+        assert host["loss"] == 0.5
+
+
+class TestBatchTokenCount:
+    def test_plain_array(self):
+        assert batch_token_count(np.zeros((4, 16))) == 64
+
+    def test_dict_pytree_sums_leaves(self):
+        batch = {
+            "input_ids": np.zeros((4, 16)),
+            "labels": np.zeros((4, 16)),
+        }
+        # np.prod(np.shape(dict)) == 1 was the old (wrong) answer
+        assert batch_token_count(batch) == 128
+
+    def test_tuple_batch(self):
+        assert batch_token_count(
+            (np.zeros((2, 8)), np.zeros((2,)))
+        ) == 18
+
+    def test_shapeless_leaves_skipped(self):
+        assert batch_token_count({"flag": True}) == 0
