@@ -381,9 +381,56 @@ class ElasticTrainingAgent:
         logger.info("started %s worker processes%s", len(self._workers),
                     " (fork server)" if use_forkserver else "")
 
+    def _chaos_hit_workers(self):
+        """Scripted worker kill/hang (chaos drills).
+
+        Fires from the monitor loop so the resulting failure travels the
+        REAL detection path: a killed worker is seen as a nonzero exit by
+        the next poll; a hung (SIGSTOPped) one stops heartbeating and is
+        flagged by the master's hang detection."""
+        from dlrover_tpu.chaos.injector import fault_hit
+
+        event = fault_hit("agent.monitor")
+        if event is None:
+            return
+        local_rank = int(event.args.get("rank", 0))
+        if local_rank >= len(self._workers):
+            return
+        proc = self._workers[local_rank]
+        if proc.poll() is not None:
+            return
+        try:
+            pgid = os.getpgid(proc.pid)
+        except ProcessLookupError:
+            return
+        if event.kind == "kill":
+            logger.warning(
+                "CHAOS: SIGKILL worker local_rank=%s pid=%s",
+                local_rank, proc.pid,
+            )
+            os.killpg(pgid, signal.SIGKILL)
+        elif event.kind == "hang":
+            logger.warning(
+                "CHAOS: SIGSTOP worker local_rank=%s pid=%s",
+                local_rank, proc.pid,
+            )
+            os.killpg(pgid, signal.SIGSTOP)
+            resume_after = float(event.args.get("resume_after_s", 0))
+            if resume_after > 0:
+                import threading
+
+                def _resume():
+                    try:
+                        os.killpg(pgid, signal.SIGCONT)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+
+                threading.Timer(resume_after, _resume).start()
+
     def _monitor_workers(self, outcome: RendezvousOutcome) -> str:
         while not self._stopped:
             time.sleep(self._config.monitor_interval)
+            self._chaos_hit_workers()
             codes = [p.poll() for p in self._workers]
             if any(c is not None and c != 0 for c in codes):
                 failed = [

@@ -10,6 +10,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RpcClient
@@ -123,12 +124,19 @@ class MasterClient:
         return self._call(m.KVStoreMultiGet(keys=tuple(keys)))
 
     def kv_store_wait(self, keys, timeout: float = 300.0) -> Dict[str, bytes]:
+        # Jittered backoff, not a fixed 0.1 s poll: every worker of the
+        # job waits on the same barrier keys at the same moment, and
+        # synchronized polling multiplies master RPC load by world size.
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        backoff = ExponentialBackoff(initial=0.05, max_delay=1.0)
+        while True:
             values = self.kv_store_multi_get(keys)
             if all(v is not None for v in values.values()):
                 return values
-            time.sleep(0.1)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            backoff.sleep(remaining)
         raise TimeoutError(f"kv keys {keys} not all set within {timeout}s")
 
     # ---------------- data sharding ----------------

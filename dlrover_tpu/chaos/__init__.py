@@ -1,0 +1,54 @@
+"""Deterministic fault injection for failure drills.
+
+DLRover's goodput claims are only as strong as the failure drills behind
+them (ElasWave, PAPERS.md). This package turns every failure path in the
+stack into a scriptable, *seeded* event so drills are reproducible:
+
+- :class:`FaultPlan` — a declarative list of :class:`FaultEvent`s
+  (which site, which kind of fault, when), serialized through one env
+  var so forkserver-spawned workers and subprocess agents inherit it;
+- :class:`FaultInjector` — the per-process singleton the instrumented
+  call sites consult (``fault_hit``). Occurrence counters and a
+  per-site seeded RNG make the schedule deterministic: re-running a
+  drill with the same seed fires the identical event sequence;
+- :class:`ChaosStorage` — a :class:`CheckpointStorage` wrapper that
+  corrupts/truncates/drops checkpoint writes on command, driving the
+  verified-restore chain (crc per block + multi-step fallback).
+
+Instrumented sites (see docs/fault_tolerance.md for the full matrix):
+
+==================  ====================================================
+site                where / kinds
+==================  ====================================================
+rpc.client.send     common/rpc.py client: drop, reset, delay
+rpc.server.recv     common/rpc.py server: drop, drop_response, delay
+agent.monitor       agent/agent.py poll loop: kill, hang
+trainer.step        train/trainer.py fit loop: straggle (delay)
+ckpt.shm            checkpoint engine load: lose (snapshot loss)
+storage.write       ChaosStorage writes: corrupt, truncate, drop, delay
+==================  ====================================================
+
+Production safety: with ``DLROVER_TPU_CHAOS`` unset, ``fault_hit`` is a
+single dict lookup returning None — no plan parsing, no locks.
+"""
+
+from dlrover_tpu.chaos.injector import (
+    CHAOS_ENV,
+    CHAOS_LOG_ENV,
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+    fault_hit,
+)
+from dlrover_tpu.chaos.storage import ChaosStorage, maybe_chaos_storage
+
+__all__ = [
+    "CHAOS_ENV",
+    "CHAOS_LOG_ENV",
+    "ChaosStorage",
+    "FaultEvent",
+    "FaultInjector",
+    "FaultPlan",
+    "fault_hit",
+    "maybe_chaos_storage",
+]

@@ -1,0 +1,137 @@
+"""Checkpoint-storage fault wrapper: corrupt/truncate/drop writes.
+
+``ChaosStorage`` delegates every operation to an inner
+:class:`CheckpointStorage` and consults the injector's ``storage.write``
+site before each write. The corruption happens *below* the persist
+layer, exactly where a real bit-flip or short write would land — so the
+crc-per-block verification and the multi-step restore fallback see the
+same damage a real incident produces.
+
+Write kinds (``FaultEvent.kind``):
+
+- ``corrupt``  — XOR one byte (``args: {"offset": int, "xor": int}``;
+  offset default = middle of the payload, xor default 0xFF);
+- ``truncate`` — drop the tail (``args: {"keep_fraction": float}`` or
+  ``{"drop_bytes": int}``; default keeps the first half);
+- ``drop``     — silently skip the write (a lost write);
+- ``delay``    — sleep ``delay_s`` then write normally (slow storage).
+
+Use ``match`` to target specific files (e.g. ``".bin"`` for shard
+payloads, ``"checkpoint-3/"`` for one step).
+"""
+
+import time
+from typing import Optional
+
+from dlrover_tpu.chaos.injector import FaultEvent, fault_hit
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.storage import CheckpointStorage
+
+
+def _mangle(data: bytes, event: FaultEvent) -> Optional[bytes]:
+    """Apply a write fault to `data`; None means the write is dropped."""
+    if event.kind == "drop":
+        return None
+    if event.kind == "delay":
+        time.sleep(event.delay_s)
+        return data
+    if event.kind == "truncate":
+        if "drop_bytes" in event.args:
+            keep = max(0, len(data) - int(event.args["drop_bytes"]))
+        else:
+            keep = int(len(data) * float(event.args.get("keep_fraction", 0.5)))
+        return data[:keep]
+    if event.kind == "corrupt":
+        if not data:
+            return data
+        offset = int(event.args.get("offset", len(data) // 2)) % len(data)
+        xor = int(event.args.get("xor", 0xFF)) or 0xFF
+        out = bytearray(data)
+        out[offset] ^= xor
+        return bytes(out)
+    logger.warning("unknown storage.write chaos kind %r; ignored", event.kind)
+    return data
+
+
+class ChaosStorage(CheckpointStorage):
+    """Fault-injecting delegate around any checkpoint storage backend."""
+
+    def __init__(self, inner: CheckpointStorage):
+        self.inner = inner
+
+    def _faulted(self, data: bytes, path: str) -> Optional[bytes]:
+        event = fault_hit("storage.write", detail=path)
+        if event is None:
+            return data
+        return _mangle(data, event)
+
+    def write(self, content, path: str):
+        if isinstance(content, (bytes, bytearray, memoryview)):
+            data = self._faulted(bytes(content), path)
+        else:
+            mangled = self._faulted(str(content).encode(), path)
+            data = None if mangled is None else mangled.decode(
+                errors="replace"
+            )
+        if data is None:
+            logger.warning("CHAOS: dropped write of %s", path)
+            return
+        self.inner.write(data, path)
+
+    def write_bytes(self, data: bytes, path: str):
+        data = self._faulted(bytes(data), path)
+        if data is None:
+            logger.warning("CHAOS: dropped write of %s", path)
+            return
+        self.inner.write_bytes(data, path)
+
+    def write_chunks(self, chunks, path: str):
+        # Materialize so a single fault can hit any byte of the file —
+        # the persist layer's chunks are an optimization, not a unit of
+        # failure atomicity.
+        self.write_bytes(b"".join(bytes(c) for c in chunks), path)
+
+    # reads and namespace ops pass straight through
+    def read(self, path: str, mode: str = "r"):
+        return self.inner.read(path, mode)
+
+    def read_bytes(self, path: str):
+        return self.inner.read_bytes(path)
+
+    def read_range(self, path: str, offset: int, nbytes: int):
+        return self.inner.read_range(path, offset, nbytes)
+
+    def safe_rename(self, src: str, dst: str):
+        self.inner.safe_rename(src, dst)
+
+    def safe_makedirs(self, path: str):
+        self.inner.safe_makedirs(path)
+
+    def safe_remove(self, path: str):
+        self.inner.safe_remove(path)
+
+    def exists(self, path: str) -> bool:
+        return self.inner.exists(path)
+
+    def listdir(self, path: str):
+        return self.inner.listdir(path)
+
+    def commit(self, step: int, success: bool):
+        self.inner.commit(step, success)
+
+
+def maybe_chaos_storage(storage: CheckpointStorage) -> CheckpointStorage:
+    """Wrap `storage` when a chaos plan with storage events is armed.
+
+    Called by :func:`dlrover_tpu.common.storage.get_checkpoint_storage`
+    so the agent saver and standalone engines pick up write faults from
+    the env without any plumbing.
+    """
+    from dlrover_tpu.chaos.injector import FaultInjector
+
+    inj = FaultInjector.get()
+    if inj is None or isinstance(storage, ChaosStorage):
+        return storage
+    if not inj._by_site.get("storage.write"):
+        return storage
+    return ChaosStorage(storage)

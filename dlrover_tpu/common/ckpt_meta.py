@@ -66,6 +66,12 @@ class TensorMeta:
     global_shape: Optional[Tuple[int, ...]] = None  # None => unsharded
     index: Optional[Tuple[Tuple[int, int], ...]] = None  # block bounds
     persist: bool = True
+    # Integrity checksum of the persisted bytes (uint32). None in shm
+    # metas — computed only on the async persist path (the hot
+    # save_to_memory path must not pay a full-buffer scan) and verified
+    # on every storage read. The algorithm rides on ShardMeta.crc_algo.
+    # Read via getattr: metas pickled before this field existed lack it.
+    crc: Optional[int] = None
 
 
 @dataclass
@@ -88,6 +94,9 @@ class ShardMeta:
     persist: bool = True
     # Monotonic id distinguishing buffer layouts (size growth recreates shm).
     layout_version: int = 0
+    # Checksum algorithm of the tensors' ``crc`` fields ("" = none —
+    # shm metas and pre-upgrade checkpoints). Stamped by persist_shard.
+    crc_algo: str = ""
 
 
 @dataclass

@@ -12,6 +12,14 @@ protocol, ``dlrover/python/elastic_agent/torch/ckpt_saver.py:747-785``)::
 A step is readable iff the tracker names it; the tracker is written only
 after every ``done_*`` file exists, so readers can never observe a torn
 checkpoint.
+
+On top of the commit protocol sits block-level integrity: every persisted
+block carries a checksum (stamped here, on the async persist path — never
+in the trainer's hot save path) which ``read_block`` verifies on every
+read. A step caught lying — missing shards, undecodable metas, short or
+bit-flipped bins — is *quarantined*: a marker file with the reason is
+dropped into its dir and both restore and GC skip it from then on, so a
+damaged step is diagnosed once, not re-read on every restart.
 """
 
 import dataclasses
@@ -20,10 +28,26 @@ import pickle
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dlrover_tpu.common import checksum
+from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.ckpt_meta import ShardMeta, TensorMeta
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.storage import CheckpointStorage
+
+
+class StepCorruptionError(Exception):
+    """A persisted step failed integrity verification.
+
+    Raised by :func:`read_block` on a checksum mismatch and by restore
+    paths that find a step structurally broken (missing shards, torn
+    bins, undecodable metas). Carries enough context to quarantine the
+    step with a useful reason."""
+
+    def __init__(self, step: int, reason: str):
+        super().__init__(f"checkpoint step {step} corrupt: {reason}")
+        self.step = step
+        self.reason = reason
 
 
 def step_dir(ckpt_dir: str, step: int) -> str:
@@ -42,6 +66,11 @@ def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
     memory restore (replica copies another process persists); the disk file
     carries exclusively the ``persist=True`` blocks, with offsets remapped
     to the file layout, so a sharded checkpoint stores each byte once.
+
+    Each disk block is checksummed here. This function runs on the agent
+    saver's persist thread (or the standalone engine's inline persist) —
+    off the trainer's ``save_to_memory`` hot path, so integrity costs
+    zero synchronization at save time.
     """
     d = step_dir(ckpt_dir, meta.step)
     storage.safe_makedirs(d)
@@ -53,11 +82,15 @@ def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
     for t in meta.tensors:
         if not t.persist:
             continue
-        disk_tensors.append(dataclasses.replace(t, offset=offset))
-        chunks.append(buf[t.offset:t.offset + t.nbytes])
+        block = buf[t.offset:t.offset + t.nbytes]
+        disk_tensors.append(dataclasses.replace(
+            t, offset=offset, crc=checksum.block_checksum(block)
+        ))
+        chunks.append(block)
         offset += t.nbytes
     disk_meta = dataclasses.replace(
-        meta, tensors=disk_tensors, used_bytes=offset, shm_name=""
+        meta, tensors=disk_tensors, used_bytes=offset, shm_name="",
+        crc_algo=checksum.DEFAULT_ALGO,
     )
     storage.write_chunks(chunks, prefix + ".bin")
     storage.write_bytes(pickle.dumps(disk_meta), prefix + ".meta")
@@ -80,9 +113,14 @@ def commit_step(storage: CheckpointStorage, ckpt_dir: str, step: int,
 
     Returns False (and leaves the tracker untouched) on timeout — a partial
     step directory is garbage-collected later, never published.
+
+    Polls with jittered exponential backoff: the committer's listdir scans
+    hit shared storage, and a fixed interval from every job on the
+    filesystem synchronizes into a thundering herd.
     """
     deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
+    backoff = ExponentialBackoff(initial=0.05, max_delay=1.0)
+    while True:
         n = count_done(storage, ckpt_dir, step)
         if n >= global_shard_num:
             storage.write(str(step), _tracker_path(ckpt_dir))
@@ -90,7 +128,10 @@ def commit_step(storage: CheckpointStorage, ckpt_dir: str, step: int,
                 "flash ckpt: committed step %s (%s shards)", step, n
             )
             return True
-        time.sleep(0.1)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        backoff.sleep(remaining)
     logger.error(
         "flash ckpt: commit of step %s timed out (%s/%s done)",
         step, count_done(storage, ckpt_dir, step), global_shard_num,
@@ -147,8 +188,17 @@ def load_step_metas(storage: CheckpointStorage, ckpt_dir: str,
 
 
 def read_block(storage: CheckpointStorage, ckpt_dir: str, step: int,
-               gid: int, t: TensorMeta) -> Optional[bytes]:
-    """Read one block's bytes out of a shard's bin file."""
+               gid: int, t: TensorMeta, crc_algo: str = "") -> Optional[bytes]:
+    """Read one block's bytes out of a shard's bin file, verified.
+
+    Returns None when the block is missing or short (file gone or
+    truncated past this block). Raises :class:`StepCorruptionError` when
+    the bytes are present but fail their checksum — a length-preserving
+    bit flip, the failure mode the commit protocol alone cannot see.
+    ``crc_algo`` comes from the shard's :class:`ShardMeta`; old metas
+    without checksums verify vacuously (read via getattr — they may
+    predate the ``crc`` field entirely).
+    """
     d = step_dir(ckpt_dir, step)
     path = os.path.join(
         d, f"{CheckpointConstant.SHARD_FILE_PREFIX}{gid}.bin"
@@ -156,6 +206,12 @@ def read_block(storage: CheckpointStorage, ckpt_dir: str, step: int,
     data = storage.read_range(path, t.offset, t.nbytes)
     if data is None or len(data) != t.nbytes:
         return None
+    if not checksum.verify_block(data, getattr(t, "crc", None), crc_algo):
+        raise StepCorruptionError(
+            step,
+            f"checksum mismatch in shard {gid} block {t.path!r} "
+            f"(offset {t.offset}, {t.nbytes} bytes, algo {crc_algo or 'crc32'})",
+        )
     return data
 
 
@@ -171,6 +227,79 @@ def list_steps(storage: CheckpointStorage, ckpt_dir: str) -> List[int]:
             except ValueError:
                 continue
     return sorted(steps)
+
+
+def _quarantine_path(ckpt_dir: str, step: int) -> str:
+    return os.path.join(
+        step_dir(ckpt_dir, step), CheckpointConstant.QUARANTINE_FILE
+    )
+
+
+def quarantine_step(storage: CheckpointStorage, ckpt_dir: str, step: int,
+                    reason: str) -> None:
+    """Mark a step dir as damaged so restore and GC skip it from now on.
+
+    The marker body carries the reason for post-mortems. Quarantine is
+    negative-only caching: a step is never marked "verified good" — reads
+    always re-verify checksums, because storage can rot after a positive
+    verdict but a damaged step stays damaged."""
+    logger.error(
+        "flash ckpt: quarantining step %s under %s: %s",
+        step, ckpt_dir, reason,
+    )
+    try:
+        storage.write(reason, _quarantine_path(ckpt_dir, step))
+    except Exception:
+        logger.warning(
+            "flash ckpt: could not write quarantine marker for step %s",
+            step, exc_info=True,
+        )
+
+
+def is_quarantined(storage: CheckpointStorage, ckpt_dir: str,
+                   step: int) -> bool:
+    return storage.exists(_quarantine_path(ckpt_dir, step))
+
+
+def quarantine_reason(storage: CheckpointStorage, ckpt_dir: str,
+                      step: int) -> Optional[str]:
+    content = storage.read(_quarantine_path(ckpt_dir, step))
+    return None if content is None else str(content)
+
+
+def verify_step(storage: CheckpointStorage, ckpt_dir: str,
+                step: int) -> Tuple[bool, str]:
+    """Full integrity check of one persisted step: ``(ok, reason)``.
+
+    Checks, in order of increasing cost: quarantine marker, shard metas
+    decodable, gid coverage against the step's own ``global_shard_num``,
+    done-file votes, and every block's length + checksum. Used by GC
+    before trusting a step as a keeper; restore performs the same checks
+    implicitly while reading."""
+    if is_quarantined(storage, ckpt_dir, step):
+        return False, "quarantined"
+    metas = load_step_metas(storage, ckpt_dir, step)
+    if not metas:
+        return False, "no readable shard metas"
+    expected = max(m.global_shard_num for m in metas.values())
+    missing = sorted(set(range(expected)) - set(metas))
+    if missing:
+        return False, f"missing shard metas {missing} of {expected}"
+    if count_done(storage, ckpt_dir, step) < expected:
+        return False, "incomplete done votes"
+    for gid, meta in sorted(metas.items()):
+        algo = getattr(meta, "crc_algo", "")
+        for t in meta.tensors:
+            try:
+                data = read_block(storage, ckpt_dir, step, gid, t, algo)
+            except StepCorruptionError as e:
+                return False, e.reason
+            if data is None:
+                return False, (
+                    f"shard {gid} bin missing/truncated at block "
+                    f"{t.path!r} (offset {t.offset}, {t.nbytes} bytes)"
+                )
+    return True, "ok"
 
 
 def _step_shard_num(storage: CheckpointStorage, ckpt_dir: str,
@@ -193,28 +322,38 @@ def _step_shard_num(storage: CheckpointStorage, ckpt_dir: str,
 
 
 def gc_steps(storage: CheckpointStorage, ckpt_dir: str, keep_latest: int):
-    """Drop old step dirs: keep the newest `keep_latest` *fully committed*
-    dirs (all done files present, judged against each step's OWN saved
-    shard count); delete every other dir at or below the tracker step —
-    including torn partial saves from crash flushes, which otherwise leak
-    multi-GB dirs forever. Dirs newer than the tracker are in-flight and
-    never touched."""
+    """Drop old step dirs: keep the newest `keep_latest` *verified* dirs
+    (all done files present judged against each step's OWN saved shard
+    count, metas decodable, every block checksum-valid); delete every
+    other dir at or below the tracker step — including torn partial saves
+    from crash flushes, which otherwise leak multi-GB dirs forever. Dirs
+    newer than the tracker are in-flight and never touched.
+
+    The tracker step gets no free pass: if the published step turns out
+    corrupt on disk, trusting it here would delete the older step that is
+    in fact the newest restorable checkpoint — GC must never destroy the
+    newest checksum-valid step just because garbage sits above it.
+    Steps that fail verification are quarantined (so the verdict is
+    cached and restore skips them too) and deleted like any other
+    non-keeper. Verification walks newest-first and stops once
+    `keep_latest` keepers are found, so old already-doomed dirs are not
+    re-read before removal."""
     tracker = read_tracker(storage, ckpt_dir)
     if tracker is None or keep_latest <= 0:
         return
     candidates = [s for s in list_steps(storage, ckpt_dir) if s <= tracker]
 
-    def complete(s: int) -> bool:
-        if s == tracker:
-            return True  # the published step is always kept
-        expected = _step_shard_num(storage, ckpt_dir, s)
-        if expected <= 0:
-            return False  # no readable meta: torn beyond use
-        return count_done(storage, ckpt_dir, s) >= expected
-
-    keep = set(
-        [s for s in candidates if complete(s)][-keep_latest:] + [tracker]
-    )
+    keep = set()
+    for s in reversed(candidates):
+        if len(keep) >= keep_latest:
+            break
+        if is_quarantined(storage, ckpt_dir, s):
+            continue
+        ok, reason = verify_step(storage, ckpt_dir, s)
+        if ok:
+            keep.add(s)
+        else:
+            quarantine_step(storage, ckpt_dir, s, f"gc verify: {reason}")
     for s in candidates:
         if s not in keep:
             storage.safe_remove(step_dir(ckpt_dir, s))

@@ -105,6 +105,9 @@ class CheckpointConstant:
     DONE_FILE_PREFIX = "done_"
     METADATA_FILE = "metadata.json"
     SAVE_TIMEOUT_SEC = 600
+    # A step dir found missing/corrupt/undecodable is stamped with this
+    # marker (body = reason) and skipped by restore and GC thereafter.
+    QUARANTINE_FILE = "QUARANTINED"
 
 
 class NodeEnv:

@@ -21,9 +21,21 @@ import uuid
 from collections import OrderedDict
 from typing import Any, Callable, Optional, Tuple
 
+from dlrover_tpu.chaos.injector import fault_hit
 from dlrover_tpu.common.log import logger
 
 _LEN = struct.Struct(">I")
+
+# Control-plane timing contract, derived from one place so the pieces
+# cannot drift apart. The dedup cache must remember a request id for
+# STRICTLY LONGER than any client can still be retrying it, otherwise a
+# retry landing after TTL expiry re-applies a mutating message. A client
+# gives up at retry_deadline after the outage began, and its final
+# attempt can then occupy the wire for up to one request timeout — so
+# the TTL carries a full request-timeout of margin past the deadline.
+RPC_TIMEOUT = 60.0
+RPC_RETRY_DEADLINE = 120.0
+DEDUP_TTL = RPC_RETRY_DEADLINE + RPC_TIMEOUT
 
 
 def _send(sock: socket.socket, obj: Any):
@@ -62,7 +74,7 @@ class _DedupCache:
     instead of re-executing the handler concurrently.
     """
 
-    def __init__(self, maxsize: int = 4096, ttl: float = 120.0):
+    def __init__(self, maxsize: int = 4096, ttl: float = DEDUP_TTL):
         # req_id -> (timestamp, response) once done; response is None and a
         # pending Event is registered while the handler is executing.
         self._entries: "OrderedDict[str, Tuple[float, Any]]" = OrderedDict()
@@ -82,7 +94,7 @@ class _DedupCache:
             if event is None:
                 self._pending[req_id] = threading.Event()
                 return False, None
-        event.wait(timeout=60.0)
+        event.wait(timeout=RPC_TIMEOUT)
         with self._lock:
             entry = self._entries.get(req_id)
             if entry is not None:
@@ -120,12 +132,28 @@ class RpcServer:
     def __init__(self, port: int, handler: Callable[[Any], Any], host: str = "0.0.0.0"):
         self._handler = handler
         self._dedup = _DedupCache()
+        # Established per-client connections, so stop() can sever them:
+        # a killed master process drops every socket, and the in-process
+        # analog (tests, graceful handover) must behave the same — a
+        # stopped server that keeps answering on old connections would
+        # let clients talk to a master that no longer exists logically.
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
+                with outer._conns_lock:
+                    outer._conns.add(sock)
+                try:
+                    self._serve(sock)
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(sock)
+
+            def _serve(self, sock):
                 while True:
                     try:
                         envelope = _recv(sock)
@@ -135,6 +163,17 @@ class RpcServer:
                         req_id, request = envelope
                     else:  # bare request (tests / simple callers)
                         req_id, request = None, envelope
+                    chaos = fault_hit(
+                        "rpc.server.recv", detail=type(request).__name__
+                    )
+                    if chaos is not None:
+                        if chaos.kind == "delay":
+                            time.sleep(chaos.delay_s)
+                        elif chaos.kind == "drop":
+                            # Request lost before execution: the client
+                            # sees a dead connection and must retry.
+                            sock.close()
+                            return
                     duplicate, response = (
                         outer._dedup.begin(req_id) if req_id else (False, None)
                     )
@@ -148,6 +187,13 @@ class RpcServer:
                             response = (False, repr(e))
                         if req_id is not None:
                             outer._dedup.finish(req_id, response)
+                    if chaos is not None and chaos.kind == "drop_response":
+                        # Executed and dedup-cached, but the answer is
+                        # lost: the retry MUST be served from the cache,
+                        # not re-applied — the exact failure the dedup
+                        # layer exists for.
+                        sock.close()
+                        return
                     try:
                         _send(sock, response)
                     except OSError:
@@ -170,6 +216,18 @@ class RpcServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class RpcClient:
@@ -185,8 +243,8 @@ class RpcClient:
     retried envelope could miss the dedup cache).
     """
 
-    def __init__(self, addr: str, timeout: float = 60.0,
-                 retry_deadline: float = 120.0,
+    def __init__(self, addr: str, timeout: float = RPC_TIMEOUT,
+                 retry_deadline: float = RPC_RETRY_DEADLINE,
                  connect_timeout: float = 5.0):
         host, port = addr.rsplit(":", 1)
         self._addr: Tuple[str, int] = (host, int(port))
@@ -229,6 +287,21 @@ class RpcClient:
                     outage_err = e
                 if outage_err is None:
                     try:
+                        chaos = fault_hit(
+                            "rpc.client.send",
+                            detail=type(request).__name__,
+                        )
+                        if chaos is not None:
+                            if chaos.kind == "delay":
+                                time.sleep(chaos.delay_s)
+                            elif chaos.kind in ("drop", "reset"):
+                                # Tear the connection down before the
+                                # send: flows through the normal
+                                # connection-dead retry path below.
+                                self._close_locked()
+                                raise ConnectionResetError(
+                                    f"chaos: {chaos.kind} before send"
+                                )
                         self._sock.settimeout(timeout or self._timeout)
                         _send(self._sock, envelope)
                         ok, payload = _recv(self._sock)

@@ -132,4 +132,9 @@ class PosixDiskStorage(CheckpointStorage):
 
 
 def get_checkpoint_storage(storage: Optional[CheckpointStorage] = None):
-    return storage or PosixDiskStorage()
+    storage = storage or PosixDiskStorage()
+    # Lazy import: chaos.storage imports this module at load time, and
+    # chaos stays entirely out of the way unless the env arms a plan.
+    from dlrover_tpu.chaos.storage import maybe_chaos_storage
+
+    return maybe_chaos_storage(storage)

@@ -33,6 +33,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu.chaos.injector import fault_hit
 from dlrover_tpu.common import ckpt_persist, fastcopy
 from dlrover_tpu.common.ckpt_meta import (
     SaveEvent,
@@ -615,6 +616,17 @@ class CheckpointEngine:
         # phase number means what it says.
         self._reset_restore_stats()
         t_load0 = time.perf_counter()
+        chaos = fault_hit("ckpt.shm", detail=self._shm_name)
+        if chaos is not None and chaos.kind == "lose":
+            # Simulate a host reboot that wiped /dev/shm: the warm
+            # snapshot is gone and restore must fall back to storage.
+            logger.warning(
+                "CHAOS: losing shm snapshot %s", self._shm_name
+            )
+            if self._shm is not None:
+                self._shm.close()
+                self._shm = None
+            SharedMemory.remove(self._shm_name)
         meta = self._memory_meta()
         has_memory = meta is not None and SharedMemory.exists(self._shm_name)
         my_step = meta.step if has_memory else -1
@@ -660,42 +672,103 @@ class CheckpointEngine:
         return read
 
     def _load_from_storage(self, template) -> Tuple[int, Any]:
-        # Phase counters restart here even on the memory->storage
-        # fallback: a failed memory attempt must not leak its phase
-        # times into the storage attribution.
-        self._reset_restore_stats()
-        t_load0 = time.perf_counter()
-        step = ckpt_persist.read_tracker(self.storage, self.checkpoint_dir)
-        if step is None:
-            return -1, template
+        """Storage restore with a verified fallback chain.
+
+        The tracker's step is only the *first* candidate: if it turns out
+        missing, torn or checksum-corrupt, the next older step directory
+        is tried, and so on — a damaged newest checkpoint costs one
+        checkpoint interval of progress, never the whole run. Each
+        rejected step is quarantined (see :mod:`ckpt_persist`) with its
+        reason, and the chain is surfaced in ``last_restore_stats``
+        (``step``/``fallback_from``/``fallback_reason``/``skipped``).
+
+        Template/shape mismatches ("model definition changed") propagate
+        instead of falling back: a healthy checkpoint that no longer fits
+        the model is a user error, and quarantining it — or silently
+        restoring an older one that happens to fit — would hide it.
+        """
+        tracker = ckpt_persist.read_tracker(self.storage, self.checkpoint_dir)
+        all_steps = ckpt_persist.list_steps(self.storage, self.checkpoint_dir)
+        if tracker is not None:
+            candidates = [s for s in all_steps if s <= tracker]
+        else:
+            # No/unreadable tracker (lost with the master's disk): any
+            # step dir that fully verifies beats a cold start.
+            candidates = list(all_steps)
+        skipped: List[Tuple[int, str]] = []
+        for step in reversed(candidates):
+            if ckpt_persist.is_quarantined(
+                self.storage, self.checkpoint_dir, step
+            ):
+                skipped.append((step, "quarantined"))
+                continue
+            # Phase counters restart per attempt (and on the
+            # memory->storage fallback): a failed attempt must not leak
+            # its phase times into the winning step's attribution.
+            self._reset_restore_stats()
+            t_load0 = time.perf_counter()
+            try:
+                nbytes, n_shards, state = self._restore_step(template, step)
+            except ckpt_persist.StepCorruptionError as e:
+                ckpt_persist.quarantine_step(
+                    self.storage, self.checkpoint_dir, step, e.reason
+                )
+                skipped.append((step, e.reason))
+                continue
+            self._cached_step = step
+            self._finish_restore_stats("storage", nbytes, t_load0)
+            s = self._restore_stats
+            s["step"] = step
+            s["skipped"] = list(skipped)
+            if skipped:
+                s["fallback_from"], s["fallback_reason"] = skipped[0]
+            logger.info(
+                "restored step %s from storage (%s shard files, %s)",
+                step, n_shards, self._restore_stats,
+            )
+            return step, state
+        if skipped:
+            logger.error(
+                "no restorable checkpoint in %s; every candidate was "
+                "damaged: %s", self.checkpoint_dir, skipped,
+            )
+            self._restore_stats["skipped"] = list(skipped)
+        return -1, template
+
+    def _restore_step(self, template, step: int) -> Tuple[int, int, Any]:
+        """Rebuild `template` from one persisted step, fully verified.
+
+        Raises :class:`ckpt_persist.StepCorruptionError` when the step is
+        structurally broken (no/undecodable/missing shard metas, missing
+        or truncated bins) or any block fails its checksum."""
         metas = ckpt_persist.load_step_metas(
             self.storage, self.checkpoint_dir, step
         )
         if not metas:
-            logger.error(
-                "tracker names step %s but no shard metas found", step
+            raise ckpt_persist.StepCorruptionError(
+                step, "no readable shard metas"
             )
-            return -1, template
+        expected = max(m.global_shard_num for m in metas.values())
+        missing = sorted(set(range(expected)) - set(metas))
+        if missing:
+            raise ckpt_persist.StepCorruptionError(
+                step, f"missing shard metas {missing} of {expected}"
+            )
         catalog: Dict[str, List] = {}
         objects: Dict[str, Any] = {}
         nbytes = 0
         for gid in sorted(metas):
             meta = metas[gid]
+            algo = getattr(meta, "crc_algo", "")
             for k, v in meta.objects.items():
                 objects.setdefault(k, v)
             for t in meta.tensors:
                 nbytes += t.nbytes
                 catalog.setdefault(t.path, []).append(
-                    (t, self._storage_reader(step, gid, t))
+                    (t, self._storage_reader(step, gid, t, algo))
                 )
         state = self._rebuild(template, catalog, objects)
-        self._cached_step = step
-        self._finish_restore_stats("storage", nbytes, t_load0)
-        logger.info(
-            "restored step %s from storage (%s shard files, %s)",
-            step, len(metas), self._restore_stats,
-        )
-        return step, state
+        return nbytes, len(metas), state
 
     # ------------- restore attribution -------------
     @property
@@ -705,13 +778,19 @@ class CheckpointEngine:
         overlap reads count under assemble), ``device_put_s``
         (host->device transfers for sharded templates), ``assemble_s``
         (region fill + batched memcpy = total - read - device_put),
-        ``total_s``, ``source``, ``bytes``."""
+        ``total_s``, ``source``, ``bytes``; plus the verified-restore
+        chain: ``step`` (the step actually restored), ``skipped``
+        (list of (step, reason) pairs rejected on the way down) and,
+        when a fallback happened, ``fallback_from``/``fallback_reason``
+        naming the newest candidate and why it was rejected."""
         return dict(getattr(self, "_restore_stats", {}))
 
     def _reset_restore_stats(self):
         self._restore_stats = {
             "source": None, "read_s": 0.0, "device_put_s": 0.0,
             "assemble_s": 0.0, "total_s": 0.0, "bytes": 0,
+            "step": -1, "skipped": [],
+            "fallback_from": None, "fallback_reason": None,
         }
 
     def _finish_restore_stats(self, source: str, nbytes: int, t0: float):
@@ -724,15 +803,19 @@ class CheckpointEngine:
         )
 
     def _storage_reader(
-        self, step: int, gid: int, t: TensorMeta
+        self, step: int, gid: int, t: TensorMeta, crc_algo: str = ""
     ) -> Callable[[], np.ndarray]:
         def read() -> np.ndarray:
+            # read_block raises StepCorruptionError itself on a checksum
+            # mismatch; a missing/short block is promoted to one here so
+            # the fallback chain treats both as "this step is damaged".
             raw = ckpt_persist.read_block(
-                self.storage, self.checkpoint_dir, step, gid, t
+                self.storage, self.checkpoint_dir, step, gid, t, crc_algo
             )
             if raw is None:
-                raise KeyError(
-                    f"block {t.path}{t.index} missing from shard {gid}"
+                raise ckpt_persist.StepCorruptionError(
+                    step,
+                    f"block {t.path}{t.index} missing from shard {gid}",
                 )
             return np.frombuffer(raw, dtype=t.dtype).reshape(t.shape)
 
@@ -908,15 +991,15 @@ class CheckpointEngine:
         `>=` because the async saver may chase a newer snapshot when the
         trainer outpaces it; the committed step is never older than asked.
         """
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        from dlrover_tpu.common.backoff import poll_until
+
+        def committed() -> bool:
             tracker = ckpt_persist.read_tracker(
                 self.storage, self.checkpoint_dir
             )
-            if tracker is not None and tracker >= step:
-                return True
-            time.sleep(0.1)
-        return False
+            return tracker is not None and tracker >= step
+
+        return poll_until(committed, timeout, initial=0.05, max_delay=1.0)
 
     def close(self):
         done = self.wait_staged(30.0)

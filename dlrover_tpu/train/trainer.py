@@ -30,6 +30,7 @@ import os
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence
 
+from dlrover_tpu.chaos.injector import fault_hit
 from dlrover_tpu.common.log import logger
 
 
@@ -297,6 +298,13 @@ class Trainer:
                 else contextlib.nullcontext()
             )
             t_step0 = time.perf_counter()
+            chaos = fault_hit("trainer.step", detail=str(step))
+            if chaos is not None and chaos.kind in ("straggle", "delay"):
+                # Scripted straggler: the sleep lands inside the step's
+                # wall time (after t_step0), so the slowdown is visible
+                # to the same step-rate reporting the master's speed
+                # monitor reads.
+                time.sleep(chaos.delay_s)
             with ctx:
                 if not pipeline:
                     batch = jax.device_put(batch, self.batch_sharding)

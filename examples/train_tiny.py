@@ -54,6 +54,11 @@ def main():
     parser.add_argument("--use-dataloader", action="store_true",
                         help="consume master-dispatched shards through "
                         "ElasticDataLoader instead of full-batch steps")
+    parser.add_argument("--final-state", type=str, default="",
+                        help="rank 0 writes the final weights' raw bytes "
+                        "here (bit-identical resume assertions: the run "
+                        "is deterministic, so a crash+resume must end at "
+                        "exactly the uninterrupted run's bytes)")
     args = parser.parse_args()
 
     dtrain.init_training()
@@ -230,6 +235,11 @@ def main():
 
     final_loss = float(jnp.mean((x @ state["w"] - y) ** 2))
     resumed_step = int(state["step"])
+    if args.final_state and rank == 0:
+        import numpy as np
+
+        with open(args.final_state, "wb") as f:
+            f.write(np.asarray(jax.device_get(state["w"])).tobytes())
     print(f"rank {rank}: done at step {resumed_step}, final loss "
           f"{final_loss:.6f}", flush=True)
     assert resumed_step == args.steps, (

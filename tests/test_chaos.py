@@ -1,0 +1,906 @@
+"""Chaos drills: deterministic fault injection across the control plane
+and the checkpoint stack, plus the verified-restore chain they exercise.
+
+Fast deterministic drills run in-process (tier-1); the heavy
+process-spawning drills carry ``chaos`` + ``slow`` and are selected with
+``pytest -m chaos``. Every schedule is seeded — same seed, same journal.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.chaos import (
+    CHAOS_ENV,
+    CHAOS_LOG_ENV,
+    ChaosStorage,
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+    fault_hit,
+)
+from dlrover_tpu.chaos.storage import _mangle
+from dlrover_tpu.common import checksum, ckpt_persist
+from dlrover_tpu.common import messages
+from dlrover_tpu.common.backoff import ExponentialBackoff, poll_until
+from dlrover_tpu.common.ckpt_meta import ckpt_shm_name
+from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.common.rpc import (
+    DEDUP_TTL,
+    RPC_RETRY_DEADLINE,
+    RPC_TIMEOUT,
+    RpcClient,
+    RpcServer,
+    _DedupCache,
+)
+from dlrover_tpu.common.shared_memory import SharedMemory
+from dlrover_tpu.common.storage import (
+    PosixDiskStorage,
+    get_checkpoint_storage,
+)
+
+from tests.conftest import cpu_subprocess_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "examples", "train_tiny.py")
+
+
+@pytest.fixture(autouse=True)
+def chaos_clean(monkeypatch):
+    """Every test starts and ends with chaos disarmed (the injector is a
+    process-wide singleton; leaking an armed plan would poison the rest
+    of the suite)."""
+    monkeypatch.delenv(CHAOS_ENV, raising=False)
+    monkeypatch.delenv(CHAOS_LOG_ENV, raising=False)
+    FaultInjector.reset()
+    yield
+    FaultInjector.reset()
+
+
+def arm(monkeypatch, plan: FaultPlan, log_path: str = ""):
+    monkeypatch.setenv(CHAOS_ENV, plan.to_json())
+    if log_path:
+        monkeypatch.setenv(CHAOS_LOG_ENV, log_path)
+    FaultInjector.reset()
+
+
+def make_state(seed=0):
+    import jax.numpy as jnp
+    import optax
+
+    w = jnp.arange(12, dtype=jnp.float32).reshape(3, 4) + seed
+    opt = optax.adam(0.1)
+    return {
+        "params": {"w": w, "b": jnp.ones((4,)) * seed},
+        "opt": opt.init(w),
+        "step": seed,
+    }
+
+
+def assert_state_bit_identical(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestInjector:
+    def test_off_by_default(self):
+        assert FaultInjector.get() is None
+        assert fault_hit("anything") is None
+
+    def test_at_fires_once_on_nth_occurrence(self, monkeypatch):
+        arm(monkeypatch, FaultPlan(seed=1, events=[
+            FaultEvent(site="s", kind="k", at=3),
+        ]))
+        fires = [fault_hit("s") is not None for _ in range(6)]
+        assert fires == [False, False, True, False, False, False]
+
+    def test_every_and_max_fires(self, monkeypatch):
+        arm(monkeypatch, FaultPlan(seed=1, events=[
+            FaultEvent(site="s", kind="k", every=2, max_fires=2),
+        ]))
+        fires = [fault_hit("s") is not None for _ in range(8)]
+        assert fires == [False, True, False, True, False, False, False, False]
+
+    def test_match_filters_on_detail(self, monkeypatch):
+        arm(monkeypatch, FaultPlan(seed=1, events=[
+            FaultEvent(site="s", kind="k", every=1, match=".bin"),
+        ]))
+        assert fault_hit("s", detail="x.meta") is None
+        assert fault_hit("s", detail="x.bin") is not None
+
+    def test_prob_schedule_is_seed_deterministic(self, monkeypatch):
+        plan = FaultPlan(seed=7, events=[
+            FaultEvent(site="s", kind="k", prob=0.4, max_fires=4),
+        ])
+        arm(monkeypatch, plan)
+        seq1 = [fault_hit("s") is not None for _ in range(30)]
+        arm(monkeypatch, plan)  # re-arm: fresh counters, same seed
+        seq2 = [fault_hit("s") is not None for _ in range(30)]
+        assert seq1 == seq2
+        assert sum(seq1) == 4
+
+    def test_plan_roundtrip_and_file_loading(self, monkeypatch, tmp_path):
+        plan = FaultPlan(seed=9, events=[
+            FaultEvent(site="a.b", kind="kill", at=2, args={"rank": 1}),
+            FaultEvent(site="c", kind="delay", every=3, delay_s=0.5),
+        ])
+        restored = FaultPlan.from_json(plan.to_json())
+        assert restored.seed == 9
+        assert [e.site for e in restored.events] == ["a.b", "c"]
+        assert restored.events[0].args == {"rank": 1}
+        p = tmp_path / "plan.json"
+        p.write_text(plan.to_json())
+        monkeypatch.setenv(CHAOS_ENV, f"@{p}")
+        FaultInjector.reset()
+        inj = FaultInjector.get()
+        assert inj is not None and len(inj._by_site) == 2
+
+    def test_journal_records_fired_events(self, monkeypatch, tmp_path):
+        log = str(tmp_path / "journal.jsonl")
+        arm(monkeypatch, FaultPlan(seed=1, events=[
+            FaultEvent(site="s", kind="k", at=2),
+        ]), log_path=log)
+        for _ in range(4):
+            fault_hit("s", detail="d")
+        lines = [json.loads(x) for x in open(log).read().splitlines()]
+        assert lines == [{"site": "s", "n": 2, "kind": "k", "detail": "d"}]
+
+
+class TestChaosStorage:
+    def test_mangle_kinds(self):
+        data = bytes(range(32))
+        assert _mangle(data, FaultEvent(site="w", kind="drop")) is None
+        out = _mangle(data, FaultEvent(site="w", kind="corrupt"))
+        assert len(out) == 32 and out != data
+        # exactly one byte differs
+        assert sum(a != b for a, b in zip(out, data)) == 1
+        out = _mangle(
+            data, FaultEvent(site="w", kind="corrupt",
+                             args={"offset": 0, "xor": 1})
+        )
+        assert out[0] == 1 and out[1:] == data[1:]
+        out = _mangle(data, FaultEvent(site="w", kind="truncate"))
+        assert out == data[:16]
+        out = _mangle(
+            data, FaultEvent(site="w", kind="truncate",
+                             args={"drop_bytes": 5})
+        )
+        assert out == data[:27]
+
+    def test_wraps_only_when_storage_events_armed(self, monkeypatch):
+        assert isinstance(get_checkpoint_storage(), PosixDiskStorage)
+        arm(monkeypatch, FaultPlan(events=[
+            FaultEvent(site="rpc.client.send", kind="drop", at=1),
+        ]))
+        assert isinstance(get_checkpoint_storage(), PosixDiskStorage)
+        arm(monkeypatch, FaultPlan(events=[
+            FaultEvent(site="storage.write", kind="drop", at=1),
+        ]))
+        st = get_checkpoint_storage()
+        assert isinstance(st, ChaosStorage)
+        # no double wrap
+        assert isinstance(get_checkpoint_storage(st), ChaosStorage)
+        assert not isinstance(st.inner, ChaosStorage)
+
+    def test_faulted_write_then_clean(self, monkeypatch, tmp_path):
+        arm(monkeypatch, FaultPlan(events=[
+            FaultEvent(site="storage.write", kind="corrupt", every=1,
+                       max_fires=1, match=".bin"),
+        ]))
+        st = get_checkpoint_storage()
+        p = str(tmp_path / "x.bin")
+        st.write_bytes(b"\x00" * 64, p)
+        assert open(p, "rb").read() != b"\x00" * 64
+        st.write_bytes(b"\x00" * 64, p)  # max_fires reached: clean now
+        assert open(p, "rb").read() == b"\x00" * 64
+
+
+class TestBackoff:
+    def test_growth_and_cap(self):
+        b = ExponentialBackoff(initial=0.1, factor=2.0, max_delay=0.5,
+                               jitter=0.0)
+        assert [b.next_delay() for _ in range(5)] == [
+            0.1, 0.2, 0.4, 0.5, 0.5
+        ]
+        b.reset()
+        assert b.next_delay() == 0.1
+
+    def test_jitter_stays_in_band(self):
+        b = ExponentialBackoff(initial=0.1, factor=1.0, max_delay=0.1,
+                               jitter=0.5)
+        for _ in range(50):
+            d = b.next_delay()
+            assert 0.05 <= d <= 0.15 or d == pytest.approx(0.005)
+
+    def test_poll_until(self):
+        hits = {"n": 0}
+
+        def pred():
+            hits["n"] += 1
+            return hits["n"] >= 3
+
+        assert poll_until(pred, timeout=5.0, initial=0.01)
+        assert hits["n"] == 3
+        assert not poll_until(lambda: False, timeout=0.05, initial=0.01)
+
+
+class TestRpcTimingContract:
+    """Satellite: the dedup TTL must outlive the client retry window."""
+
+    def test_ttl_derivation(self):
+        assert DEDUP_TTL == RPC_RETRY_DEADLINE + RPC_TIMEOUT
+        assert DEDUP_TTL > RPC_RETRY_DEADLINE
+        assert _DedupCache()._ttl == DEDUP_TTL
+
+    def test_client_defaults_share_constants(self):
+        c = RpcClient("127.0.0.1:1")
+        assert c._timeout == RPC_TIMEOUT
+        assert c._retry_deadline == RPC_RETRY_DEADLINE
+        c.close()
+
+
+def _counting_server():
+    counter = {"n": 0}
+
+    def handler(req):
+        counter["n"] += 1
+        return counter["n"]
+
+    server = RpcServer(0, handler)
+    server.start()
+    return server, counter
+
+
+@pytest.mark.chaos
+class TestRpcChaos:
+    def test_client_reset_is_retried_and_applied_once(self, monkeypatch):
+        arm(monkeypatch, FaultPlan(events=[
+            FaultEvent(site="rpc.client.send", kind="reset", every=1,
+                       max_fires=1),
+        ]))
+        server, counter = _counting_server()
+        client = RpcClient(f"127.0.0.1:{server.port}")
+        try:
+            assert client.call(messages.KVStoreAdd(key="k")) == 1
+            assert counter["n"] == 1
+        finally:
+            client.close()
+            server.stop()
+
+    def test_server_drop_before_execution_is_retried(self, monkeypatch):
+        arm(monkeypatch, FaultPlan(events=[
+            FaultEvent(site="rpc.server.recv", kind="drop", every=1,
+                       max_fires=1),
+        ]))
+        server, counter = _counting_server()
+        client = RpcClient(f"127.0.0.1:{server.port}")
+        try:
+            assert client.call(messages.KVStoreAdd(key="k")) == 1
+            assert counter["n"] == 1  # dropped attempt never executed
+        finally:
+            client.close()
+            server.stop()
+
+    def test_lost_response_answered_from_dedup_cache(self, monkeypatch):
+        """The mutating-message contract: the server executes, the
+        response is lost on the wire, and the client's retry must be
+        answered from the dedup cache — applied exactly once."""
+        arm(monkeypatch, FaultPlan(events=[
+            FaultEvent(site="rpc.server.recv", kind="drop_response",
+                       every=1, max_fires=1),
+        ]))
+        server, counter = _counting_server()
+        client = RpcClient(f"127.0.0.1:{server.port}")
+        try:
+            assert client.call(messages.KVStoreAdd(key="k")) == 1
+            assert counter["n"] == 1
+        finally:
+            client.close()
+            server.stop()
+
+
+@pytest.mark.chaos
+class TestMasterFailoverContract:
+    """Satellite: in-flight traffic rides out a server stop -> restart at
+    the same port (the in-process analog of the master-relaunch e2e)."""
+
+    def test_call_rides_out_server_restart(self):
+        server1, counter1 = _counting_server()
+        port = server1.port
+        client = RpcClient(f"127.0.0.1:{port}")
+        try:
+            assert client.call(messages.KVStoreAdd(key="k")) == 1
+            server1.stop()
+            result = {}
+
+            def call():
+                result["v"] = client.call(messages.KVStoreAdd(key="k2"))
+
+            t = threading.Thread(target=call)
+            t.start()
+            time.sleep(0.6)  # a real outage window, not an instant flip
+            assert t.is_alive(), "client gave up during the outage"
+            counter2 = {"n": 0}
+
+            def handler2(req):
+                counter2["n"] += 1
+                return 100 + counter2["n"]
+
+            server2 = RpcServer(port, handler2)
+            server2.start()
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert result["v"] == 101
+            # the mutating call was applied exactly once across the
+            # outage: never by the dead server, once by the new one
+            assert counter1["n"] == 1 and counter2["n"] == 1
+            server2.stop()
+        finally:
+            client.close()
+
+
+class TestChecksummedPersist:
+    """crc per block: stamped on the async persist path, never in the
+    shm hot path, verified on every storage read."""
+
+    def _save_steps(self, ckpt_dir, steps):
+        from dlrover_tpu.train.checkpoint import CheckpointEngine
+
+        engine = CheckpointEngine(ckpt_dir, keep_latest=0)
+        try:
+            for s in steps:
+                assert engine.save_to_storage(s, make_state(s))
+        finally:
+            engine.close()
+        return engine
+
+    def test_disk_meta_has_crc_shm_meta_does_not(self, job_name, tmp_path):
+        from dlrover_tpu.train.checkpoint import CheckpointEngine
+
+        ckpt_dir = str(tmp_path / "ckpts")
+        engine = CheckpointEngine(ckpt_dir, keep_latest=0)
+        try:
+            assert engine.save_to_storage(1, make_state(1))
+            # hot path: the shm meta carries no checksums (computing
+            # them would put a full-buffer scan in save_to_memory)
+            shm_meta = engine._memory_meta()
+            assert shm_meta is not None
+            assert all(t.crc is None for t in shm_meta.tensors)
+            assert shm_meta.crc_algo == ""
+            # persist path: every disk block is checksummed + algo-tagged
+            d = ckpt_persist.step_dir(ckpt_dir, 1)
+            disk_meta = pickle.loads(
+                open(os.path.join(d, "shard_0.meta"), "rb").read()
+            )
+            assert disk_meta.crc_algo == checksum.DEFAULT_ALGO
+            assert len(disk_meta.tensors) > 0
+            assert all(isinstance(t.crc, int) for t in disk_meta.tensors)
+        finally:
+            engine.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+
+    def test_read_block_raises_on_bit_flip(self, job_name, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpts")
+        self._save_steps(ckpt_dir, [1])
+        SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        d = ckpt_persist.step_dir(ckpt_dir, 1)
+        bin_path = os.path.join(d, "shard_0.bin")
+        raw = bytearray(open(bin_path, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        open(bin_path, "wb").write(bytes(raw))
+        st = PosixDiskStorage()
+        meta = pickle.loads(
+            open(os.path.join(d, "shard_0.meta"), "rb").read()
+        )
+        flipped = [
+            t for t in meta.tensors
+            if t.offset <= len(raw) // 2 < t.offset + t.nbytes
+        ]
+        assert flipped
+        with pytest.raises(ckpt_persist.StepCorruptionError):
+            ckpt_persist.read_block(
+                st, ckpt_dir, 1, 0, flipped[0], meta.crc_algo
+            )
+
+    def test_pre_upgrade_meta_without_crc_still_loads(
+        self, job_name, tmp_path
+    ):
+        from dlrover_tpu.train.checkpoint import CheckpointEngine
+
+        ckpt_dir = str(tmp_path / "ckpts")
+        state = make_state(4)
+        self._save_steps(ckpt_dir, [1])
+        SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        # Strip the checksums, simulating a checkpoint written before
+        # the crc fields existed: verification must be vacuous, not fail.
+        d = ckpt_persist.step_dir(ckpt_dir, 1)
+        meta_path = os.path.join(d, "shard_0.meta")
+        meta = pickle.loads(open(meta_path, "rb").read())
+        meta.crc_algo = ""
+        for t in meta.tensors:
+            t.crc = None
+        open(meta_path, "wb").write(pickle.dumps(meta))
+        loader = CheckpointEngine(ckpt_dir, keep_latest=0)
+        try:
+            step, restored = loader.load(make_state(0))
+            assert step == 1
+        finally:
+            loader.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+
+
+class TestRestoreFallbackChain:
+    """The acceptance drill: a damaged newest step falls back to the
+    previous committed step, with the reason surfaced in
+    last_restore_stats — and the result is bit-identical to a run that
+    never saw the damaged step."""
+
+    def _drill(self, monkeypatch, tmp_path, job_name, kind, args=None):
+        from dlrover_tpu.train.checkpoint import CheckpointEngine
+
+        dir_a = str(tmp_path / "damaged")
+        dir_b = str(tmp_path / "clean")
+        target = os.path.join(dir_a, "checkpoint-3", "shard_0.bin")
+        arm(monkeypatch, FaultPlan(seed=3, events=[
+            FaultEvent(site="storage.write", kind=kind, every=1,
+                       max_fires=1, match=target, args=args or {}),
+        ]))
+        engine = CheckpointEngine(dir_a, keep_latest=0)
+        try:
+            for s in (1, 2, 3):
+                assert engine.save_to_storage(s, make_state(s))
+        finally:
+            engine.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        # the tracker names step 3 — whose bin the chaos write damaged
+        assert ckpt_persist.read_tracker(PosixDiskStorage(), dir_a) == 3
+
+        loader = CheckpointEngine(dir_a, keep_latest=0)
+        try:
+            step, restored = loader.load(make_state(0))
+            stats = loader.last_restore_stats
+        finally:
+            loader.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        assert step == 2
+        assert stats["source"] == "storage"
+        assert stats["step"] == 2
+        assert stats["fallback_from"] == 3
+        assert stats["fallback_reason"]
+        assert [s for s, _ in stats["skipped"]] == [3]
+        # the damaged step is quarantined with the reason for post-mortems
+        st = PosixDiskStorage()
+        assert ckpt_persist.is_quarantined(st, dir_a, 3)
+        assert stats["fallback_reason"] in (
+            ckpt_persist.quarantine_reason(st, dir_a, 3) or ""
+        )
+
+        # bit-identical to a run that never saw the damaged step
+        monkeypatch.delenv(CHAOS_ENV)
+        FaultInjector.reset()
+        clean = CheckpointEngine(dir_b, keep_latest=0)
+        try:
+            for s in (1, 2):
+                assert clean.save_to_storage(s, make_state(s))
+        finally:
+            clean.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        ref_loader = CheckpointEngine(dir_b, keep_latest=0)
+        try:
+            ref_step, ref_state = ref_loader.load(make_state(0))
+        finally:
+            ref_loader.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        assert ref_step == 2
+        assert_state_bit_identical(restored, ref_state)
+        return stats
+
+    @pytest.mark.chaos
+    def test_bit_flip_in_newest_bin_falls_back(
+        self, monkeypatch, tmp_path, job_name
+    ):
+        stats = self._drill(monkeypatch, tmp_path, job_name, "corrupt")
+        assert "checksum mismatch" in stats["fallback_reason"]
+
+    @pytest.mark.chaos
+    def test_truncated_bin_falls_back(
+        self, monkeypatch, tmp_path, job_name
+    ):
+        stats = self._drill(monkeypatch, tmp_path, job_name, "truncate")
+        assert "missing" in stats["fallback_reason"]
+
+    @pytest.mark.chaos
+    def test_undecodable_meta_falls_back(
+        self, monkeypatch, tmp_path, job_name
+    ):
+        from dlrover_tpu.train.checkpoint import CheckpointEngine
+
+        dir_a = str(tmp_path / "ckpts")
+        target = os.path.join(dir_a, "checkpoint-3", "shard_0.meta")
+        arm(monkeypatch, FaultPlan(events=[
+            FaultEvent(site="storage.write", kind="truncate", every=1,
+                       max_fires=1, match=target,
+                       args={"keep_fraction": 0.3}),
+        ]))
+        engine = CheckpointEngine(dir_a, keep_latest=0)
+        try:
+            for s in (1, 2, 3):
+                assert engine.save_to_storage(s, make_state(s))
+        finally:
+            engine.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        loader = CheckpointEngine(dir_a, keep_latest=0)
+        try:
+            step, _ = loader.load(make_state(0))
+            stats = loader.last_restore_stats
+        finally:
+            loader.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        assert step == 2
+        assert stats["fallback_from"] == 3
+        assert "metas" in stats["fallback_reason"]
+
+    @pytest.mark.chaos
+    def test_quarantined_step_skipped_without_reread(
+        self, monkeypatch, tmp_path, job_name
+    ):
+        """The second restore must skip the marked dir on the marker
+        alone (diagnosed once, not re-read on every restart)."""
+        self._drill(monkeypatch, tmp_path, job_name, "corrupt")
+        from dlrover_tpu.train.checkpoint import CheckpointEngine
+
+        dir_a = str(tmp_path / "damaged")
+        loader = CheckpointEngine(dir_a, keep_latest=0)
+        try:
+            step, _ = loader.load(make_state(0))
+            stats = loader.last_restore_stats
+        finally:
+            loader.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        assert step == 2
+        assert stats["skipped"] == [(3, "quarantined")]
+
+    @pytest.mark.chaos
+    def test_shm_loss_falls_back_to_storage(
+        self, monkeypatch, tmp_path, job_name
+    ):
+        from dlrover_tpu.train.checkpoint import CheckpointEngine
+
+        ckpt_dir = str(tmp_path / "ckpts")
+        state = make_state(5)
+        engine = CheckpointEngine(ckpt_dir, keep_latest=0)
+        try:
+            assert engine.save_to_storage(5, state)
+            # without chaos this engine would restore from its own shm
+            arm(monkeypatch, FaultPlan(events=[
+                FaultEvent(site="ckpt.shm", kind="lose", at=1),
+            ]))
+            step, restored = engine.load(make_state(0))
+            assert step == 5
+            assert engine.last_restore_stats["source"] == "storage"
+            assert_state_bit_identical(restored, state)
+        finally:
+            engine.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+
+    def test_missing_tracker_restores_newest_valid_dir(
+        self, job_name, tmp_path
+    ):
+        from dlrover_tpu.train.checkpoint import CheckpointEngine
+
+        ckpt_dir = str(tmp_path / "ckpts")
+        engine = CheckpointEngine(ckpt_dir, keep_latest=0)
+        try:
+            for s in (1, 2):
+                assert engine.save_to_storage(s, make_state(s))
+        finally:
+            engine.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        os.remove(os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE))
+        loader = CheckpointEngine(ckpt_dir, keep_latest=0)
+        try:
+            step, _ = loader.load(make_state(0))
+        finally:
+            loader.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        assert step == 2
+
+
+class TestGcQuarantine:
+    """Satellite: GC must never delete the newest checksum-valid step,
+    even when damaged (or uncommitted) step dirs sit above it."""
+
+    def _save(self, ckpt_dir, job_name, steps, keep_latest=0):
+        from dlrover_tpu.train.checkpoint import CheckpointEngine
+
+        engine = CheckpointEngine(ckpt_dir, keep_latest=keep_latest)
+        try:
+            for s in steps:
+                assert engine.save_to_storage(s, make_state(s))
+        finally:
+            engine.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+
+    def test_gc_keeps_newest_valid_below_corrupt_tracker_step(
+        self, job_name, tmp_path
+    ):
+        ckpt_dir = str(tmp_path / "ckpts")
+        self._save(ckpt_dir, job_name, [1, 2, 3])
+        st = PosixDiskStorage()
+        assert ckpt_persist.read_tracker(st, ckpt_dir) == 3
+        # flip a byte in the tracker step's bin
+        bin3 = os.path.join(
+            ckpt_persist.step_dir(ckpt_dir, 3), "shard_0.bin"
+        )
+        raw = bytearray(open(bin3, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        open(bin3, "wb").write(bytes(raw))
+
+        ckpt_persist.gc_steps(st, ckpt_dir, keep_latest=1)
+        # the old code kept the tracker step unconditionally and deleted
+        # step 2 — leaving zero restorable checkpoints
+        assert os.path.isdir(ckpt_persist.step_dir(ckpt_dir, 2)), (
+            "gc deleted the newest checksum-valid step"
+        )
+        assert not os.path.isdir(ckpt_persist.step_dir(ckpt_dir, 1))
+
+        from dlrover_tpu.train.checkpoint import CheckpointEngine
+
+        loader = CheckpointEngine(ckpt_dir, keep_latest=0)
+        try:
+            step, restored = loader.load(make_state(0))
+        finally:
+            loader.close()
+            SharedMemory.remove(ckpt_shm_name(job_name, 0, 0))
+        assert step == 2
+        assert_state_bit_identical(restored, make_state(2))
+
+    def test_gc_never_touches_dirs_above_tracker(self, job_name, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpts")
+        self._save(ckpt_dir, job_name, [1, 2])
+        # an in-flight (uncommitted) dir above the tracker
+        inflight = ckpt_persist.step_dir(ckpt_dir, 9)
+        os.makedirs(inflight)
+        open(os.path.join(inflight, "shard_0.bin"), "wb").write(b"partial")
+        st = PosixDiskStorage()
+        ckpt_persist.gc_steps(st, ckpt_dir, keep_latest=1)
+        assert os.path.isdir(inflight), "gc deleted an in-flight dir"
+        assert os.path.isdir(ckpt_persist.step_dir(ckpt_dir, 2))
+        assert not os.path.isdir(ckpt_persist.step_dir(ckpt_dir, 1))
+
+    def test_verify_step_reports_reasons(self, job_name, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpts")
+        self._save(ckpt_dir, job_name, [1])
+        st = PosixDiskStorage()
+        ok, reason = ckpt_persist.verify_step(st, ckpt_dir, 1)
+        assert ok, reason
+        d = ckpt_persist.step_dir(ckpt_dir, 1)
+        os.remove(os.path.join(d, "done_0"))
+        ok, reason = ckpt_persist.verify_step(st, ckpt_dir, 1)
+        assert not ok and "done" in reason
+        ckpt_persist.quarantine_step(st, ckpt_dir, 1, "test reason")
+        ok, reason = ckpt_persist.verify_step(st, ckpt_dir, 1)
+        assert not ok and reason == "quarantined"
+        assert ckpt_persist.quarantine_reason(st, ckpt_dir, 1) == (
+            "test reason"
+        )
+
+
+@pytest.mark.chaos
+class TestStragglerDetection:
+    def test_chaos_straggle_lands_in_step_wall_time(
+        self, monkeypatch, job_name
+    ):
+        """The trainer.step site inflates the straggled step's measured
+        wall time — the signal the master's speed monitor consumes."""
+        import optax
+
+        from dlrover_tpu.accel import ParallelSpec
+        from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+        from dlrover_tpu.train.trainer import Trainer, TrainerCallback
+
+        import dataclasses as dc
+
+        import jax
+        import jax.numpy as jnp
+
+        cfg = dc.replace(GPTConfig.tiny(), dtype=jnp.float32)
+
+        def token_loss(module, params, batch):
+            return loss_fn(module.apply({"params": params}, batch), batch)
+
+        def batches(n=64, batch=4):
+            key = jax.random.PRNGKey(7)
+            for i in range(n):
+                yield jax.random.randint(
+                    jax.random.fold_in(key, i), (batch, 16), 0,
+                    cfg.vocab_size,
+                )
+
+        times = {}
+
+        class Capture(TrainerCallback):
+            def on_step_end(self, trainer, step, metrics):
+                times[step] = metrics["step_time_s"]
+
+        arm(monkeypatch, FaultPlan(seed=5, events=[
+            FaultEvent(site="trainer.step", kind="straggle", at=4,
+                       delay_s=0.4),
+        ]))
+        trainer = Trainer(
+            GPT(cfg), optax.adamw(1e-3), token_loss, next(batches()),
+            spec=ParallelSpec(), report_metrics=False,
+            callbacks=[Capture()],
+        )
+        trainer.fit(batches(), steps=5, pipeline=False)
+        # occurrence 4 of the site = loop index 3 = 1-based step 4
+        assert times[4] > 0.35, times
+        # a healthy post-compile step is far below the injected delay
+        assert times[3] < 0.35, times
+
+    def test_speed_monitor_flags_stalled_worker(self):
+        from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+
+        mon = SpeedMonitor(hang_seconds=0.3)
+        mon.collect_global_step(1, time.time(), worker_id=0)
+        mon.collect_global_step(1, time.time(), worker_id=1)
+        time.sleep(0.4)
+        mon.collect_global_step(2, time.time(), worker_id=1)
+        assert mon.worker_hang(0), "stalled worker not flagged"
+        assert not mon.worker_hang(1)
+
+
+def _run_cli(cli_args, extra_env=None, timeout=240):
+    cmd = [sys.executable, "-m", "dlrover_tpu.cli", *cli_args]
+    return subprocess.run(
+        cmd, env=cpu_subprocess_env(extra_env), timeout=timeout,
+        capture_output=True, text=True,
+    )
+
+
+@pytest.mark.chaos
+@pytest.mark.e2e
+@pytest.mark.slow
+class TestEndToEndDrills:
+    """Process-spawning drills: real agent, real workers, chaos armed
+    through the environment alone. Heavy — selected via -m chaos."""
+
+    def _kill_drill(self, tmp_path, tag, journal):
+        # at=18 ~ 3.6 s of 0.2 s monitor polls: past worker startup
+        # (~1.8 s, so snapshots exist to flush) and well before the
+        # 14 x 0.3 s step budget runs out (~6 s) — a genuine mid-run kill.
+        plan = FaultPlan(seed=11, events=[
+            FaultEvent(site="agent.monitor", kind="kill", at=18,
+                       args={"rank": 0}),
+        ])
+        job = f"chaos-{uuid.uuid4().hex[:6]}"
+        ckpt_dir = str(tmp_path / f"ckpts-{tag}")
+        marker = str(tmp_path / f"resumed-{tag}.txt")
+        final = str(tmp_path / f"final-{tag}.bin")
+        result = _run_cli(
+            [
+                "--standalone", "--nproc_per_node=1", f"--job_name={job}",
+                "--monitor_interval=0.2", "--max_restarts=2",
+                SCRIPT, "--",
+                "--steps", "14", "--step-sleep", "0.3",
+                "--ckpt-dir", ckpt_dir, "--persist-every", "50",
+                "--resume-marker", marker, "--final-state", final,
+            ],
+            extra_env={
+                CHAOS_ENV: plan.to_json(),
+                CHAOS_LOG_ENV: journal,
+            },
+        )
+        assert result.returncode == 0, result.stderr[-3000:]
+        assert os.path.exists(marker), "worker was never killed + resumed"
+        return open(final, "rb").read()
+
+    def test_worker_kill_resumes_bit_identical(self, tmp_path):
+        """Kill a worker mid-step from the agent's monitor loop; the
+        flushed snapshot resumes and the final weights are bit-identical
+        to an uninterrupted run — and the fault journal is reproducible
+        across runs with the same seed."""
+        j1 = str(tmp_path / "journal1.jsonl")
+        final_killed = self._kill_drill(tmp_path, "a", j1)
+
+        # uninterrupted reference run, chaos off
+        job = f"chaos-{uuid.uuid4().hex[:6]}"
+        final_ref = str(tmp_path / "final-ref.bin")
+        result = _run_cli(
+            [
+                "--standalone", "--nproc_per_node=1", f"--job_name={job}",
+                "--monitor_interval=0.2",
+                SCRIPT, "--",
+                "--steps", "14",
+                "--ckpt-dir", str(tmp_path / "ckpts-ref"),
+                "--persist-every", "50", "--final-state", final_ref,
+            ],
+        )
+        assert result.returncode == 0, result.stderr[-3000:]
+        assert final_killed == open(final_ref, "rb").read(), (
+            "crash+resume diverged from the uninterrupted run"
+        )
+
+        # same seed -> identical fault journal
+        j2 = str(tmp_path / "journal2.jsonl")
+        self._kill_drill(tmp_path, "b", j2)
+        assert open(j1).read() == open(j2).read(), (
+            "fault schedule was not reproducible for the same seed"
+        )
+
+    def test_master_restart_mid_training(self, tmp_path):
+        """Kill the master mid-run and relaunch it at the same port;
+        the agent+worker ride out the outage and the job completes."""
+        job = f"mchaos-{uuid.uuid4().hex[:6]}"
+        port_file = str(tmp_path / "port")
+
+        def start_master(port=0):
+            args = [
+                sys.executable, "-m", "dlrover_tpu.master.main",
+                "--node_num", "1", "--job_name", job,
+            ]
+            if port:
+                args += ["--port", str(port)]
+            else:
+                args += ["--port_file", port_file]
+            return subprocess.Popen(args, env=cpu_subprocess_env())
+
+        master = start_master()
+        agent = None
+        master2 = None
+        try:
+            deadline = time.monotonic() + 30
+            while not os.path.exists(port_file):
+                assert time.monotonic() < deadline, "master never started"
+                time.sleep(0.05)
+            port = int(open(port_file).read().strip())
+            agent = subprocess.Popen(
+                [
+                    sys.executable, "-m", "dlrover_tpu.cli",
+                    "--nnodes=1", "--nproc_per_node=1", "--node_rank=0",
+                    f"--master_addr=127.0.0.1:{port}",
+                    f"--job_name={job}", "--monitor_interval=0.2",
+                    "--max_restarts=2",
+                    SCRIPT, "--", "--steps", "30", "--step-sleep", "0.25",
+                    "--ckpt-dir", str(tmp_path / "ckpts"),
+                    "--persist-every", "50",
+                ],
+                env=cpu_subprocess_env(), stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+            import glob
+
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if glob.glob(f"/dev/shm/ckpt_{job}_n*_rank0"):
+                    break
+                time.sleep(0.5)
+            assert glob.glob(f"/dev/shm/ckpt_{job}_n*_rank0"), (
+                "worker never started saving snapshots"
+            )
+            master.kill()
+            master.wait(timeout=10)
+            time.sleep(2)  # a real outage window
+            master2 = start_master(port=port)
+            out, _ = agent.communicate(timeout=240)
+            assert agent.returncode == 0, out[-4000:]
+            master2.wait(timeout=30)
+            assert master2.returncode == 0
+        finally:
+            for p in (agent, master, master2):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
