@@ -6,12 +6,13 @@ metrics, failures and sync barriers.
 """
 
 import os
+import threading
 import time
 from typing import Dict, Optional, Tuple
 
 from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.backoff import ExponentialBackoff
-from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.constants import NodeEnv, NodeStatus
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RpcClient
 
@@ -22,9 +23,17 @@ class MasterClient:
     def __init__(self, master_addr: str, node_id: int = 0,
                  node_type: str = "worker"):
         self._client = RpcClient(master_addr)
+        self._client.on_incarnation_change = self._on_master_incarnation_change
         self._node_id = node_id
         self._node_type = node_type
         self.master_addr = master_addr
+        # Shard tasks fetched but not yet acked, keyed by
+        # (dataset, task_id) — what a fenced client re-reports to the
+        # new master incarnation so records it holds are neither
+        # re-dispatched to someone else nor dropped.
+        self._inflight_tasks: Dict[Tuple[str, int], m.ShardTask] = {}
+        self._inflight_lock = threading.Lock()
+        self.fenced_count = 0
 
     # ---------------- singleton wiring ----------------
     @classmethod
@@ -50,6 +59,55 @@ class MasterClient:
 
     def _call(self, req, timeout: Optional[float] = None):
         return self._client.call(self._fill(req), timeout=timeout)
+
+    # ---------------- incarnation fencing ----------------
+    def _on_master_incarnation_change(self, old: int, new: int):
+        """The master restarted (response stamps jumped old -> new):
+        re-register this node with the new incarnation and re-report
+        every in-flight shard task. Invoked by the transport outside its
+        lock, on the thread that observed the change; RPCs issued here
+        are ordinary calls against the new master."""
+        with self._inflight_lock:
+            tasks = list(self._inflight_tasks.values())
+        self.fenced_count += 1
+        logger.warning(
+            "master incarnation changed %s -> %s: re-registering node %s "
+            "and re-reporting %s in-flight shard task(s)",
+            old, new, self._node_id, len(tasks),
+        )
+        try:
+            self.report_node_status(NodeStatus.RUNNING)
+            self.report_heartbeat()
+        except Exception as e:
+            logger.warning("fencing re-registration failed: %s", e)
+        for task in tasks:
+            try:
+                resp = self._call(m.TaskHoldReport(
+                    dataset_name=task.dataset_name,
+                    task_id=task.task_id,
+                    start=task.start,
+                    end=task.end,
+                    shard_name=task.shard_name,
+                    record_indices=task.record_indices,
+                ))
+                if resp is not None and not resp.success:
+                    # The new master refused the hold (the task was
+                    # already acked or re-dispatched): drop our claim so
+                    # a later report_task doesn't double-account it.
+                    logger.warning(
+                        "master rejected hold of shard task %s/%s; "
+                        "dropping the local claim",
+                        task.dataset_name, task.task_id,
+                    )
+                    with self._inflight_lock:
+                        self._inflight_tasks.pop(
+                            (task.dataset_name, task.task_id), None
+                        )
+            except Exception as e:
+                logger.warning(
+                    "fencing hold-report of task %s/%s failed: %s",
+                    task.dataset_name, task.task_id, e,
+                )
 
     # ---------------- rendezvous ----------------
     def join_rendezvous(self, rdzv_name: str, node_rank: int,
@@ -123,6 +181,9 @@ class MasterClient:
     def kv_store_multi_get(self, keys) -> Dict[str, Optional[bytes]]:
         return self._call(m.KVStoreMultiGet(keys=tuple(keys)))
 
+    def kv_store_delete(self, key: str):
+        return self._call(m.KVStoreDelete(key=key))
+
     def kv_store_wait(self, keys, timeout: float = 300.0) -> Dict[str, bytes]:
         # Jittered backoff, not a fixed 0.1 s poll: every worker of the
         # job waits on the same barrier keys at the same moment, and
@@ -161,13 +222,20 @@ class MasterClient:
         )
 
     def get_task(self, dataset_name: str) -> m.ShardTask:
-        return self._call(m.TaskRequest(dataset_name=dataset_name))
+        task = self._call(m.TaskRequest(dataset_name=dataset_name))
+        if task is not None and task.exists:
+            with self._inflight_lock:
+                self._inflight_tasks[(task.dataset_name, task.task_id)] = task
+        return task
 
     def report_task(self, dataset_name: str, task_id: int, success: bool = True):
-        return self._call(
+        resp = self._call(
             m.TaskReport(dataset_name=dataset_name, task_id=task_id,
                          success=success)
         )
+        with self._inflight_lock:
+            self._inflight_tasks.pop((dataset_name, task_id), None)
+        return resp
 
     def get_shard_checkpoint(self, dataset_name: str) -> str:
         resp: m.ShardCheckpoint = self._call(

@@ -120,6 +120,11 @@ class KVStoreMultiGet(BaseRequest):
     keys: Tuple[str, ...] = ()
 
 
+@dataclass
+class KVStoreDelete(BaseRequest):
+    key: str = ""
+
+
 # ---------------- dynamic data sharding ----------------
 
 
@@ -166,6 +171,26 @@ class TaskReport(BaseRequest):
     dataset_name: str = ""
     task_id: int = -1
     success: bool = True
+
+
+@dataclass
+class TaskHoldReport(BaseRequest):
+    """Fencing re-report: "I am still holding this dispatched shard".
+
+    Sent by a client that observed a master incarnation change, for every
+    task it fetched but has not yet acked. A recovered master that knows
+    the task (journal replay) just reaffirms the assignment; one that
+    lost it (e.g. the dispatch raced the crash) re-installs the shard
+    from the carried range so the records cannot be dispatched twice or
+    dropped.
+    """
+
+    dataset_name: str = ""
+    task_id: int = -1
+    start: int = 0
+    end: int = 0
+    shard_name: str = ""
+    record_indices: Optional[List[int]] = None
 
 
 @dataclass

@@ -26,6 +26,17 @@ from dlrover_tpu.common.log import logger
 
 _LEN = struct.Struct(">I")
 
+# The id of the envelope currently being dispatched by an RpcServer
+# worker thread. Handlers that need it (the master's WAL keys journal
+# records by request id so replayed responses can re-seed the dedup
+# cache) read it via current_request_id() instead of widening every
+# handler signature.
+_req_ctx = threading.local()
+
+
+def current_request_id() -> Optional[str]:
+    return getattr(_req_ctx, "req_id", None)
+
 # Control-plane timing contract, derived from one place so the pieces
 # cannot drift apart. The dedup cache must remember a request id for
 # STRICTLY LONGER than any client can still be retrying it, otherwise a
@@ -132,6 +143,12 @@ class RpcServer:
     def __init__(self, port: int, handler: Callable[[Any], Any], host: str = "0.0.0.0"):
         self._handler = handler
         self._dedup = _DedupCache()
+        # Monotonic boot counter of the process logically behind this
+        # server (the master's incarnation). When set, every response is
+        # stamped with it so clients can detect a master restart — the
+        # fencing signal that triggers re-registration. None (the
+        # default) keeps the legacy 2-tuple wire format.
+        self.incarnation: Optional[int] = None
         # Established per-client connections, so stop() can sever them:
         # a killed master process drops every socket, and the in-process
         # analog (tests, graceful handover) must behave the same — a
@@ -178,6 +195,7 @@ class RpcServer:
                         outer._dedup.begin(req_id) if req_id else (False, None)
                     )
                     if not duplicate:
+                        _req_ctx.req_id = req_id
                         try:
                             response = (True, outer._handler(request))
                         except Exception as e:
@@ -185,8 +203,16 @@ class RpcServer:
                                 "rpc handler error for %r", type(request)
                             )
                             response = (False, repr(e))
+                        finally:
+                            _req_ctx.req_id = None
                         if req_id is not None:
                             outer._dedup.finish(req_id, response)
+                    if outer.incarnation is not None:
+                        # Stamp at send time (not into the dedup cache):
+                        # a cache entry seeded from the previous
+                        # incarnation's journal still answers with THIS
+                        # incarnation.
+                        response = response + (outer.incarnation,)
                     if chaos is not None and chaos.kind == "drop_response":
                         # Executed and dedup-cached, but the answer is
                         # lost: the retry MUST be served from the cache,
@@ -207,6 +233,16 @@ class RpcServer:
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
+    def seed_dedup(self, req_id: str, result: Any):
+        """Pre-populate the dedup cache with a replayed response.
+
+        The cache dies with the master process; a recovered master
+        re-seeds it from its journal so a client retry of a request the
+        OLD incarnation already applied is answered from cache instead
+        of being re-applied — the exactly-once half of failover.
+        """
+        self._dedup.finish(req_id, (True, result))
+
     def start(self):
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="rpc-server", daemon=True
@@ -214,7 +250,10 @@ class RpcServer:
         self._thread.start()
 
     def stop(self):
-        self._server.shutdown()
+        if self._thread is not None:
+            # socketserver.shutdown() blocks until serve_forever acks;
+            # if start() was never called that ack never comes.
+            self._server.shutdown()
         self._server.server_close()
         with self._conns_lock:
             conns = list(self._conns)
@@ -253,6 +292,13 @@ class RpcClient:
         self._connect_timeout = connect_timeout
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # Last master incarnation observed in a response (None until an
+        # incarnation-stamping server answers). A change means the
+        # master restarted: the observer below is invoked once per
+        # transition so the owner can re-register with the new master.
+        self.incarnation: Optional[int] = None
+        self.on_incarnation_change: Optional[Callable[[int, int], None]] = None
+        self._fencing = threading.local()
         # First-failure timestamp of the CURRENT outage, shared by all
         # threads on this client: every caller measures the retry
         # window from the same start, so N threads queued on a dead
@@ -274,6 +320,7 @@ class RpcClient:
         envelope = (uuid.uuid4().hex, request)
         delay = 0.1
         reported = False
+        fence = None
         while True:
             outage_err = None
             with self._lock:
@@ -304,7 +351,25 @@ class RpcClient:
                                 )
                         self._sock.settimeout(timeout or self._timeout)
                         _send(self._sock, envelope)
-                        ok, payload = _recv(self._sock)
+                        resp = _recv(self._sock)
+                        if len(resp) == 3:
+                            ok, payload, inc = resp
+                        else:
+                            ok, payload = resp
+                            inc = None
+                        if inc is not None and not getattr(
+                            self._fencing, "active", False
+                        ):
+                            # Only the thread that performs the
+                            # old->new transition (under the lock)
+                            # fires the observer; RPCs issued BY the
+                            # observer leave self.incarnation alone so
+                            # a further restart mid-observer is
+                            # detected by the next regular call.
+                            prev = self.incarnation
+                            self.incarnation = inc
+                            if prev is not None and inc != prev:
+                                fence = (prev, inc)
                         self._down_since = None
                         break
                     except socket.timeout:
@@ -342,6 +407,18 @@ class RpcClient:
             # monitors) must not serialize behind this backoff.
             time.sleep(delay)
             delay = min(delay * 2, 2.0)
+        if fence is not None and self.on_incarnation_change is not None:
+            # Outside the lock: the observer re-registers over this same
+            # client, which must not deadlock or serialize other threads.
+            self._fencing.active = True
+            try:
+                self.on_incarnation_change(*fence)
+            except Exception:
+                logger.exception(
+                    "incarnation-change observer failed (%s -> %s)", *fence
+                )
+            finally:
+                self._fencing.active = False
         if not ok:
             raise RuntimeError(f"master rejected {type(request).__name__}: {payload}")
         return payload

@@ -40,3 +40,12 @@ class KVStoreService:
     def clear(self):
         with self._lock:
             self._store.clear()
+
+    # ------------- master state snapshot/restore -------------
+    def export_state(self) -> Dict[str, bytes]:
+        with self._lock:
+            return dict(self._store)
+
+    def restore_state(self, state: Dict[str, bytes]):
+        with self._lock:
+            self._store = dict(state)

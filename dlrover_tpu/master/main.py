@@ -4,6 +4,7 @@ Parity: reference ``master/main.py`` + ``args.py``.
 """
 
 import argparse
+import os
 import sys
 
 from dlrover_tpu.common.log import logger
@@ -20,17 +21,32 @@ def parse_args(argv=None):
     )
     parser.add_argument("--port_file", type=str, default="",
                         help="write the bound port to this file once serving")
+    parser.add_argument("--state_dir", type=str, default="",
+                        help="persist master state (snapshots + WAL) here; "
+                        "a relaunched master with the same dir resumes the "
+                        "previous incarnation's job state")
     return parser.parse_args(argv)
+
+
+def write_port_file(path: str, port: int):
+    """Atomic write: pollers either see nothing or the full port number,
+    never an empty/partial file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(str(port))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def run(args) -> int:
     master = JobMaster(
-        port=args.port, node_num=args.node_num, job_name=args.job_name
+        port=args.port, node_num=args.node_num, job_name=args.job_name,
+        state_dir=args.state_dir,
     )
     master.prepare()
     if args.port_file:
-        with open(args.port_file, "w") as f:
-            f.write(str(master.port))
+        write_port_file(args.port_file, master.port)
     return master.run()
 
 

@@ -13,6 +13,7 @@ from typing import Optional
 from dlrover_tpu.common.constants import JobStage, RendezvousName
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import DEDUP_TTL
 from dlrover_tpu.master.kv_store import KVStoreService
 from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.node_manager import JobManager, LocalJobManager
@@ -22,6 +23,7 @@ from dlrover_tpu.master.rendezvous import (
 )
 from dlrover_tpu.master.servicer import MasterServicer, create_master_service
 from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.state_store import MasterStateStore
 from dlrover_tpu.master.stats import JobMetricCollector
 from dlrover_tpu.master.sync_service import SyncService
 
@@ -34,9 +36,20 @@ class JobMaster:
         job_name: str = "local-job",
         job_manager: Optional[JobManager] = None,
         scaler=None,
+        state_dir: str = "",
     ):
         ctx = get_context()
         self.job_name = job_name
+        # Durable state (opt-in via --state_dir): snapshots + WAL so a
+        # relaunched master at the same address resumes the previous
+        # incarnation's shard cursors, kv store, node registry and
+        # rendezvous rounds instead of booting blank.
+        self.state_store: Optional[MasterStateStore] = None
+        self.incarnation = 0
+        self.last_recovery_stats = {}
+        if state_dir:
+            self.state_store = MasterStateStore(state_dir)
+            self.incarnation = self.state_store.next_incarnation()
         self.speed_monitor = SpeedMonitor(hang_seconds=ctx.hang_detection_seconds)
         self.job_manager = job_manager or LocalJobManager(
             node_num=node_num, heartbeat_timeout=ctx.heartbeat_timeout
@@ -58,6 +71,10 @@ class JobMaster:
         self.kv_store = KVStoreService()
         self.sync_service = SyncService(self.job_manager)
         self.metric_collector = JobMetricCollector()
+        if self.state_store is not None:
+            self.task_manager.set_journal(self.state_store.append)
+            for mgr in self.rdzv_managers.values():
+                mgr.set_state_listener(self._journal_rdzv_state)
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             kv_store=self.kv_store,
@@ -66,9 +83,17 @@ class JobMaster:
             speed_monitor=self.speed_monitor,
             sync_service=self.sync_service,
             metric_collector=self.metric_collector,
+            state_store=self.state_store,
         )
         self._server = create_master_service(port, self.servicer)
         self.port = self._server.port
+        if self.state_store is not None:
+            self._server.incarnation = self.incarnation
+            self._recover_state()
+            # Fold whatever was recovered into a fresh generation right
+            # away: opens this incarnation's journal and bounds the next
+            # recovery's replay to post-boot mutations.
+            self.state_store.snapshot(self._collect_state)
         self.stage = JobStage.INIT
         self._stopped = threading.Event()
         self._abort_reason: Optional[str] = None
@@ -93,6 +118,101 @@ class JobMaster:
     @property
     def addr(self) -> str:
         return f"127.0.0.1:{self.port}"
+
+    # ------------- durable state -------------
+    def _journal_rdzv_state(self, name: str, state: dict):
+        # Absolute counter values, so replaying a duplicate is a no-op
+        # (restore() takes the max) and journal-after-apply is safe.
+        self.state_store.append(("rdzv", name, state, time.time()))
+
+    def _collect_state(self) -> dict:
+        return {
+            "version": 1,
+            "incarnation": self.incarnation,
+            "time": time.time(),
+            "task_manager": self.task_manager.checkpoint(),
+            "kv": self.kv_store.export_state(),
+            "nodes": self.job_manager.export_nodes(),
+            "rdzv": {
+                name: mgr.checkpoint()
+                for name, mgr in self.rdzv_managers.items()
+            },
+            "speed": self.speed_monitor.checkpoint(),
+        }
+
+    def _recover_state(self):
+        """Load the newest valid snapshot, replay the journal chain over
+        it, and seed the RPC dedup cache with the replayed responses so
+        in-flight client retries are answered, not re-applied."""
+        store = self.state_store
+        state, records = store.recover()
+        if state is None and not records:
+            return
+        store.replaying = True
+        seeds = []
+        now = time.time()
+        applied = 0
+        try:
+            if state is not None:
+                self.task_manager.restore(
+                    state.get("task_manager", ""), exact=True
+                )
+                self.kv_store.restore_state(state.get("kv", {}))
+                self.job_manager.restore_nodes(state.get("nodes", []))
+                for name, st in state.get("rdzv", {}).items():
+                    mgr = self.rdzv_managers.get(name)
+                    if mgr is not None:
+                        mgr.restore(st)
+                self.speed_monitor.restore(state.get("speed", {}))
+            for rec in records:
+                try:
+                    kind = rec[0]
+                    if kind == "rpc":
+                        _, req_id, request, ts = rec
+                        resp = self.servicer.handle(request)
+                        if req_id and now - ts < DEDUP_TTL:
+                            seeds.append((req_id, resp))
+                    elif kind == "dispatch":
+                        _, req_id, d, ts = rec
+                        task = self.task_manager.replay_dispatch(d)
+                        if req_id and task is not None and now - ts < DEDUP_TTL:
+                            seeds.append((req_id, task))
+                    elif kind == "shards":
+                        _, dataset, st, ts = rec
+                        self.task_manager.replay_shards(dataset, st)
+                    elif kind == "reclaim":
+                        _, dataset, ids, ts = rec
+                        self.task_manager.replay_reclaim(dataset, ids)
+                    elif kind == "evict":
+                        _, node_id, reason, ts = rec
+                        self._evict_node(node_id, f"replayed: {reason}")
+                    elif kind == "rdzv":
+                        _, name, st, ts = rec
+                        mgr = self.rdzv_managers.get(name)
+                        if mgr is not None:
+                            mgr.restore(st)
+                    else:
+                        logger.warning("skipping unknown journal record %r",
+                                       kind)
+                        continue
+                    applied += 1
+                except Exception:
+                    logger.exception("skipping unreplayable journal record")
+        finally:
+            store.replaying = False
+        for req_id, resp in seeds:
+            self._server.seed_dedup(req_id, resp)
+        stats = dict(store.last_recovery_stats)
+        stats.update(replayed=applied, dedup_seeded=len(seeds))
+        self.last_recovery_stats = stats
+        logger.info(
+            "recovered master state: incarnation=%s snapshot_seq=%s "
+            "journal_records=%s replayed=%s dedup_seeded=%s torn_tails=%s "
+            "quarantined=%s",
+            self.incarnation, stats.get("snapshot_seq"),
+            stats.get("journal_records"), applied, len(seeds),
+            stats.get("torn_tails"), stats.get("quarantined_snapshots"),
+        )
 
     def prepare(self):
         self._server.start()
@@ -159,6 +279,8 @@ class JobMaster:
                     # stale report times re-arms detection instead of
                     # re-firing every pass.
                     self.speed_monitor.reset_worker_reports()
+                if self.state_store is not None:
+                    self.state_store.maybe_snapshot(self._collect_state)
                 if not self.job_manager.all_nodes():
                     self._abort_reason = "all nodes lost"
                     return
@@ -170,6 +292,18 @@ class JobMaster:
 
         get_tracer().instant("evict-node", node_id=node_id, reason=reason)
         logger.error("evicting node %s: %s", node_id, reason)
+        store = self.state_store
+        if store is not None and not store.replaying:
+            # Write-ahead, under the mutation lock so the eviction's
+            # queue requeues serialize against concurrent RPC mutations
+            # in journal order.
+            with store.mutation_lock:
+                store.append(("evict", node_id, reason, time.time()))
+                self._apply_evict(node_id, reason)
+            return
+        self._apply_evict(node_id, reason)
+
+    def _apply_evict(self, node_id: int, reason: str):
         self.job_manager.remove_node(node_id, reason)
         for mgr in self.rdzv_managers.values():
             mgr.remove_alive_node(node_id)
@@ -181,7 +315,9 @@ class JobMaster:
         """Block until the job finishes; returns an exit code."""
         try:
             while not self._stopped.is_set():
-                time.sleep(poll_interval)
+                # Event.wait, not sleep: stop() takes effect immediately
+                # instead of up to a full poll interval later.
+                self._stopped.wait(poll_interval)
                 exit_req = self.servicer.job_exit_request()
                 if exit_req is not None:
                     self.stage = (
@@ -209,6 +345,15 @@ class JobMaster:
         if self.auto_scaler is not None:
             self.auto_scaler.stop()
         self._server.stop()
+        if self.state_store is not None:
+            # Sockets are severed, so no mutation can race the final
+            # snapshot; best-effort — a failure here is exactly the
+            # crash case the journal already covers.
+            try:
+                self.state_store.snapshot(self._collect_state)
+            except Exception:
+                logger.exception("final state snapshot failed")
+            self.state_store.close()
 
 
 # Aliases matching the reference composition names.

@@ -81,3 +81,16 @@ class SpeedMonitor:
 
     def reset_running_speed_monitor(self):
         self._samples.clear()
+
+    # ------------- master state snapshot/restore -------------
+    def checkpoint(self) -> dict:
+        return {"global_step": self._global_step}
+
+    def restore(self, state: dict):
+        """Reload the global step (throughput samples and per-worker
+        report times are intentionally ephemeral: speed re-derives from
+        fresh reports and stale report times would trip hang detection
+        against the pre-crash clock)."""
+        self._global_step = max(
+            self._global_step, int(state.get("global_step", 0))
+        )

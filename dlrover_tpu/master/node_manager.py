@@ -237,6 +237,44 @@ class JobManager:
     def stop(self):
         self._stopped = True
 
+    # ------------- master state snapshot/restore -------------
+    def export_nodes(self) -> List[Dict]:
+        with self._lock:
+            return [
+                {
+                    "id": n.id,
+                    "type": n.type,
+                    "rank_index": n.rank_index,
+                    "name": n.name,
+                    "status": n.status,
+                    "exit_reason": n.exit_reason,
+                    "relaunch_count": n.relaunch_count,
+                    "relaunchable": n.relaunchable,
+                    "max_relaunch_count": n.max_relaunch_count,
+                }
+                for n in self._nodes.values()
+            ]
+
+    def restore_nodes(self, dumped: List[Dict]):
+        with self._lock:
+            self._nodes.clear()
+            for d in dumped:
+                node = Node(
+                    d["type"], d["id"], rank_index=d.get("rank_index"),
+                    name=d.get("name", ""),
+                    max_relaunch_count=d.get(
+                        "max_relaunch_count", self._max_relaunch_count
+                    ),
+                )
+                node.status = d.get("status", NodeStatus.INITIAL)
+                node.exit_reason = d.get("exit_reason", "")
+                node.relaunch_count = d.get("relaunch_count", 0)
+                node.relaunchable = d.get("relaunchable", True)
+                # heartbeat_time stays 0: find_dead_nodes skips such
+                # nodes, so a restored registry cannot mass-evict before
+                # fenced clients re-register and heartbeat again.
+                self._nodes[node.id] = node
+
 
 class LocalJobManager(JobManager):
     """Single-host deployment: the agent supervises processes itself."""

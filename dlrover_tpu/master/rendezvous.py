@@ -44,6 +44,40 @@ class RendezvousManager(ABC):
         # Rounds <= this are invalidated (a member died); survivors must
         # re-rendezvous.
         self._stale_round = 0
+        # Observer fired (outside the lock) whenever the round/stale
+        # counters change — the state-store-backed master journals the
+        # new values so a relaunched master cannot hand out already-used
+        # round numbers, which would make world_stale() mis-classify
+        # agents holding previous-incarnation round tokens.
+        self._on_state_change = None
+
+    def set_state_listener(self, listener):
+        self._on_state_change = listener
+
+    def checkpoint(self) -> dict:
+        with self._lock:
+            return {
+                "round": self._rdzv_round,
+                "stale_round": self._stale_round,
+            }
+
+    def restore(self, state: dict):
+        with self._lock:
+            self._rdzv_round = max(
+                self._rdzv_round, int(state.get("round", 0))
+            )
+            self._stale_round = max(
+                self._stale_round, int(state.get("stale_round", 0))
+            )
+
+    def _notify_state(self):
+        """Call WITHOUT the lock held."""
+        listener = self._on_state_change
+        if listener is not None:
+            try:
+                listener(self.name, self.checkpoint())
+            except Exception:
+                logger.exception("rdzv state listener failed")
 
     # ---------------- configuration ----------------
     def update_rdzv_params(
@@ -65,6 +99,7 @@ class RendezvousManager(ABC):
             self._alive_nodes.add(node_rank)
 
     def remove_alive_node(self, node_rank: int):
+        changed = False
         with self._lock:
             self._alive_nodes.discard(node_rank)
             if node_rank in self._waiting_nodes:
@@ -74,12 +109,15 @@ class RendezvousManager(ABC):
                 # so surviving agents (polling world_stale) restart their
                 # workers and re-form without the dead node.
                 del self._rdzv_nodes[node_rank]
+                changed = self._stale_round != self._rdzv_round
                 self._stale_round = self._rdzv_round
                 logger.info(
                     "rdzv %s: node %s left active world; round %s is now "
                     "stale, survivors must re-form",
                     self.name, node_rank, self._rdzv_round,
                 )
+        if changed:
+            self._notify_state()
 
     def world_stale(self, round_: int) -> bool:
         """True when the given round was invalidated by a member death."""
@@ -89,13 +127,17 @@ class RendezvousManager(ABC):
     def invalidate_round(self):
         """Invalidate the current round without evicting anyone (hang
         recovery: every member flushes, restarts and rejoins)."""
+        changed = False
         with self._lock:
             if self._rdzv_nodes:
+                changed = self._stale_round != self._rdzv_round
                 self._stale_round = self._rdzv_round
                 logger.info(
                     "rdzv %s: round %s invalidated; members must re-form",
                     self.name, self._rdzv_round,
                 )
+        if changed:
+            self._notify_state()
 
     def join_rendezvous(
         self, node_rank: int, local_world_size: int = 1
@@ -160,14 +202,21 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
     """One global communication world per round."""
 
     def get_comm_world(self, node_rank: int):
-        with self._lock:
-            if node_rank in self._rdzv_nodes:
-                return self._rdzv_round, 0, dict(self._rdzv_nodes)
-            if self._freeze_ready():
-                self._freeze_round()
+        froze = False
+        try:
+            with self._lock:
                 if node_rank in self._rdzv_nodes:
                     return self._rdzv_round, 0, dict(self._rdzv_nodes)
-            return self._rdzv_round, 0, {}
+                if self._freeze_ready():
+                    before = self._rdzv_round
+                    self._freeze_round()
+                    froze = self._rdzv_round != before
+                    if node_rank in self._rdzv_nodes:
+                        return self._rdzv_round, 0, dict(self._rdzv_nodes)
+                return self._rdzv_round, 0, {}
+        finally:
+            if froze:
+                self._notify_state()
 
 
 class DeviceCheckRendezvousManager(RendezvousManager):
@@ -208,23 +257,30 @@ class DeviceCheckRendezvousManager(RendezvousManager):
             return self._rdzv_round
 
     def get_comm_world(self, node_rank: int):
-        with self._lock:
-            self._expire_round()
-            if not self._rdzv_nodes and self._freeze_ready():
-                self._freeze_round()
-                if self._rdzv_nodes:  # node_unit may admit zero nodes
-                    self._check_round += 1
-                    self._round_members[self._check_round] = set(
-                        self._rdzv_nodes
-                    )
-                    self._round_frozen_time = time.monotonic()
-                    self._groups = self._build_groups()
-            if node_rank in self._rdzv_nodes:
-                for group_idx, members in enumerate(self._groups):
-                    if node_rank in members:
-                        world = {r: self._rdzv_nodes[r] for r in members}
-                        return self._rdzv_round, group_idx, world
-            return self._rdzv_round, 0, {}
+        froze = False
+        try:
+            with self._lock:
+                self._expire_round()
+                if not self._rdzv_nodes and self._freeze_ready():
+                    before = self._rdzv_round
+                    self._freeze_round()
+                    froze = self._rdzv_round != before
+                    if self._rdzv_nodes:  # node_unit may admit zero nodes
+                        self._check_round += 1
+                        self._round_members[self._check_round] = set(
+                            self._rdzv_nodes
+                        )
+                        self._round_frozen_time = time.monotonic()
+                        self._groups = self._build_groups()
+                if node_rank in self._rdzv_nodes:
+                    for group_idx, members in enumerate(self._groups):
+                        if node_rank in members:
+                            world = {r: self._rdzv_nodes[r] for r in members}
+                            return self._rdzv_round, group_idx, world
+                return self._rdzv_round, 0, {}
+        finally:
+            if froze:
+                self._notify_state()
 
     def _expire_round(self):
         """With the lock held: time out members that never reported."""

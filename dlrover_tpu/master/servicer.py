@@ -6,13 +6,31 @@ diagnosis queries, kv-store, dynamic data sharding, metrics, sync barriers,
 failures, and the runtime-tunable parallel config.
 """
 
+import os
+import signal
 import time
 from typing import Any, Dict
 
+from dlrover_tpu.chaos.injector import fault_hit
 from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.common.rpc import RpcServer
+from dlrover_tpu.common.rpc import RpcServer, current_request_id
+
+#: Messages whose handlers mutate durable master state. With a state
+#: store attached, each is journaled WRITE-AHEAD (append, then apply,
+#: both under the store's mutation lock) so a crash between the two is
+#: recovered by replay and journal order equals apply order.
+_JOURNALED = (
+    m.DatasetShardParams,
+    m.TaskReport,
+    m.TaskHoldReport,
+    m.KVStoreSet,
+    m.KVStoreAdd,
+    m.KVStoreDelete,
+    m.NodeStatusReport,
+    m.NodeFailure,
+)
 
 
 class MasterServicer:
@@ -25,6 +43,7 @@ class MasterServicer:
         speed_monitor,
         sync_service,
         metric_collector=None,
+        state_store=None,
     ):
         self._rdzv_managers = rdzv_managers
         self._kv_store = kv_store
@@ -33,15 +52,51 @@ class MasterServicer:
         self._speed_monitor = speed_monitor
         self._sync_service = sync_service
         self._metric_collector = metric_collector
+        self._state_store = state_store
         self._paral_config = m.ParallelConfig()
         self._job_exit = None
         self._start_time = time.time()
 
     # The transport handler.
     def handle(self, request: Any) -> Any:
+        chaos = fault_hit("master.crash", detail=type(request).__name__)
+        if chaos is not None:
+            if chaos.kind == "kill":
+                # A real master death: no flushes, no atexit — exactly
+                # what SIGKILL on the pod looks like.
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif chaos.kind == "exit":
+                os._exit(1)
         handler = self._HANDLERS.get(type(request))
         if handler is None:
             raise ValueError(f"unknown control message {type(request).__name__}")
+        store = self._state_store
+        if store is None or store.replaying:
+            return handler(self, request)
+        if isinstance(request, m.TaskRequest):
+            # Dispatch is journaled AFTER the handler (apply-then-log):
+            # the record must carry the chosen shard's exact range, and
+            # a lost record is safe — the replayed master still holds
+            # the shard in todo and the fenced client re-reports it.
+            with store.mutation_lock:
+                task = handler(self, request)
+                if task.exists:
+                    store.append(("dispatch", current_request_id(), {
+                        "worker": request.node_id,
+                        "dataset": task.dataset_name,
+                        "task_id": task.task_id,
+                        "shard_name": task.shard_name,
+                        "start": task.start,
+                        "end": task.end,
+                        "record_indices": task.record_indices,
+                    }, time.time()))
+                return task
+        if isinstance(request, _JOURNALED):
+            with store.mutation_lock:
+                store.append(
+                    ("rpc", current_request_id(), request, time.time())
+                )
+                return handler(self, request)
         return handler(self, request)
 
     # ---------------- rendezvous ----------------
@@ -109,6 +164,10 @@ class MasterServicer:
     def _kv_multi_get(self, req: m.KVStoreMultiGet):
         return self._kv_store.multi_get(req.keys)
 
+    def _kv_delete(self, req: m.KVStoreDelete):
+        self._kv_store.delete(req.key)
+        return m.Response()
+
     # ---------------- data sharding ----------------
     def _new_dataset(self, req: m.DatasetShardParams):
         self._task_manager.new_dataset(
@@ -127,6 +186,18 @@ class MasterServicer:
     def _report_task(self, req: m.TaskReport):
         ok = self._task_manager.report_task(
             req.dataset_name, req.task_id, req.success
+        )
+        return m.Response(success=ok)
+
+    def _report_task_hold(self, req: m.TaskHoldReport):
+        ok = self._task_manager.reclaim_task(
+            req.node_id, req.dataset_name, {
+                "task_id": req.task_id,
+                "shard_name": req.shard_name,
+                "start": req.start,
+                "end": req.end,
+                "record_indices": req.record_indices,
+            },
         )
         return m.Response(success=ok)
 
@@ -243,9 +314,11 @@ MasterServicer._HANDLERS = {
     m.KVStoreGet: MasterServicer._kv_get,
     m.KVStoreAdd: MasterServicer._kv_add,
     m.KVStoreMultiGet: MasterServicer._kv_multi_get,
+    m.KVStoreDelete: MasterServicer._kv_delete,
     m.DatasetShardParams: MasterServicer._new_dataset,
     m.TaskRequest: MasterServicer._get_task,
     m.TaskReport: MasterServicer._report_task,
+    m.TaskHoldReport: MasterServicer._report_task_hold,
     m.ShardCheckpointRequest: MasterServicer._get_shard_checkpoint,
     m.DatasetEpochRequest: MasterServicer._get_dataset_epoch,
     m.GlobalStep: MasterServicer._report_step,

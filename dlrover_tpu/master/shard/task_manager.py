@@ -51,6 +51,12 @@ class DatasetManager:
         )
         self._task_id = 0
         self._completed_tasks = 0
+        # WAL hook (MasterStateStore.append). Shard *creation* and
+        # timeout *reclaims* mutate the queues outside any RPC record —
+        # without journaling them a replayed master would re-split with
+        # a different shuffle (double-dispatch) or resurrect reclaimed
+        # doing entries (lost shards).
+        self.journal = None
 
     def _requeue(self, task: ShardTask):
         """Re-dispatch under a FRESH task id: a late ack from the
@@ -67,6 +73,11 @@ class DatasetManager:
             tid for tid, d in self.doing.items()
             if now - d.start_time > self.doing_timeout
         ]
+        if stale and self.journal is not None:
+            self.journal(
+                ("reclaim", self.splitter.dataset_name, list(stale),
+                 time.time())
+            )
         for tid in stale:
             doing = self.doing.pop(tid)
             logger.warning(
@@ -79,8 +90,42 @@ class DatasetManager:
         self._reclaim_stale()
         if self.todo or self.splitter.epoch_finished():
             return
+        created = []
         for shard in self.splitter.create_shards():
-            self.todo.append(self._new_task(shard))
+            task = self._new_task(shard)
+            self.todo.append(task)
+            created.append(task)
+        if created and self.journal is not None:
+            # Shuffling splitters draw from the global RNG, so a replay
+            # cannot re-split identically — journal the exact ranges and
+            # the splitter cursor AFTER the split instead.
+            self.journal(
+                ("shards", self.splitter.dataset_name, {
+                    "splitter": self.splitter.checkpoint(),
+                    "tasks": [self._task_dict(t) for t in created],
+                }, time.time())
+            )
+
+    @staticmethod
+    def _task_dict(task: ShardTask) -> dict:
+        return {
+            "task_id": task.task_id,
+            "shard_name": task.shard_name,
+            "start": task.start,
+            "end": task.end,
+            "record_indices": task.record_indices,
+        }
+
+    @staticmethod
+    def _task_from_dict(d: dict, dataset_name: str) -> ShardTask:
+        return ShardTask(
+            task_id=d["task_id"],
+            dataset_name=dataset_name,
+            shard_name=d.get("shard_name", ""),
+            start=d["start"],
+            end=d["end"],
+            record_indices=d.get("record_indices"),
+        )
 
     def _new_task(self, shard: Shard) -> ShardTask:
         task = ShardTask(
@@ -122,6 +167,69 @@ class DatasetManager:
             self._requeue(self.doing.pop(tid).task)
         return len(stale)
 
+    # ------------- journal replay + fencing reclaim -------------
+    def replay_shards(self, state: dict):
+        """Re-apply a journaled split: exact ranges, exact ids."""
+        self.splitter.restore(state.get("splitter", {}))
+        known = {t.task_id for t in self.todo} | set(self.doing)
+        for d in state.get("tasks", []):
+            if d["task_id"] in known:
+                continue
+            self.todo.append(
+                self._task_from_dict(d, self.splitter.dataset_name)
+            )
+            self._task_id = max(self._task_id, d["task_id"] + 1)
+
+    def replay_dispatch(self, d: dict) -> Optional[ShardTask]:
+        """Re-apply a journaled get_task answer; returns the task so the
+        caller can re-seed the RPC dedup cache with it."""
+        tid = d["task_id"]
+        self._task_id = max(self._task_id, tid + 1)
+        if tid in self.doing:  # duplicated record: already applied
+            return self.doing[tid].task
+        task = None
+        for queued in self.todo:
+            if queued.task_id == tid:
+                task = queued
+                break
+        if task is not None:
+            self.todo.remove(task)
+        else:
+            task = self._task_from_dict(d, self.splitter.dataset_name)
+        self.doing[tid] = DoingTask(task, d["worker"], time.time())
+        return task
+
+    def replay_reclaim(self, task_ids):
+        for tid in task_ids:
+            doing = self.doing.pop(tid, None)
+            if doing is not None:
+                self._requeue(doing.task)
+
+    def reclaim_task(self, worker_id: int, d: dict) -> bool:
+        """A fenced client re-reports a shard it still holds. Reaffirm
+        the assignment if we know the task; re-install it from the
+        carried range if the dispatch was lost with the old incarnation;
+        refuse (False) if it was already acked or re-dispatched — the
+        client must drop its copy."""
+        tid = d["task_id"]
+        doing = self.doing.get(tid)
+        if doing is not None:
+            if doing.worker_id != worker_id:
+                return False  # re-dispatched to someone else
+            doing.start_time = time.time()
+            return True
+        for queued in list(self.todo):
+            if (
+                queued.task_id == tid
+                and queued.start == d["start"]
+                and queued.end == d["end"]
+            ):
+                self.todo.remove(queued)
+                self.doing[tid] = DoingTask(queued, worker_id, time.time())
+                self._task_id = max(self._task_id, tid + 1)
+                return True
+        return False
+
     def completed(self) -> bool:
         return (
             self.splitter.epoch_finished()
@@ -134,7 +242,32 @@ class DatasetManager:
         return self.splitter.epoch
 
     def checkpoint(self) -> dict:
+        # "todo" keeps the legacy merged todo+doing list consumed by the
+        # ShardCheckpoint RPC (a *client*-driven restore into a fresh
+        # master, where the doing holders are unknown). The exact fields
+        # alongside it serve the master's own snapshot/WAL restore,
+        # which must preserve ids and assignments for idempotent replay.
+        from dlrover_tpu.master.shard.splitter import (
+            StreamingDatasetSplitter,
+            TextDatasetSplitter,
+        )
+
+        storage_type = "table"
+        if isinstance(self.splitter, TextDatasetSplitter):
+            storage_type = "text"
+        elif isinstance(self.splitter, StreamingDatasetSplitter):
+            storage_type = "stream"
         return {
+            # Enough to re-create this dataset from a snapshot alone —
+            # its registration RPC lives in a journal generation the
+            # recovery chain no longer replays.
+            "params": {
+                "dataset_size": self.splitter.dataset_size,
+                "shard_size": self.splitter.shard_size,
+                "num_epochs": self.splitter.num_epochs,
+                "shuffle": getattr(self.splitter, "shuffle", False),
+                "storage_type": storage_type,
+            },
             "splitter": self.splitter.checkpoint(),
             "todo": [
                 {"start": t.start, "end": t.end, "shard_name": t.shard_name}
@@ -145,12 +278,34 @@ class DatasetManager:
                  "shard_name": d.task.shard_name}
                 for d in self.doing.values()
             ],
+            "todo_exact": [self._task_dict(t) for t in self.todo],
+            "doing": [
+                {**self._task_dict(d.task), "worker_id": d.worker_id}
+                for d in self.doing.values()
+            ],
+            "next_task_id": self._task_id,
+            "completed": self._completed_tasks,
         }
 
-    def restore(self, state: dict):
+    def restore(self, state: dict, exact: bool = False):
         self.splitter.restore(state.get("splitter", {}))
         self.todo.clear()
         self.doing.clear()
+        if exact and "next_task_id" in state:
+            name = self.splitter.dataset_name
+            for d in state.get("todo_exact", []):
+                self.todo.append(self._task_from_dict(d, name))
+            for d in state.get("doing", []):
+                # The holder may still be alive and riding out the
+                # master outage; start_time=now gives it a full timeout
+                # window before the shard is presumed abandoned.
+                self.doing[d["task_id"]] = DoingTask(
+                    self._task_from_dict(d, name), d["worker_id"],
+                    time.time(),
+                )
+            self._task_id = int(state["next_task_id"])
+            self._completed_tasks = int(state.get("completed", 0))
+            return
         for item in state.get("todo", []):
             shard = Shard(
                 name=item.get("shard_name", ""),
@@ -168,6 +323,14 @@ class TaskManager:
         self._datasets: Dict[str, DatasetManager] = {}
         self._speed_monitor = speed_monitor
         self._worker_last_task: Dict[int, float] = {}
+        self._journal = None
+
+    def set_journal(self, journal):
+        """Install the WAL append hook (state-store-backed masters)."""
+        with self._lock:
+            self._journal = journal
+            for ds in self._datasets.values():
+                ds.journal = journal
 
     def new_dataset(
         self,
@@ -179,20 +342,28 @@ class TaskManager:
         storage_type: str = "table",
     ):
         with self._lock:
-            if dataset_name in self._datasets:
-                return
-            splitter = create_dataset_splitter(
+            self._create_dataset(
                 dataset_name, dataset_size, shard_size, num_epochs, shuffle,
                 storage_type,
             )
-            timeout = float(os.getenv(
-                "DLROVER_TPU_SHARD_TIMEOUT", DatasetManager.DOING_TASK_TIMEOUT
-            ))
-            self._datasets[dataset_name] = DatasetManager(
-                splitter, doing_timeout=timeout
-            )
-            logger.info("registered dataset %s (size=%s shard=%s epochs=%s)",
-                        dataset_name, dataset_size, shard_size, num_epochs)
+
+    def _create_dataset(self, dataset_name, dataset_size, shard_size,
+                        num_epochs, shuffle, storage_type):
+        """With the lock held."""
+        if dataset_name in self._datasets:
+            return
+        splitter = create_dataset_splitter(
+            dataset_name, dataset_size, shard_size, num_epochs, shuffle,
+            storage_type,
+        )
+        timeout = float(os.getenv(
+            "DLROVER_TPU_SHARD_TIMEOUT", DatasetManager.DOING_TASK_TIMEOUT
+        ))
+        manager = DatasetManager(splitter, doing_timeout=timeout)
+        manager.journal = self._journal
+        self._datasets[dataset_name] = manager
+        logger.info("registered dataset %s (size=%s shard=%s epochs=%s)",
+                    dataset_name, dataset_size, shard_size, num_epochs)
 
     def has_dataset(self, dataset_name: str) -> bool:
         with self._lock:
@@ -213,6 +384,29 @@ class TaskManager:
         with self._lock:
             ds = self._datasets.get(dataset_name)
             return ds.report_task(task_id, success) if ds else False
+
+    # ------------- journal replay + fencing reclaim -------------
+    def replay_shards(self, dataset_name: str, state: dict):
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is not None:
+                ds.replay_shards(state)
+
+    def replay_dispatch(self, d: dict):
+        with self._lock:
+            ds = self._datasets.get(d.get("dataset", ""))
+            return ds.replay_dispatch(d) if ds else None
+
+    def replay_reclaim(self, dataset_name: str, task_ids):
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is not None:
+                ds.replay_reclaim(task_ids)
+
+    def reclaim_task(self, worker_id: int, dataset_name: str, d: dict) -> bool:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            return ds.reclaim_task(worker_id, d) if ds else False
 
     def recover_worker_tasks(self, worker_id: int):
         with self._lock:
@@ -241,12 +435,27 @@ class TaskManager:
                 {name: ds.checkpoint() for name, ds in self._datasets.items()}
             )
 
-    def restore(self, content: str):
+    def restore(self, content: str, exact: bool = False):
+        """Restore from a checkpoint() string.
+
+        ``exact=False`` (the ShardCheckpoint RPC contract): merge
+        todo+doing under fresh ids — the restoring master doesn't know
+        the doing holders. ``exact=True`` (state-store recovery):
+        preserve ids, assignments and the completed count so journaled
+        dispatch/report replays line up with the snapshot.
+        """
         if not content:
             return
         state = json.loads(content)
         with self._lock:
             for name, ds_state in state.items():
                 ds = self._datasets.get(name)
+                if ds is None and exact and "params" in ds_state:
+                    p = ds_state["params"]
+                    self._create_dataset(
+                        name, p["dataset_size"], p["shard_size"],
+                        p["num_epochs"], p["shuffle"], p["storage_type"],
+                    )
+                    ds = self._datasets.get(name)
                 if ds:
-                    ds.restore(ds_state)
+                    ds.restore(ds_state, exact=exact)

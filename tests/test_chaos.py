@@ -841,32 +841,94 @@ class TestEndToEndDrills:
             "fault schedule was not reproducible for the same seed"
         )
 
+    @staticmethod
+    def _start_master(job, port_file, state_dir, log_path, port=0,
+                      extra_env=None):
+        args = [
+            sys.executable, "-m", "dlrover_tpu.master.main",
+            "--node_num", "1", "--job_name", job,
+            "--state_dir", state_dir,
+        ]
+        if port:
+            args += ["--port", str(port)]
+        else:
+            args += ["--port_file", port_file]
+        env = {
+            # No mid-run snapshot rotation (keeps the journal a single
+            # readable chain) and no doing-timeout reclaims during the
+            # outage window — the drill asserts exactly-once accounting,
+            # so legitimate timeout re-dispatch must not muddy it.
+            "DLROVER_TPU_STATE_SNAPSHOT_SECS": "300",
+            "DLROVER_TPU_SHARD_TIMEOUT": "300",
+        }
+        env.update(extra_env or {})
+        log = open(log_path, "ab")
+        return subprocess.Popen(
+            args, env=cpu_subprocess_env(env), stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+
+    @staticmethod
+    def _wait_port(port_file, timeout=30):
+        deadline = time.monotonic() + timeout
+        while not os.path.exists(port_file):
+            assert time.monotonic() < deadline, "master never started"
+            time.sleep(0.05)
+        return int(open(port_file).read().strip())
+
+    @staticmethod
+    def _shard_accounting(state_dir):
+        """Mini-replay of the master journal chain with the same
+        request-id dedup the real recovery applies. Returns
+        (completed, dispatched, double_applied, re_emitted) where
+        double_applied lists shards whose completion was applied twice
+        (distinct request ids) and re_emitted lists shards dispatched
+        again AFTER being completed."""
+        from dlrover_tpu.master.state_store import read_journal_records
+
+        applied = set()
+        dispatched = {}  # (dataset, task_id) -> shard_name
+        completed = {}
+        double_applied = []
+        re_emitted = []
+        for _seq, rec in read_journal_records(state_dir):
+            kind = rec[0]
+            if kind == "dispatch":
+                req_id, d = rec[1], rec[2]
+                if req_id is not None and req_id in applied:
+                    continue
+                applied.add(req_id)
+                key = (d["dataset"], d["task_id"])
+                if key in completed:
+                    re_emitted.append(key)
+                dispatched[key] = d.get("shard_name", "")
+            elif kind == "rpc":
+                req_id, request = rec[1], rec[2]
+                if req_id is not None and req_id in applied:
+                    continue
+                applied.add(req_id)
+                if isinstance(request, messages.TaskReport) and request.success:
+                    key = (request.dataset_name, request.task_id)
+                    if key in completed:
+                        double_applied.append(key)
+                    completed[key] = dispatched.get(key, "")
+        return completed, dispatched, double_applied, re_emitted
+
     def test_master_restart_mid_training(self, tmp_path):
-        """Kill the master mid-run and relaunch it at the same port;
-        the agent+worker ride out the outage and the job completes."""
+        """Kill the master mid-run and relaunch it at the same port with
+        the same --state_dir; the agent+worker ride out the outage, the
+        job completes, and the resumed master does not re-emit shards
+        the old incarnation already saw completed."""
         job = f"mchaos-{uuid.uuid4().hex[:6]}"
         port_file = str(tmp_path / "port")
+        state_dir = str(tmp_path / "master-state")
+        mlog = str(tmp_path / "master.log")
 
-        def start_master(port=0):
-            args = [
-                sys.executable, "-m", "dlrover_tpu.master.main",
-                "--node_num", "1", "--job_name", job,
-            ]
-            if port:
-                args += ["--port", str(port)]
-            else:
-                args += ["--port_file", port_file]
-            return subprocess.Popen(args, env=cpu_subprocess_env())
-
-        master = start_master()
+        master = self._start_master(job, port_file, state_dir, mlog)
         agent = None
         master2 = None
         try:
-            deadline = time.monotonic() + 30
-            while not os.path.exists(port_file):
-                assert time.monotonic() < deadline, "master never started"
-                time.sleep(0.05)
-            port = int(open(port_file).read().strip())
+            port = self._wait_port(port_file)
             agent = subprocess.Popen(
                 [
                     sys.executable, "-m", "dlrover_tpu.cli",
@@ -875,6 +937,7 @@ class TestEndToEndDrills:
                     f"--job_name={job}", "--monitor_interval=0.2",
                     "--max_restarts=2",
                     SCRIPT, "--", "--steps", "30", "--step-sleep", "0.25",
+                    "--use-dataloader",
                     "--ckpt-dir", str(tmp_path / "ckpts"),
                     "--persist-every", "50",
                 ],
@@ -894,11 +957,111 @@ class TestEndToEndDrills:
             master.kill()
             master.wait(timeout=10)
             time.sleep(2)  # a real outage window
-            master2 = start_master(port=port)
+            master2 = self._start_master(
+                job, port_file, state_dir, mlog, port=port
+            )
             out, _ = agent.communicate(timeout=240)
             assert agent.returncode == 0, out[-4000:]
             master2.wait(timeout=30)
             assert master2.returncode == 0
+            mout = open(mlog, errors="replace").read()
+            assert "recovered master state" in mout, mout[-3000:]
+            completed, _, double_applied, re_emitted = (
+                self._shard_accounting(state_dir)
+            )
+            assert completed, "no shard completions ever journaled"
+            assert not re_emitted, (
+                f"resumed master re-emitted completed shards: {re_emitted}"
+            )
+            assert not double_applied, (
+                f"shard completions applied twice: {double_applied}"
+            )
+        finally:
+            for p in (agent, master, master2):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+
+    def test_master_sigkill_on_report_exactly_once(self, tmp_path):
+        """The nastiest failover window: chaos SIGKILLs the master the
+        instant a shard-completion report arrives — BEFORE the report is
+        journaled, so the old incarnation dies knowing about the shard
+        while the durable record does not. The relaunched master (same
+        port, same --state_dir) must resume, the client's retry must be
+        applied exactly once, and the journal must account every shard
+        effectively once."""
+        job = f"mkill-{uuid.uuid4().hex[:6]}"
+        port_file = str(tmp_path / "port")
+        state_dir = str(tmp_path / "master-state")
+        mlog = str(tmp_path / "master.log")
+        steps = 24
+        plan = FaultPlan(seed=7, events=[
+            FaultEvent(site="master.crash", kind="kill", every=1,
+                       max_fires=1, match="TaskReport"),
+        ])
+
+        master = self._start_master(
+            job, port_file, state_dir, mlog,
+            extra_env={CHAOS_ENV: plan.to_json()},
+        )
+        agent = None
+        master2 = None
+        try:
+            port = self._wait_port(port_file)
+            agent = subprocess.Popen(
+                [
+                    sys.executable, "-m", "dlrover_tpu.cli",
+                    "--nnodes=1", "--nproc_per_node=1", "--node_rank=0",
+                    f"--master_addr=127.0.0.1:{port}",
+                    f"--job_name={job}", "--monitor_interval=0.2",
+                    "--max_restarts=2",
+                    SCRIPT, "--",
+                    "--steps", str(steps), "--step-sleep", "0.1",
+                    "--use-dataloader",
+                ],
+                env=cpu_subprocess_env(), stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+            # The first TaskReport pulls the trigger.
+            master.wait(timeout=120)
+            assert master.returncode == -9, (
+                f"chaos kill never fired (master exited {master.returncode})"
+            )
+            master2 = self._start_master(
+                job, port_file, state_dir, mlog, port=port
+            )
+            out, _ = agent.communicate(timeout=240)
+            assert agent.returncode == 0, out[-4000:]
+            master2.wait(timeout=60)
+            assert master2.returncode == 0
+            mout = open(mlog, errors="replace").read()
+            assert "recovered master state" in mout, mout[-3000:]
+
+            completed, dispatched, double_applied, re_emitted = (
+                self._shard_accounting(state_dir)
+            )
+            assert not double_applied, (
+                f"shard completions applied twice: {double_applied}"
+            )
+            assert not re_emitted, (
+                f"completed shards re-dispatched: {re_emitted}"
+            )
+            # No shard lost: the worker trained `steps` batches (one
+            # shard each) to rc==0; every consumed batch's ack must have
+            # landed effectively once — including the one whose first
+            # attempt died with the old master. The tail batch's ack can
+            # legitimately still be in flight when the job exits.
+            assert len(completed) >= steps - 2, (
+                f"shards lost across failover: {len(completed)} acked "
+                f"of {steps} trained"
+            )
+            assert set(completed) <= set(dispatched), (
+                "completion journaled for a shard never dispatched"
+            )
+            names = [n for n in completed.values() if n]
+            assert len(names) == len(set(names)), (
+                "the same shard completed under two task ids"
+            )
         finally:
             for p in (agent, master, master2):
                 if p is not None and p.poll() is None:

@@ -1,0 +1,464 @@
+"""Durable master state: snapshot + WAL store, recovery, fencing.
+
+Tier-1 (fast, in-process) coverage of the master failover stack:
+``MasterStateStore`` framing and fallback behavior, ``JobMaster``
+recovery semantics (exactly-once shard accounting across a simulated
+master crash), and client-side incarnation fencing against a scripted
+old-incarnation/new-incarnation server pair. The real-process SIGKILL
+drill lives in test_chaos.py (marked chaos/slow).
+"""
+
+import os
+import pickle
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.chaos.injector import FaultInjector, FaultPlan, FaultEvent
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import NodeStatus, RendezvousName
+from dlrover_tpu.common.rpc import RpcClient, RpcServer
+from dlrover_tpu.master.main import write_port_file
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.rendezvous import ElasticTrainingRendezvousManager
+from dlrover_tpu.master.state_store import (
+    MasterStateStore,
+    read_journal_records,
+)
+
+
+@pytest.fixture(autouse=True)
+def chaos_clean(monkeypatch):
+    monkeypatch.delenv("DLROVER_TPU_CHAOS", raising=False)
+    FaultInjector.reset()
+    yield
+    FaultInjector.reset()
+
+
+def crash_master(master):
+    """Simulate a master process death: sever the sockets, skip the
+    graceful stop()/final-snapshot path entirely."""
+    master._stopped.set()
+    master._server.stop()
+
+
+# ---------------------------------------------------------------------------
+# MasterStateStore core
+# ---------------------------------------------------------------------------
+
+
+class TestStateStore:
+    def test_snapshot_journal_roundtrip(self, tmp_path):
+        store = MasterStateStore(str(tmp_path))
+        store.snapshot(lambda: {"a": 1})
+        for i in range(5):
+            store.append(("rec", i))
+        store.close()
+
+        store2 = MasterStateStore(str(tmp_path))
+        state, records = store2.recover()
+        assert state == {"a": 1}
+        assert records == [("rec", i) for i in range(5)]
+        assert store2.last_recovery_stats["torn_tails"] == 0
+
+    def test_torn_journal_tail_tolerated(self, tmp_path):
+        store = MasterStateStore(str(tmp_path))
+        store.snapshot(lambda: {})
+        for i in range(4):
+            store.append(("rec", i))
+        store.close()
+        journal = tmp_path / "journal-1.wal"
+        data = journal.read_bytes()
+        journal.write_bytes(data[:-3])  # crash mid-append
+
+        state, records = MasterStateStore(str(tmp_path)).recover()
+        assert records == [("rec", i) for i in range(3)]
+
+    def test_corrupt_frame_stops_at_tail(self, tmp_path):
+        store = MasterStateStore(str(tmp_path))
+        store.snapshot(lambda: {})
+        store.append(("good",))
+        store.append(("flipped",))
+        store.close()
+        journal = tmp_path / "journal-1.wal"
+        data = bytearray(journal.read_bytes())
+        data[-2] ^= 0xFF  # flip a bit inside the last record
+        journal.write_bytes(bytes(data))
+
+        store2 = MasterStateStore(str(tmp_path))
+        _, records = store2.recover()
+        assert records == [("good",)]
+        assert store2.last_recovery_stats["torn_tails"] == 1
+
+    def test_corrupt_snapshot_quarantined_with_journal_chain(self, tmp_path):
+        store = MasterStateStore(str(tmp_path))
+        store.snapshot(lambda: {"gen": 1})
+        store.append(("gen1-rec",))
+        store.snapshot(lambda: {"gen": 2})
+        store.append(("gen2-rec",))
+        store.close()
+        snap2 = tmp_path / "snapshot-2.bin"
+        snap2.write_bytes(os.urandom(64))
+
+        store2 = MasterStateStore(str(tmp_path))
+        state, records = store2.recover()
+        # Falls back to generation 1 AND replays the full journal chain
+        # (journal-1 then journal-2), so nothing committed is lost.
+        assert state == {"gen": 1}
+        assert records == [("gen1-rec",), ("gen2-rec",)]
+        assert store2.last_recovery_stats["quarantined_snapshots"] == [2]
+        assert not snap2.exists()
+        assert (tmp_path / "snapshot-2.bin.corrupt").exists()
+        # The next snapshot must not collide with the quarantined seq.
+        assert store2.snapshot(lambda: {"gen": 3}) == 3
+
+    def test_gc_keeps_recent_generations(self, tmp_path):
+        store = MasterStateStore(str(tmp_path), keep_generations=2)
+        for i in range(6):
+            store.snapshot(lambda: {"i": i})
+            store.append(("rec", i))
+        store.close()
+        snaps = sorted(
+            p.name for p in tmp_path.glob("snapshot-*.bin")
+        )
+        assert snaps == ["snapshot-5.bin", "snapshot-6.bin"]
+        assert not (tmp_path / "journal-1.wal").exists()
+
+    def test_incarnation_monotonic_across_boots(self, tmp_path):
+        incs = [
+            MasterStateStore(str(tmp_path)).next_incarnation()
+            for _ in range(3)
+        ]
+        assert incs == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# JobMaster recovery (in-process crash simulation)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def state_dir(tmp_path):
+    return str(tmp_path / "master-state")
+
+
+class TestMasterRecovery:
+    def test_resumes_shards_kv_nodes_and_step(self, state_dir):
+        m1 = JobMaster(port=0, node_num=1, job_name="rec", state_dir=state_dir)
+        m1.prepare()
+        try:
+            client = MasterClient(m1.addr, node_id=0)
+            client.report_node_status(NodeStatus.RUNNING)
+            client.report_dataset_shard_params("ds", 40, 10)
+            done = client.get_task("ds")
+            held = client.get_task("ds")
+            client.report_task("ds", done.task_id, True)
+            client.kv_store_set("k", b"v")
+            client.kv_store_add("ctr", 7)
+            client.report_global_step(3, time.time())
+            # One periodic snapshot mid-run, then more mutations on top:
+            # recovery must compose snapshot + journal.
+            m1.state_store.snapshot(m1._collect_state)
+            client.kv_store_set("k2", b"v2")
+        finally:
+            crash_master(m1)
+
+        m2 = JobMaster(
+            port=0, node_num=1, job_name="rec", state_dir=state_dir
+        )
+        try:
+            assert m2.incarnation == 2
+            ds = m2.task_manager._datasets["ds"]
+            assert ds._completed_tasks == 1
+            assert held.task_id in ds.doing
+            assert ds.doing[held.task_id].worker_id == 0
+            todo_ids = {t.task_id for t in ds.todo}
+            assert done.task_id not in todo_ids and held.task_id not in todo_ids
+            assert len(todo_ids) == 2
+            assert m2.kv_store.get("k") == b"v"
+            assert m2.kv_store.get("k2") == b"v2"
+            assert m2.kv_store.get("ctr") == b"7"
+            assert m2.speed_monitor.global_step == 3
+            node = m2.job_manager.get_node(0)
+            assert node is not None and node.status == NodeStatus.RUNNING
+            # Restored nodes must not be instantly evictable off the
+            # previous incarnation's heartbeat clock.
+            assert m2.job_manager.find_dead_nodes() == []
+        finally:
+            m2.stop()
+
+    def test_duplicate_report_task_replay_is_idempotent(self, state_dir):
+        m1 = JobMaster(port=0, node_num=1, job_name="dup", state_dir=state_dir)
+        m1.prepare()
+        try:
+            client = MasterClient(m1.addr, node_id=0)
+            client.report_dataset_shard_params("ds", 20, 10)
+            task = client.get_task("ds")
+            client.report_task("ds", task.task_id, True)
+            # A retry that executed twice on the wire (the dedup cache
+            # died with the old master): the journal holds the report
+            # twice, replay must count it once and requeue nothing.
+            m1.state_store.append(
+                ("rpc", "retry-req-id",
+                 m.TaskReport(node_id=0, dataset_name="ds",
+                              task_id=task.task_id, success=True),
+                 time.time())
+            )
+        finally:
+            crash_master(m1)
+
+        m2 = JobMaster(port=0, node_num=1, job_name="dup", state_dir=state_dir)
+        try:
+            ds = m2.task_manager._datasets["ds"]
+            assert ds._completed_tasks == 1
+            assert not ds.doing
+            assert len(ds.todo) == 1  # the one shard never dispatched
+            # The duplicate's request id was seeded into the dedup
+            # cache: a wire retry is answered from cache, not re-applied.
+            duplicate, _ = m2._server._dedup.begin("retry-req-id")
+            assert duplicate
+        finally:
+            m2.stop()
+
+    def test_corrupt_newest_snapshot_master_falls_back(self, state_dir):
+        m1 = JobMaster(port=0, node_num=1, job_name="fb", state_dir=state_dir)
+        m1.prepare()
+        try:
+            client = MasterClient(m1.addr, node_id=0)
+            client.kv_store_set("pre", b"1")
+            m1.state_store.snapshot(m1._collect_state)  # snapshot-2
+            client.kv_store_set("post", b"2")
+        finally:
+            crash_master(m1)
+        newest = os.path.join(state_dir, "snapshot-2.bin")
+        with open(newest, "r+b") as f:
+            f.seek(10)
+            f.write(os.urandom(32))
+
+        m2 = JobMaster(port=0, node_num=1, job_name="fb", state_dir=state_dir)
+        try:
+            # Booted from snapshot-1 + the journal chain, not blank.
+            assert m2.kv_store.get("pre") == b"1"
+            assert m2.kv_store.get("post") == b"2"
+            assert m2.last_recovery_stats["quarantined_snapshots"] == [2]
+            assert m2.last_recovery_stats["snapshot_seq"] == 1
+        finally:
+            m2.stop()
+
+    def test_read_journal_records_sees_dispatch_and_report(self, state_dir):
+        m1 = JobMaster(port=0, node_num=1, job_name="acct", state_dir=state_dir)
+        m1.prepare()
+        try:
+            client = MasterClient(m1.addr, node_id=0)
+            client.report_dataset_shard_params("ds", 20, 10)
+            task = client.get_task("ds")
+            client.report_task("ds", task.task_id, True)
+        finally:
+            crash_master(m1)
+        kinds = [rec[0] for _, rec in read_journal_records(state_dir)]
+        assert "dispatch" in kinds
+        assert any(
+            rec[0] == "rpc" and isinstance(rec[2], m.TaskReport)
+            for _, rec in read_journal_records(state_dir)
+        )
+
+    def test_master_crash_chaos_site_consulted(self, state_dir, monkeypatch):
+        plan = FaultPlan(events=[
+            # Benign kind: proves the site is wired without killing the
+            # test process (the "kill" kind is exercised by the e2e drill).
+            FaultEvent(site="master.crash", kind="log", at=1),
+        ])
+        monkeypatch.setenv("DLROVER_TPU_CHAOS", plan.to_json())
+        FaultInjector.reset()
+        master = JobMaster(port=0, node_num=1, job_name="site")
+        master.servicer.handle(m.NodeHeartbeat(node_id=0, timestamp=1.0))
+        inj = FaultInjector.get()
+        assert inj is not None and inj.occurrences("master.crash") == 1
+        master.stop()
+
+
+# ---------------------------------------------------------------------------
+# Incarnation fencing (scripted old/new server pair)
+# ---------------------------------------------------------------------------
+
+
+class TestIncarnationFencing:
+    def test_client_reregisters_and_rereports_inflight(self):
+        """An agent riding out a master restart must re-register and
+        re-report its in-flight shard tasks to the new incarnation."""
+        canned = m.ShardTask(
+            task_id=7, dataset_name="ds", shard_name="ds-e0-s7",
+            start=70, end=80,
+        )
+
+        def old_handler(request):
+            if isinstance(request, m.TaskRequest):
+                return canned
+            return m.Response()
+
+        old = RpcServer(0, old_handler)
+        old.incarnation = 1
+        old.start()
+        client = MasterClient(f"127.0.0.1:{old.port}", node_id=3)
+        task = client.get_task("ds")
+        assert task.task_id == 7
+        assert client._client.incarnation == 1
+        old.stop()
+
+        received = []
+
+        def new_handler(request):
+            received.append(request)
+            return m.Response()
+
+        new = RpcServer(old.port, new_handler)
+        new.incarnation = 2
+        new.start()
+        try:
+            client.report_heartbeat()  # observes the incarnation change
+            assert client._client.incarnation == 2
+            assert client.fenced_count == 1
+            kinds = [type(r).__name__ for r in received]
+            assert "NodeStatusReport" in kinds
+            assert "TaskHoldReport" in kinds
+            holds = [r for r in received if isinstance(r, m.TaskHoldReport)]
+            assert holds[0].task_id == 7
+            assert holds[0].start == 70 and holds[0].end == 80
+            assert holds[0].node_id == 3
+        finally:
+            new.stop()
+            client.close()
+
+    def test_same_incarnation_does_not_fence(self):
+        server = RpcServer(0, lambda req: m.Response())
+        server.incarnation = 5
+        server.start()
+        client = MasterClient(f"127.0.0.1:{server.port}", node_id=0)
+        try:
+            client.report_heartbeat()
+            client.report_heartbeat()
+            assert client.fenced_count == 0
+            assert client._client.incarnation == 5
+        finally:
+            server.stop()
+            client.close()
+
+    def test_hold_refused_drops_local_claim(self):
+        """A hold the new master refuses (already acked/re-dispatched)
+        must drop the client's in-flight claim."""
+        canned = m.ShardTask(task_id=1, dataset_name="ds", start=0, end=10)
+
+        def old_handler(request):
+            return canned if isinstance(request, m.TaskRequest) else m.Response()
+
+        old = RpcServer(0, old_handler)
+        old.incarnation = 1
+        old.start()
+        client = MasterClient(f"127.0.0.1:{old.port}", node_id=0)
+        client.get_task("ds")
+        old.stop()
+
+        def new_handler(request):
+            if isinstance(request, m.TaskHoldReport):
+                return m.Response(success=False)
+            return m.Response()
+
+        new = RpcServer(old.port, new_handler)
+        new.incarnation = 2
+        new.start()
+        try:
+            client.report_heartbeat()
+            assert client.fenced_count == 1
+            assert not client._inflight_tasks
+        finally:
+            new.stop()
+            client.close()
+
+    def test_hold_reinstalls_lost_dispatch_on_real_master(self, tmp_path):
+        """End-to-end against real masters sharing a state dir, with the
+        dispatch record torn off the journal tail: only the fenced hold
+        re-report can restore the assignment."""
+        state_dir = str(tmp_path / "state")
+        m1 = JobMaster(port=0, node_num=1, job_name="hold",
+                       state_dir=state_dir)
+        m1.prepare()
+        client = MasterClient(m1.addr, node_id=0)
+        client.report_dataset_shard_params("ds", 20, 10)
+        task = client.get_task("ds")
+        crash_master(m1)
+        # Tear the dispatch record off the journal tail (the crash beat
+        # the append): the new master will see the shard as todo.
+        journal = os.path.join(state_dir, "journal-1.wal")
+        records = [r for _, r in read_journal_records(state_dir)]
+        assert records[-1][0] == "dispatch"
+        with open(journal, "r+b") as f:
+            f.truncate(os.path.getsize(journal) - 20)
+
+        m2 = JobMaster(port=m1.port, node_num=1, job_name="hold",
+                       state_dir=state_dir)
+        m2.prepare()
+        try:
+            ds = m2.task_manager._datasets["ds"]
+            assert task.task_id not in ds.doing  # dispatch was lost
+            client.report_heartbeat()  # fence: re-reports the hold
+            assert task.task_id in ds.doing
+            assert ds.doing[task.task_id].worker_id == 0
+            # And the ack completes normally against the new master.
+            client.report_task("ds", task.task_id, True)
+            assert ds._completed_tasks == 1
+        finally:
+            m2.stop()
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous counter restore (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestRendezvousRestore:
+    def test_round_counters_survive_restore(self):
+        mgr = ElasticTrainingRendezvousManager(RendezvousName.TRAINING)
+        mgr.update_rdzv_params(1, 1, 1.0, 1)
+        mgr.join_rendezvous(0, 1)
+        round_, _, world = mgr.get_comm_world(0)
+        assert world and round_ == 1
+        mgr.invalidate_round()
+        ck = mgr.checkpoint()
+        assert ck == {"round": 1, "stale_round": 1}
+
+        fresh = ElasticTrainingRendezvousManager(RendezvousName.TRAINING)
+        fresh.restore(ck)
+        # Without the restore a blank master would hand out round 1
+        # again and world_stale(1) would wrongly be False for agents
+        # holding previous-incarnation round tokens.
+        assert fresh.world_stale(1)
+        fresh.update_rdzv_params(1, 1, 1.0, 1)
+        fresh.join_rendezvous(0, 1)
+        round2, _, world2 = fresh.get_comm_world(0)
+        assert world2 and round2 == 2
+        assert not fresh.world_stale(2)
+
+    def test_state_listener_fires_on_changes(self):
+        seen = []
+        mgr = ElasticTrainingRendezvousManager(RendezvousName.TRAINING)
+        mgr.set_state_listener(lambda name, st: seen.append((name, st)))
+        mgr.update_rdzv_params(1, 1, 1.0, 1)
+        mgr.join_rendezvous(0, 1)
+        mgr.get_comm_world(0)  # freeze -> round 1
+        mgr.invalidate_round()
+        assert seen[0][1]["round"] == 1
+        assert seen[-1][1]["stale_round"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Atomic port file (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_port_file_written_atomically(tmp_path):
+    path = tmp_path / "port"
+    write_port_file(str(path), 12345)
+    assert path.read_text() == "12345"
+    assert list(tmp_path.iterdir()) == [path]  # no tmp leftovers
