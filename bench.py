@@ -625,6 +625,98 @@ def section_goodput():
     return out
 
 
+def goodput_json_main(out_path=None) -> int:
+    """``bench.py --goodput-json [PATH]`` — kill-injection drill whose
+    artifact is the MASTER's own goodput ledger, not wall-clock ratios.
+
+    Runs one elastic job (CPU backend, real master/agent/worker) with a
+    SIGKILL scripted through the chaos plane (site ``agent.monitor``) so
+    the ledger attributes the downtime to an *injected* cause
+    (``chaos.kill``), and with ``DLROVER_TPU_GOODPUT_JSON`` pointed at a
+    scratch file so the master dumps its ledger summary + full event
+    timeline on stop. The dump plus the scenario protocol is written to
+    ``GOODPUT_r0N.json`` (next free round, or PATH)."""
+    import subprocess
+    import tempfile
+    import uuid
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    script = os.path.join(repo, "examples", "train_tiny.py")
+    if not out_path:
+        n = 1
+        while os.path.exists(os.path.join(repo, f"GOODPUT_r{n:02d}.json")):
+            n += 1
+        out_path = os.path.join(repo, f"GOODPUT_r{n:02d}.json")
+
+    steps, sleep, kill_at = 30, 0.2, 15
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DLROVER_TPU_MASTER_ADDR", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + [p for p in env.get("PYTHONPATH", "").split(
+            os.pathsep) if p and "axon" not in p]
+    )
+    # One SIGKILL ~3s in (the agent monitor polls every 0.2s). Injected
+    # through the chaos plan — not the worker's own --crash-at — so the
+    # injection self-reports and the incident carries injected=true.
+    env["DLROVER_TPU_CHAOS"] = json.dumps({
+        "seed": 7,
+        "events": [
+            {"site": "agent.monitor", "kind": "kill", "at": kill_at}
+        ],
+    })
+    with tempfile.TemporaryDirectory() as td:
+        dump = os.path.join(td, "goodput.json")
+        env["DLROVER_TPU_GOODPUT_JSON"] = dump
+        job = f"goodput-art-{uuid.uuid4().hex[:6]}"
+        cmd = [
+            sys.executable, "-m", "dlrover_tpu.cli",
+            "--standalone", "--nproc_per_node=1",
+            f"--job_name={job}", "--monitor_interval=0.2",
+            "--max_restarts=3", script, "--",
+            "--steps", str(steps), "--step-sleep", str(sleep),
+            "--ckpt-dir", os.path.join(td, "ckpts"),
+            "--persist-every", "10",
+        ]
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            cmd, env=env, capture_output=True, text=True, timeout=600
+        )
+        wall = time.perf_counter() - t0
+        try:
+            with open(dump) as f:
+                artifact = json.load(f)
+        except (OSError, ValueError):
+            log(f"bench[goodput-json]: master left no ledger dump; "
+                f"rc={r.returncode}\n{r.stderr[-800:]}")
+            return 1
+    artifact["scenario"] = {
+        "wall_s": round(wall, 1),
+        "returncode": r.returncode,
+        "steps": steps,
+        "step_sleep_s": sleep,
+        "injection": (
+            f"chaos plan: agent.monitor kill at occurrence {kill_at}"
+        ),
+    }
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=2)
+    os.replace(tmp, out_path)
+    s = artifact.get("summary", {})
+    log(f"bench[goodput-json]: goodput={s.get('goodput')} "
+        f"downtime_by_cause={s.get('downtime_by_cause_s')} "
+        f"incidents={s.get('incidents_by_cause')} -> {out_path}")
+    print(json.dumps({
+        "metric": "goodput_ratio",
+        "value": s.get("goodput"),
+        "unit": "ratio",
+        "artifact": os.path.basename(out_path),
+        "downtime_by_cause_s": s.get("downtime_by_cause_s"),
+    }))
+    return 0
+
+
 def main():
     import jax
 
@@ -695,4 +787,10 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--goodput-json" in sys.argv[1:]:
+        i = sys.argv.index("--goodput-json")
+        target = None
+        if len(sys.argv) > i + 1 and not sys.argv[i + 1].startswith("-"):
+            target = sys.argv[i + 1]
+        sys.exit(goodput_json_main(target))
     main()

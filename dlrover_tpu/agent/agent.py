@@ -36,6 +36,12 @@ from dlrover_tpu.common.constants import (
 )
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import find_free_port
+from dlrover_tpu.observability.events import (
+    EventKind,
+    emit,
+    flush_events,
+    set_identity,
+)
 
 
 @dataclass
@@ -250,6 +256,12 @@ class ElasticTrainingAgent:
                 f"workers-{result}", restart=self._restart_count
             )
             get_tracer().export()  # no-op unless DLROVER_TPU_TRACE_FILE
+            # Reaching here means the loop restarts the workers (failure
+            # or membership change).
+            emit(
+                EventKind.WORKER_RESTART, reason=result,
+                restart=self._restart_count,
+            )
         self._client.report_node_status(NodeStatus.FAILED, "fatal-error")
         return 1
 
@@ -437,6 +449,10 @@ class ElasticTrainingAgent:
                     (i, c) for i, c in enumerate(codes) if c not in (None, 0)
                 ]
                 logger.error("worker processes failed: %s", failed)
+                emit(
+                    EventKind.WORKER_FAIL, codes=failed,
+                    restart=self._restart_count,
+                )
                 self._client.report_failure(
                     f"worker exit codes {failed}",
                     level=TrainingExceptionLevel.PROCESS_ERROR,
@@ -499,6 +515,9 @@ class ElasticTrainingAgent:
         fs = getattr(self, "_forkserver", None)
         if fs is not None:
             fs.stop()
+        # Drain the event-forwarding buffer so the master's timeline
+        # gets this agent's final events before the process exits.
+        flush_events()
 
 
 def launch_agent(config: ElasticLaunchConfig, entrypoint: str,
@@ -506,6 +525,7 @@ def launch_agent(config: ElasticLaunchConfig, entrypoint: str,
     """Entry used by the CLI (parity: training.py:655)."""
     spec = WorkerSpec(entrypoint, args)
     client = MasterClient.singleton_instance()
+    set_identity(config.node_rank, "agent")
     agent = ElasticTrainingAgent(config, spec, client)
 
     def _on_sigterm(signum, frame):

@@ -35,6 +35,7 @@ from dlrover_tpu.common.comm import SharedDict, SharedLock, SharedQueue
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.shared_memory import SharedMemory
 from dlrover_tpu.common.storage import get_checkpoint_storage
+from dlrover_tpu.observability.events import EventKind, emit
 
 
 class CommonDirCheckpointSaver:
@@ -177,6 +178,7 @@ class CommonDirCheckpointSaver:
             # multi-GB buffer for a step that is on disk is pure waste.
             return
         commit_at = -1
+        persist_t0 = time.monotonic()
         # The commit wait (potentially minutes, multi-node) runs OUTSIDE
         # _flush_lock — the crash/SIGTERM flush must never queue behind it.
         with self._flush_lock:
@@ -237,6 +239,10 @@ class CommonDirCheckpointSaver:
                     "saver)", step,
                 )
         if commit_at >= 0:
+            emit(
+                EventKind.CKPT_SAVE, step=commit_at,
+                duration_s=round(time.monotonic() - persist_t0, 3),
+            )
             self._finish_step(commit_at, commit_timeout)
 
     def _wait_local_step(self, step: int, timeout: float) -> Dict[int, ShardMeta]:
@@ -253,11 +259,16 @@ class CommonDirCheckpointSaver:
 
     def _finish_step(self, step: int, commit_timeout: float):
         if self.is_committer:
+            commit_t0 = time.monotonic()
             ok = ckpt_persist.commit_step(
                 self.storage, self.checkpoint_dir, step,
                 self.global_shard_num, timeout=commit_timeout,
             )
             if ok:
+                emit(
+                    EventKind.CKPT_COMMIT, step=step,
+                    duration_s=round(time.monotonic() - commit_t0, 3),
+                )
                 ckpt_persist.gc_steps(
                     self.storage, self.checkpoint_dir, self.keep_latest
                 )

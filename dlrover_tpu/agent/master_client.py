@@ -287,6 +287,12 @@ class MasterClient:
     def report_heartbeat(self):
         return self._call(m.NodeHeartbeat(timestamp=time.time()))
 
+    def report_events(self, events, timeout: Optional[float] = None):
+        """Forward a batch of JobEvents to the master's event log."""
+        return self._call(
+            m.EventReport(events=list(events)), timeout=timeout
+        )
+
     def report_node_status(self, status: str, exit_reason: str = ""):
         return self._call(
             m.NodeStatusReport(status=status, exit_reason=exit_reason)

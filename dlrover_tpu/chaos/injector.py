@@ -179,6 +179,7 @@ class FaultInjector:
         events = self._by_site.get(site)
         if not events:
             return None
+        fired = None
         with self._lock:
             n = self._counters.get(site, 0) + 1
             self._counters[site] = n
@@ -190,8 +191,25 @@ class FaultInjector:
                 if e.triggers(n, detail, rng):
                     e.fired += 1
                     self._journal(site, n, e, detail)
-                    return e
-        return None
+                    fired = e
+                    break
+        if fired is not None:
+            # Self-report into the job timeline (outside our lock — the
+            # emit path may take the master's journal lock). Lazy import:
+            # chaos must stay importable with zero dependencies.
+            try:
+                from dlrover_tpu.observability.events import (
+                    EventKind,
+                    emit,
+                )
+
+                emit(
+                    EventKind.CHAOS_INJECT, site=site, kind=fired.kind,
+                    detail=detail, n=n,
+                )
+            except Exception:
+                pass
+        return fired
 
     def occurrences(self, site: str) -> int:
         with self._lock:

@@ -150,6 +150,14 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "timeline":
+        # Subcommand, intercepted before the launcher parser (whose
+        # required positional entrypoint would swallow it):
+        #   dlrover-tpu-run timeline --state-dir DIR [--chrome-out F]
+        from dlrover_tpu.observability.timeline import main as timeline_main
+
+        return timeline_main(argv[1:])
     args = build_parser().parse_args(argv)
     return run(args)
 

@@ -245,6 +245,17 @@ class NodeHeartbeat(BaseRequest):
     timestamp: float = 0.0
 
 
+@dataclass
+class EventReport(BaseRequest):
+    """A batch of JobEvents forwarded from an agent/worker event buffer.
+
+    Journaled + request-id-deduped like every mutating RPC, so a retried
+    batch lands in the master's EventLog exactly once.
+    """
+
+    events: List = field(default_factory=list)
+
+
 # ---------------- sync service ----------------
 
 

@@ -25,6 +25,10 @@ def parse_args(argv=None):
                         help="persist master state (snapshots + WAL) here; "
                         "a relaunched master with the same dir resumes the "
                         "previous incarnation's job state")
+    parser.add_argument("--metrics_port", type=int, default=None,
+                        help="serve Prometheus /metrics on this port "
+                        "(0 = ephemeral; unset = DLROVER_TPU_METRICS_PORT "
+                        "env or disabled)")
     return parser.parse_args(argv)
 
 
@@ -42,7 +46,7 @@ def write_port_file(path: str, port: int):
 def run(args) -> int:
     master = JobMaster(
         port=args.port, node_num=args.node_num, job_name=args.job_name,
-        state_dir=args.state_dir,
+        state_dir=args.state_dir, metrics_port=args.metrics_port,
     )
     master.prepare()
     if args.port_file:

@@ -6,6 +6,7 @@ sync service and the RPC servicer; ``run()`` watches exit conditions
 (all workers done, fatal node failure, no-task-manager-progress).
 """
 
+import os
 import threading
 import time
 from typing import Optional
@@ -15,6 +16,16 @@ from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import DEDUP_TTL
 from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.observability.events import (
+    EventKind,
+    emit,
+    install_sink,
+    uninstall_sink,
+)
+from dlrover_tpu.observability.plane import (
+    METRICS_PORT_ENV,
+    ObservabilityPlane,
+)
 from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.node_manager import JobManager, LocalJobManager
 from dlrover_tpu.master.rendezvous import (
@@ -37,6 +48,7 @@ class JobMaster:
         job_manager: Optional[JobManager] = None,
         scaler=None,
         state_dir: str = "",
+        metrics_port: Optional[int] = None,
     ):
         ctx = get_context()
         self.job_name = job_name
@@ -71,10 +83,27 @@ class JobMaster:
         self.kv_store = KVStoreService()
         self.sync_service = SyncService(self.job_manager)
         self.metric_collector = JobMetricCollector()
+        # Observability plane: the job-wide event log + goodput ledger
+        # + /metrics source. Master-local emits flow through the sink
+        # below; agent/worker emits arrive as EventReport RPCs.
+        self.observability = ObservabilityPlane()
+        self.observability.attach(
+            speed_monitor=self.speed_monitor,
+            job_manager=self.job_manager,
+            task_manager=self.task_manager,
+        )
+        self.metric_collector.add_sink(self.observability.metric_sink)
+        self._metrics_port_cfg = metrics_port
+        self.metrics_port = 0
+        # Bind once: uninstall_sink removes by identity, and bound-method
+        # attribute access would mint a different object each time.
+        self._event_sink_fn = self._event_sink
+        install_sink(self._event_sink_fn)
         if self.state_store is not None:
             self.task_manager.set_journal(self.state_store.append)
             for mgr in self.rdzv_managers.values():
                 mgr.set_state_listener(self._journal_rdzv_state)
+            self.observability.event_log.journal = self.state_store.append
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             kv_store=self.kv_store,
@@ -84,6 +113,7 @@ class JobMaster:
             sync_service=self.sync_service,
             metric_collector=self.metric_collector,
             state_store=self.state_store,
+            observability=self.observability,
         )
         self._server = create_master_service(port, self.servicer)
         self.port = self._server.port
@@ -119,6 +149,17 @@ class JobMaster:
     def addr(self) -> str:
         return f"127.0.0.1:{self.port}"
 
+    # ------------- events -------------
+    def _event_sink(self, ev):
+        """Process-wide emit() sink for the master. Dropped while the
+        journal is replaying: locally-emitted events were journaled as
+        ``("event", ...)`` records and replay themselves — re-recording
+        the handler's side-effect emits would double them."""
+        store = self.state_store
+        if store is not None and store.replaying:
+            return
+        self.observability.event_log.append(ev)
+
     # ------------- durable state -------------
     def _journal_rdzv_state(self, name: str, state: dict):
         # Absolute counter values, so replaying a duplicate is a no-op
@@ -138,6 +179,7 @@ class JobMaster:
                 for name, mgr in self.rdzv_managers.items()
             },
             "speed": self.speed_monitor.checkpoint(),
+            "events": self.observability.event_log.export_state(),
         }
 
     def _recover_state(self):
@@ -164,6 +206,11 @@ class JobMaster:
                     if mgr is not None:
                         mgr.restore(st)
                 self.speed_monitor.restore(state.get("speed", {}))
+                ev_state = state.get("events")
+                if ev_state:
+                    # Replays through the listeners, so the goodput
+                    # ledger rebuilds its incident history too.
+                    self.observability.event_log.restore_state(ev_state)
             for rec in records:
                 try:
                     kind = rec[0]
@@ -191,6 +238,11 @@ class JobMaster:
                         mgr = self.rdzv_managers.get(name)
                         if mgr is not None:
                             mgr.restore(st)
+                    elif kind == "event":
+                        _, ev, ts = rec
+                        self.observability.event_log.append(
+                            ev, journal=False
+                        )
                     else:
                         logger.warning("skipping unknown journal record %r",
                                        kind)
@@ -224,6 +276,17 @@ class JobMaster:
         self._monitor_thread.start()
         if self.auto_scaler is not None:
             self.auto_scaler.start()
+        port_cfg = self._metrics_port_cfg
+        if port_cfg is None:
+            env = os.getenv(METRICS_PORT_ENV, "")
+            port_cfg = int(env) if env else None
+        if port_cfg is not None and port_cfg >= 0:
+            try:
+                self.metrics_port = self.observability.start_exporter(
+                    port_cfg
+                )
+            except Exception:
+                logger.exception("metrics exporter failed to start")
         logger.info("master %s serving on port %s", self.job_name, self.port)
 
     # ------------- failure detection -------------
@@ -273,6 +336,10 @@ class JobMaster:
                         "invalidating the round so agents restart",
                         self.speed_monitor.hang_seconds,
                     )
+                    emit(
+                        EventKind.NODE_HANG, _role="master",
+                        hang_seconds=self.speed_monitor.hang_seconds,
+                    )
                     for mgr in self.rdzv_managers.values():
                         mgr.invalidate_round()
                     # Restarted workers report steps again; clearing the
@@ -292,6 +359,12 @@ class JobMaster:
 
         get_tracer().instant("evict-node", node_id=node_id, reason=reason)
         logger.error("evicting node %s: %s", node_id, reason)
+        # During journal replay the sink drops this (the live eviction's
+        # own ("event", ...) record replays it instead).
+        emit(
+            EventKind.NODE_EVICT, _node_id=node_id, _role="master",
+            reason=reason,
+        )
         store = self.state_store
         if store is not None and not store.replaying:
             # Write-ahead, under the mutation lock so the eviction's
@@ -345,6 +418,8 @@ class JobMaster:
         if self.auto_scaler is not None:
             self.auto_scaler.stop()
         self._server.stop()
+        uninstall_sink(self._event_sink_fn)
+        self.observability.stop()
         if self.state_store is not None:
             # Sockets are severed, so no mutation can race the final
             # snapshot; best-effort — a failure here is exactly the

@@ -22,11 +22,17 @@ class SpeedMonitor:
         self._worker_last_report: Dict[int, float] = {}
         self._worker_start_step: Dict[int, Tuple[int, float]] = {}
         self._init_time = time.time()
-        self._paused_ranges: float = 0.0
+        # Defined up front so readers before the first
+        # set_target_worker_num call see 0, not an AttributeError.
+        self._target_worker_num = 0
 
     @property
     def global_step(self) -> int:
         return self._global_step
+
+    @property
+    def target_worker_num(self) -> int:
+        return self._target_worker_num
 
     def set_target_worker_num(self, num: int):
         self._target_worker_num = num

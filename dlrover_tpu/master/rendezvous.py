@@ -22,6 +22,7 @@ from abc import ABC, abstractmethod
 from typing import Dict, List, Optional, Set, Tuple
 
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import EventKind, emit
 
 
 class RendezvousManager(ABC):
@@ -116,8 +117,16 @@ class RendezvousManager(ABC):
                     "stale, survivors must re-form",
                     self.name, node_rank, self._rdzv_round,
                 )
+            round_ = self._rdzv_round
+        # Emits (like _notify_state) stay outside the lock: the journal
+        # path must never nest inside the rendezvous lock.
         if changed:
             self._notify_state()
+            emit(
+                EventKind.RDZV_INVALIDATED, _node_id=node_rank,
+                _role="master", rdzv=self.name, round=round_,
+                reason="member-left",
+            )
 
     def world_stale(self, round_: int) -> bool:
         """True when the given round was invalidated by a member death."""
@@ -136,8 +145,13 @@ class RendezvousManager(ABC):
                     "rdzv %s: round %s invalidated; members must re-form",
                     self.name, self._rdzv_round,
                 )
+            round_ = self._rdzv_round
         if changed:
             self._notify_state()
+            emit(
+                EventKind.RDZV_INVALIDATED, _role="master",
+                rdzv=self.name, round=round_, reason="invalidated",
+            )
 
     def join_rendezvous(
         self, node_rank: int, local_world_size: int = 1
@@ -147,12 +161,23 @@ class RendezvousManager(ABC):
             if node_rank in self._rdzv_nodes and node_rank not in self._waiting_nodes:
                 # Rejoin after restart: previous world is stale.
                 self._rdzv_nodes = {}
-            if not self._waiting_nodes:
+            first = not self._waiting_nodes
+            if first:
                 self._start_rdzv_time = time.monotonic()
             self._waiting_nodes[node_rank] = local_world_size
             self._alive_nodes.add(node_rank)
             self._lastcall_time = time.monotonic()
-            return self._rdzv_round
+            round_ = self._rdzv_round
+        if first:
+            emit(
+                EventKind.RDZV_ROUND_START, _role="master",
+                rdzv=self.name, round=round_ + 1,
+            )
+        emit(
+            EventKind.RDZV_JOIN, _node_id=node_rank, _role="master",
+            rdzv=self.name, round=round_ + 1,
+        )
+        return round_
 
     def _freeze_ready(self) -> bool:
         """Called with the lock held: can the waiting set become a round?"""
@@ -203,6 +228,7 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
 
     def get_comm_world(self, node_rank: int):
         froze = False
+        froze_round = froze_nodes = 0
         try:
             with self._lock:
                 if node_rank in self._rdzv_nodes:
@@ -211,12 +237,18 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
                     before = self._rdzv_round
                     self._freeze_round()
                     froze = self._rdzv_round != before
+                    froze_round = self._rdzv_round
+                    froze_nodes = len(self._rdzv_nodes)
                     if node_rank in self._rdzv_nodes:
                         return self._rdzv_round, 0, dict(self._rdzv_nodes)
                 return self._rdzv_round, 0, {}
         finally:
             if froze:
                 self._notify_state()
+                emit(
+                    EventKind.RDZV_ROUND_COMPLETE, _role="master",
+                    rdzv=self.name, round=froze_round, nodes=froze_nodes,
+                )
 
 
 class DeviceCheckRendezvousManager(RendezvousManager):
@@ -258,6 +290,7 @@ class DeviceCheckRendezvousManager(RendezvousManager):
 
     def get_comm_world(self, node_rank: int):
         froze = False
+        froze_round = froze_nodes = 0
         try:
             with self._lock:
                 self._expire_round()
@@ -265,6 +298,8 @@ class DeviceCheckRendezvousManager(RendezvousManager):
                     before = self._rdzv_round
                     self._freeze_round()
                     froze = self._rdzv_round != before
+                    froze_round = self._rdzv_round
+                    froze_nodes = len(self._rdzv_nodes)
                     if self._rdzv_nodes:  # node_unit may admit zero nodes
                         self._check_round += 1
                         self._round_members[self._check_round] = set(
@@ -281,6 +316,10 @@ class DeviceCheckRendezvousManager(RendezvousManager):
         finally:
             if froze:
                 self._notify_state()
+                emit(
+                    EventKind.RDZV_ROUND_COMPLETE, _role="master",
+                    rdzv=self.name, round=froze_round, nodes=froze_nodes,
+                )
 
     def _expire_round(self):
         """With the lock held: time out members that never reported."""

@@ -16,6 +16,7 @@ from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RpcServer, current_request_id
+from dlrover_tpu.observability.events import EventKind, emit
 
 #: Messages whose handlers mutate durable master state. With a state
 #: store attached, each is journaled WRITE-AHEAD (append, then apply,
@@ -30,6 +31,9 @@ _JOURNALED = (
     m.KVStoreDelete,
     m.NodeStatusReport,
     m.NodeFailure,
+    # Forwarded event batches are state: the timeline must survive a
+    # master failover, and a retried batch must land exactly once.
+    m.EventReport,
 )
 
 
@@ -44,6 +48,7 @@ class MasterServicer:
         sync_service,
         metric_collector=None,
         state_store=None,
+        observability=None,
     ):
         self._rdzv_managers = rdzv_managers
         self._kv_store = kv_store
@@ -53,6 +58,7 @@ class MasterServicer:
         self._sync_service = sync_service
         self._metric_collector = metric_collector
         self._state_store = state_store
+        self._observability = observability
         self._paral_config = m.ParallelConfig()
         self._job_exit = None
         self._start_time = time.time()
@@ -212,6 +218,11 @@ class MasterServicer:
         self._speed_monitor.collect_global_step(
             req.step, req.timestamp or time.time(), req.node_id
         )
+        if self._observability:
+            # Steps close open downtime incidents in the goodput ledger.
+            self._observability.note_step(
+                req.step, req.timestamp or time.time()
+            )
         if self._metric_collector:
             # Training-speed history feeds the Brain's completion-time
             # prediction (brain/algorithms.py::completion_time).
@@ -244,6 +255,13 @@ class MasterServicer:
         return m.Response()
 
     def _report_failure(self, req: m.NodeFailure):
+        # Master-visible detection point: the node drops out of every
+        # rendezvous below. (The agent's own worker.fail event arrives
+        # async via EventReport; the ledger folds both into one incident.)
+        emit(
+            EventKind.NODE_EVICT, _node_id=req.node_id, _role="master",
+            reason=req.level, restart_count=req.restart_count,
+        )
         if self._job_manager:
             self._job_manager.process_error(
                 req.node_id, req.restart_count, req.error_data, req.level
@@ -252,6 +270,13 @@ class MasterServicer:
             mgr.remove_alive_node(req.node_id)
         if self._task_manager:
             self._task_manager.recover_worker_tasks(req.node_id)
+        return m.Response()
+
+    def _report_events(self, req: m.EventReport):
+        if self._observability:
+            # Not re-journaled per event: this EventReport is itself a
+            # journaled RPC and replays through this same path.
+            self._observability.ingest_report(req.events)
         return m.Response()
 
     def _report_heartbeat(self, req: m.NodeHeartbeat):
@@ -325,6 +350,7 @@ MasterServicer._HANDLERS = {
     m.NodeResourceStats: MasterServicer._report_resource,
     m.ModelInfo: MasterServicer._report_model_info,
     m.NodeFailure: MasterServicer._report_failure,
+    m.EventReport: MasterServicer._report_events,
     m.NodeHeartbeat: MasterServicer._report_heartbeat,
     m.NodeStatusReport: MasterServicer._report_node_status,
     m.SyncJoin: MasterServicer._sync_join,

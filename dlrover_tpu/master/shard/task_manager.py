@@ -369,6 +369,15 @@ class TaskManager:
         with self._lock:
             return dataset_name in self._datasets
 
+    def queue_depths(self) -> Dict[str, Dict[str, int]]:
+        """Per-dataset todo/doing queue sizes (the /metrics exporter's
+        shard-queue gauge)."""
+        with self._lock:
+            return {
+                name: {"todo": len(ds.todo), "doing": len(ds.doing)}
+                for name, ds in self._datasets.items()
+            }
+
     def get_task(self, worker_id: int, dataset_name: str) -> ShardTask:
         with self._lock:
             ds = self._datasets.get(dataset_name)

@@ -150,11 +150,17 @@ class JobMetricCollector:
             }
 
     def add_sink(self, sink: Callable[[str, Dict], None]):
-        """Subscribe to metric events (e.g. a Brain-service reporter)."""
-        self._sinks.append(sink)
+        """Subscribe to metric events (e.g. a Brain-service reporter or
+        the observability plane's event log)."""
+        with self._lock:
+            self._sinks.append(sink)
 
     def _emit(self, kind: str, payload: Dict):
-        for sink in self._sinks:
+        # Snapshot under the lock (add_sink may race a collector call),
+        # call outside it: sinks take their own locks.
+        with self._lock:
+            sinks = list(self._sinks)
+        for sink in sinks:
             try:
                 sink(kind, payload)
             except Exception:

@@ -1,0 +1,44 @@
+"""Observability plane: typed job events, cross-process forwarding,
+the master's goodput ledger and the ``/metrics`` exporter.
+
+See ``docs/observability.md`` for the event schema and goodput model.
+"""
+
+from dlrover_tpu.observability.event_log import EventLog
+from dlrover_tpu.observability.events import (
+    EventKind,
+    JobEvent,
+    emit,
+    install_sink,
+    set_identity,
+    uninstall_sink,
+)
+from dlrover_tpu.observability.exporter import (
+    MetricsExporter,
+    render_prometheus,
+)
+from dlrover_tpu.observability.goodput import GoodputLedger, Incident
+from dlrover_tpu.observability.plane import (
+    GOODPUT_JSON_ENV,
+    METRICS_PORT_ENV,
+    ObservabilityPlane,
+)
+from dlrover_tpu.observability.reporter import EventReporter
+
+__all__ = [
+    "EventKind",
+    "JobEvent",
+    "emit",
+    "install_sink",
+    "uninstall_sink",
+    "set_identity",
+    "EventLog",
+    "GoodputLedger",
+    "Incident",
+    "MetricsExporter",
+    "render_prometheus",
+    "ObservabilityPlane",
+    "EventReporter",
+    "METRICS_PORT_ENV",
+    "GOODPUT_JSON_ENV",
+]

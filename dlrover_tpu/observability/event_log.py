@@ -1,0 +1,116 @@
+"""Master-side event log: bounded, ordered, journaled.
+
+One ring buffer holds the merged event stream of the whole job — the
+master's own emissions plus everything agents/workers forwarded via
+``EventReport``. Each event gets a master-assigned ``seq`` so the
+timeline has a total order even when producer clocks skew.
+
+Durability rides the PR-3 state store: locally-emitted events are
+journaled as ``("event", ev, ts)`` records write-ahead of nothing (the
+event IS the state), while RPC-forwarded batches are NOT re-journaled
+here — their ``EventReport`` request is already a journaled mutating
+RPC, and replaying it re-ingests the same events. High-frequency
+``metric.*`` events are kept in the ring but excluded from the journal
+so the WAL stays bounded by incidents, not by sampling rate.
+"""
+
+import threading
+import time
+from typing import Callable, Iterable, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import JobEvent
+
+
+def _durable(ev: JobEvent) -> bool:
+    return not ev.kind.startswith("metric.")
+
+
+class EventLog:
+    def __init__(self, capacity: int = 4096):
+        self._capacity = capacity
+        self._events: List[JobEvent] = []
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._listeners: List[Callable[[JobEvent], None]] = []
+        #: Optional WAL hook (MasterStateStore.append-compatible).
+        self.journal: Optional[Callable] = None
+
+    def add_listener(self, fn: Callable[[JobEvent], None]):
+        self._listeners.append(fn)
+
+    def append(self, ev: JobEvent, journal: bool = True) -> JobEvent:
+        with self._lock:
+            self._seq += 1
+            ev.seq = self._seq
+            self._events.append(ev)
+            if len(self._events) > self._capacity:
+                del self._events[: len(self._events) - self._capacity]
+        if journal and self.journal is not None and _durable(ev):
+            try:
+                self.journal(("event", ev, time.time()))
+            except Exception:
+                logger.exception("event journal append failed")
+        # Listeners run outside the log lock: the ledger takes its own
+        # lock and must never nest inside ours.
+        for fn in self._listeners:
+            try:
+                fn(ev)
+            except Exception:
+                logger.exception("event listener failed for %s", ev.kind)
+        return ev
+
+    def extend(self, events: Iterable[JobEvent], journal: bool = False):
+        for ev in events:
+            self.append(ev, journal=journal)
+
+    def events(self, kinds=None, limit: Optional[int] = None) -> List[JobEvent]:
+        with self._lock:
+            out = list(self._events)
+        if kinds is not None:
+            want = set(kinds)
+            out = [e for e in out if e.kind in want]
+        if limit is not None:
+            out = out[-limit:]
+        return out
+
+    def counts_by_kind(self):
+        counts = {}
+        for e in self.events():
+            counts[e.kind] = counts.get(e.kind, 0) + 1
+        return counts
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    # ------------- master state snapshot/restore -------------
+    def export_state(self) -> dict:
+        with self._lock:
+            return {
+                "seq": self._seq,
+                "events": [e.to_dict() for e in self._events],
+            }
+
+    def restore_state(self, state: dict):
+        """Reload a snapshot's events, preserving their seq numbers and
+        replaying them through the listeners (the goodput ledger rebuilds
+        its incident history from exactly this pass)."""
+        events = [JobEvent.from_dict(d) for d in state.get("events", ())]
+        with self._lock:
+            self._events.extend(events)
+            self._events.sort(key=lambda e: e.seq)
+            if len(self._events) > self._capacity:
+                del self._events[: len(self._events) - self._capacity]
+            self._seq = max(
+                self._seq, int(state.get("seq", 0)),
+                max((e.seq for e in events), default=0),
+            )
+        for ev in events:
+            for fn in self._listeners:
+                try:
+                    fn(ev)
+                except Exception:
+                    logger.exception(
+                        "event listener failed for %s", ev.kind
+                    )

@@ -1,0 +1,193 @@
+"""Typed job events + the process-local emit entry point.
+
+Every process of a job (master, agents, workers) reports what happened
+to it through :func:`emit`. The call never blocks and never raises: it
+mirrors the event into the process's :class:`~dlrover_tpu.utils.tracing.
+Tracer` (so one Chrome-trace view spans the whole tree) and then routes
+it to whichever transport this process has:
+
+- the **master** installs a direct sink (:func:`install_sink`) feeding
+  its :class:`~dlrover_tpu.observability.event_log.EventLog`;
+- **agents and workers** lazily build an
+  :class:`~dlrover_tpu.observability.reporter.EventReporter` that
+  batches events over the existing master RPC (``EventReport``),
+  buffered with jittered backoff so a briefly-down master loses
+  nothing;
+- processes with neither (standalone scripts, unit tests) keep the
+  tracer mirror only.
+
+The schema is deliberately flat — one dataclass, dotted ``kind``
+strings — so events pickle through the RPC/journal layers and render
+as Chrome-trace instants without adapters.
+"""
+
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import logger
+
+
+class EventKind:
+    """Dotted event names; the prefix is the subsystem."""
+
+    RDZV_ROUND_START = "rendezvous.round_start"
+    RDZV_JOIN = "rendezvous.join"
+    RDZV_ROUND_COMPLETE = "rendezvous.round_complete"
+    RDZV_INVALIDATED = "rendezvous.invalidated"
+    NODE_JOIN = "node.join"
+    NODE_EVICT = "node.evict"
+    NODE_HANG = "node.hang"
+    WORKER_RESTART = "worker.restart"
+    WORKER_FAIL = "worker.fail"
+    CKPT_SAVE = "ckpt.save"
+    CKPT_COMMIT = "ckpt.commit"
+    CKPT_RESTORE = "ckpt.restore"
+    CKPT_FALLBACK = "ckpt.fallback"
+    CHAOS_INJECT = "chaos.inject"
+    STEP_PROGRESS = "step.progress"
+
+
+@dataclass
+class JobEvent:
+    kind: str = ""
+    ts: float = 0.0
+    node_id: int = -1          # -1 = the master itself / unknown
+    role: str = ""             # "master" | "agent" | "worker"
+    pid: int = 0
+    seq: int = -1              # assigned by the master-side EventLog
+    args: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "JobEvent":
+        return cls(**{k: d[k] for k in (
+            "kind", "ts", "node_id", "role", "pid", "seq", "args"
+        ) if k in d})
+
+
+# ---------------- process-local routing ----------------
+
+_lock = threading.Lock()
+_sink: Optional[Callable[[JobEvent], None]] = None
+_identity: Optional[Dict[str, Any]] = None
+_reporter = None          # lazy EventReporter, see _route()
+_reporter_failed = False  # one warning, then tracer-only
+
+
+def set_identity(node_id: int, role: str):
+    """Pin who this process is (the agent knows; workers derive)."""
+    global _identity
+    _identity = {"node_id": int(node_id), "role": role}
+
+
+def install_sink(sink: Callable[[JobEvent], None]):
+    """Master-side: route emits straight into the in-process EventLog."""
+    global _sink
+    with _lock:
+        _sink = sink
+
+
+def uninstall_sink(sink: Callable[[JobEvent], None]):
+    """Remove `sink` only if still installed (a later master wins)."""
+    global _sink
+    with _lock:
+        if _sink is sink:
+            _sink = None
+
+
+def reset():
+    """Test hook: drop sink, identity and the lazy reporter."""
+    global _sink, _identity, _reporter, _reporter_failed
+    with _lock:
+        _sink = None
+        _identity = None
+        rep, _reporter = _reporter, None
+        _reporter_failed = False
+    if rep is not None:
+        try:
+            rep.stop(flush=False)
+        except Exception:
+            pass
+
+
+def flush_events(timeout: float = 3.0):
+    """Best-effort synchronous drain of the forwarding buffer (called at
+    orderly shutdown so the tail of the timeline reaches the master)."""
+    rep = _reporter
+    if rep is not None:
+        try:
+            rep.flush(timeout)
+        except Exception:
+            pass
+
+
+def _derive_identity() -> Dict[str, Any]:
+    node_id = int(os.getenv(NodeEnv.NODE_ID, -1))
+    # Workers carry a PROCESS_ID from the agent; anything else that can
+    # reach a master defaults to "agent".
+    role = "worker" if os.getenv(NodeEnv.PROCESS_ID) else "agent"
+    return {"node_id": node_id, "role": role}
+
+
+def _route(ev: JobEvent):
+    global _reporter, _reporter_failed
+    sink = _sink
+    if sink is not None:
+        sink(ev)
+        return
+    if _reporter is not None:
+        _reporter.emit(ev)
+        return
+    if _reporter_failed or not os.getenv(NodeEnv.MASTER_ADDR):
+        return  # tracer-only process
+    with _lock:
+        if _reporter is None and not _reporter_failed:
+            try:
+                from dlrover_tpu.observability.reporter import EventReporter
+
+                _reporter = EventReporter.singleton_instance()
+            except Exception as e:
+                _reporter_failed = True
+                logger.warning(
+                    "event forwarding unavailable (%s); events stay "
+                    "tracer-local", e,
+                )
+                return
+    if _reporter is not None:
+        _reporter.emit(ev)
+
+
+def emit(_kind: str, _node_id: Optional[int] = None,
+         _role: Optional[str] = None, **args) -> JobEvent:
+    """Record one job event. Never blocks, never raises.
+
+    ``_node_id``/``_role`` override the process identity — the master
+    uses them to stamp events it records ABOUT a node (evictions, hangs)
+    with that node's id so incident attribution lands on the right host.
+    All parameters are underscore-prefixed so payload keys can never
+    shadow them (a chaos event's payload legitimately contains ``kind``).
+    """
+    ident = _identity or _derive_identity()
+    ev = JobEvent(
+        kind=_kind, ts=time.time(),
+        node_id=int(_node_id) if _node_id is not None else ident["node_id"],
+        role=_role if _role is not None else ident["role"],
+        pid=os.getpid(), args=args,
+    )
+    try:
+        from dlrover_tpu.utils.tracing import get_tracer
+
+        get_tracer().instant(_kind, **args)
+    except Exception:
+        pass
+    try:
+        _route(ev)
+    except Exception:
+        logger.exception("event routing failed for %s", kind)
+    return ev

@@ -1,0 +1,122 @@
+"""Prometheus text exporter: stdlib-only ``/metrics`` endpoint.
+
+No client library dependency: the exposition format (text/plain,
+version 0.0.4) is a few lines of escaping, and the master must not grow
+a pip requirement for a scrape endpoint. :func:`render_prometheus`
+turns an ordered list of metric tuples into the wire text (pure, so the
+golden tests can assert it byte-for-byte); :class:`MetricsExporter`
+serves it from a daemon ``ThreadingHTTPServer``, pulling a fresh
+snapshot from its ``collect`` callback per scrape.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.common.log import logger
+
+#: (name, type, help, [(labels, value), ...]) — type is "gauge" or
+#: "counter"; labels may be None for an unlabelled sample.
+Metric = Tuple[str, str, str, Sequence[Tuple[Optional[Dict[str, str]], float]]]
+
+
+def _escape_label(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace("\n", r"\n")
+        .replace('"', r'\"')
+    )
+
+
+def _format_value(value) -> str:
+    f = float(value)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(metrics: Sequence[Metric]) -> str:
+    """Render the exposition text. Label keys are emitted sorted so the
+    output is deterministic for a given snapshot."""
+    lines: List[str] = []
+    for name, mtype, help_text, samples in metrics:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            if labels:
+                body = ",".join(
+                    f'{k}="{_escape_label(v)}"'
+                    for k, v in sorted(labels.items())
+                )
+                lines.append(f"{name}{{{body}}} {_format_value(value)}")
+            else:
+                lines.append(f"{name} {_format_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsExporter:
+    """Serve ``/metrics`` (and a trivial ``/healthz``) on localhost."""
+
+    def __init__(self, collect: Callable[[], Sequence[Metric]],
+                 port: int = 0, host: str = "0.0.0.0"):
+        self._collect = collect
+        self._host = host
+        self._requested_port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port = 0
+
+    def start(self) -> int:
+        collect = self._collect
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server contract)
+                if self.path.split("?", 1)[0] not in ("/metrics", "/healthz"):
+                    self.send_error(404)
+                    return
+                if self.path.startswith("/healthz"):
+                    payload = b"ok\n"
+                    ctype = "text/plain"
+                else:
+                    try:
+                        payload = render_prometheus(collect()).encode()
+                    except Exception:
+                        logger.exception("metric collection failed")
+                        self.send_error(500)
+                        return
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, fmt, *args):
+                pass  # scrapes are not log-worthy
+
+        self._server = ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler
+        )
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="metrics-exporter",
+        )
+        self._thread.start()
+        logger.info("metrics exporter serving on port %s", self.port)
+        return self.port
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -1,0 +1,229 @@
+"""Goodput ledger: fold the event stream into attributed downtime.
+
+The ledger listens on the master's :class:`EventLog` and maintains
+*incidents* — contiguous windows in which training was not making
+progress, each attributed to the fault that opened it. An incident
+
+- **opens** on a fault event (chaos injection, worker failure, node
+  eviction, hang, round invalidation). Related fault events that arrive
+  while an incident is open on the same node *attach* to it instead of
+  opening a second one: a chaos kill, the worker-exit report it causes
+  and the master-side eviction are ONE incident, whose root cause is
+  the injection when one self-reported;
+- records **detect time** — the first master-visible detection event
+  (worker fail / evict / hang) relative to the incident start; the gap
+  between injection and detection is the detector's latency;
+- **closes** when the job makes a training step again
+  (:meth:`note_step`, fed by the servicer's ``GlobalStep`` handler);
+  recover time is close minus start.
+
+``summary()`` reports goodput two ways: the attribution-based ratio
+``(wall - downtime_union) / wall`` (downtime is the UNION of incident
+intervals, so two overlapping faults don't double-count wall time,
+while the per-cause table still charges each its own span), and the
+step-derived ``productive_step_s`` — the summed inter-step gaps during
+which no incident was open — for cross-checking against throughput.
+Open incidents count downtime up to the query time.
+"""
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.observability.events import EventKind, JobEvent
+
+#: kind -> default cause label for incident-opening events.
+_OPENING = {
+    EventKind.CHAOS_INJECT: "chaos",
+    EventKind.WORKER_FAIL: "worker-failure",
+    EventKind.NODE_EVICT: "node-evict",
+    EventKind.NODE_HANG: "hang",
+    EventKind.RDZV_INVALIDATED: "round-invalidated",
+}
+#: Master-visible detection events (stamp detect_ts).
+_DETECT = (
+    EventKind.WORKER_FAIL,
+    EventKind.NODE_EVICT,
+    EventKind.NODE_HANG,
+)
+#: Context events worth attaching to an open incident's trail.
+_CONTEXT = (
+    EventKind.CKPT_RESTORE,
+    EventKind.CKPT_FALLBACK,
+    EventKind.WORKER_RESTART,
+    EventKind.RDZV_ROUND_COMPLETE,
+)
+
+
+@dataclass
+class Incident:
+    cause: str = ""
+    node_id: int = -1
+    start_ts: float = 0.0
+    detect_ts: Optional[float] = None
+    recover_ts: Optional[float] = None
+    injected: bool = False
+    trail: List[str] = field(default_factory=list)
+
+    @property
+    def open(self) -> bool:
+        return self.recover_ts is None
+
+    def duration(self, now: float) -> float:
+        end = self.recover_ts if self.recover_ts is not None else now
+        return max(0.0, end - self.start_ts)
+
+    def to_dict(self, now: float) -> Dict:
+        return {
+            "cause": self.cause,
+            "node_id": self.node_id,
+            "start_ts": self.start_ts,
+            "detect_s": (
+                None if self.detect_ts is None
+                else max(0.0, self.detect_ts - self.start_ts)
+            ),
+            "recover_s": (
+                None if self.recover_ts is None
+                else max(0.0, self.recover_ts - self.start_ts)
+            ),
+            "downtime_s": self.duration(now),
+            "open": self.open,
+            "injected": self.injected,
+            "trail": list(self.trail),
+        }
+
+
+def _union_seconds(intervals: List[tuple]) -> float:
+    """Total length of the union of (start, end) intervals."""
+    total = 0.0
+    end_prev = None
+    for start, end in sorted(intervals):
+        if end <= start:
+            continue
+        if end_prev is None or start > end_prev:
+            total += end - start
+            end_prev = end
+        elif end > end_prev:
+            total += end - end_prev
+            end_prev = end
+    return total
+
+
+class GoodputLedger:
+    #: An inter-step gap longer than this is not counted as productive
+    #: even without an incident (the fault may simply be undetected yet).
+    STEP_GAP_CAP = 120.0
+
+    def __init__(self, now: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._t0 = now if now is not None else time.time()
+        self._incidents: List[Incident] = []
+        self._steps = 0
+        self._last_step = 0
+        self._last_step_ts: Optional[float] = None
+        self._first_step_ts: Optional[float] = None
+        self._productive_step_s = 0.0
+        self._incident_during_gap = False
+
+    # ------------- intake -------------
+    def ingest(self, ev: JobEvent):
+        """EventLog listener: fold one event into the incident model."""
+        if ev.kind in _OPENING:
+            self._on_fault(ev)
+        elif ev.kind in _CONTEXT:
+            with self._lock:
+                inc = self._open_incident_for(ev.node_id)
+                if inc is not None:
+                    inc.trail.append(ev.kind)
+
+    def _on_fault(self, ev: JobEvent):
+        cause = _OPENING[ev.kind]
+        if ev.kind == EventKind.CHAOS_INJECT:
+            cause = f"chaos.{ev.args.get('kind', 'fault')}"
+        with self._lock:
+            self._incident_during_gap = True
+            self._t0 = min(self._t0, ev.ts)
+            inc = self._open_incident_for(ev.node_id)
+            if inc is None:
+                inc = Incident(
+                    cause=cause, node_id=ev.node_id, start_ts=ev.ts,
+                )
+                self._incidents.append(inc)
+            inc.trail.append(ev.kind)
+            inc.start_ts = min(inc.start_ts, ev.ts)
+            if ev.kind == EventKind.CHAOS_INJECT:
+                # The injection is the ROOT cause no matter which event
+                # reached the master first.
+                inc.injected = True
+                inc.cause = cause
+            if ev.kind in _DETECT and inc.detect_ts is None:
+                inc.detect_ts = ev.ts
+
+    def _open_incident_for(self, node_id: int) -> Optional[Incident]:
+        """Most recent open incident this node's events attach to (with
+        the lock held). node_id -1 (master-global) matches anything."""
+        for inc in reversed(self._incidents):
+            if not inc.open:
+                continue
+            if node_id < 0 or inc.node_id < 0 or inc.node_id == node_id:
+                return inc
+        return None
+
+    def note_step(self, step: int, ts: Optional[float] = None):
+        """A training step was reported: the job is productive again —
+        close every open incident and advance the step accounting."""
+        ts = ts if ts is not None else time.time()
+        with self._lock:
+            if self._first_step_ts is None:
+                self._first_step_ts = ts
+                self._t0 = min(self._t0, ts)
+            if self._last_step_ts is not None and ts > self._last_step_ts:
+                gap = ts - self._last_step_ts
+                if not self._incident_during_gap and gap <= self.STEP_GAP_CAP:
+                    self._productive_step_s += gap
+            self._incident_during_gap = False
+            self._last_step_ts = ts
+            self._steps += 1
+            self._last_step = max(self._last_step, step)
+            for inc in self._incidents:
+                if inc.open:
+                    inc.recover_ts = ts
+
+    # ------------- outputs -------------
+    def incidents(self) -> List[Incident]:
+        with self._lock:
+            return list(self._incidents)
+
+    def summary(self, now: Optional[float] = None) -> Dict:
+        now = now if now is not None else time.time()
+        with self._lock:
+            incidents = list(self._incidents)
+            t0 = self._t0
+            steps = self._steps
+            last_step = self._last_step
+            productive = self._productive_step_s
+        wall = max(0.0, now - t0)
+        intervals = [
+            (i.start_ts, i.recover_ts if i.recover_ts is not None else now)
+            for i in incidents
+        ]
+        downtime = min(wall, _union_seconds(intervals)) if wall else 0.0
+        by_cause: Dict[str, float] = {}
+        counts: Dict[str, int] = {}
+        for i in incidents:
+            by_cause[i.cause] = by_cause.get(i.cause, 0.0) + i.duration(now)
+            counts[i.cause] = counts.get(i.cause, 0) + 1
+        goodput = 1.0 if wall <= 0 else max(0.0, (wall - downtime) / wall)
+        return {
+            "wall_s": wall,
+            "downtime_s": downtime,
+            "goodput": goodput,
+            "downtime_by_cause_s": by_cause,
+            "incidents_by_cause": counts,
+            "incidents": [i.to_dict(now) for i in incidents],
+            "open_incidents": sum(1 for i in incidents if i.open),
+            "steps_reported": steps,
+            "last_step": last_step,
+            "productive_step_s": productive,
+        }

@@ -1,0 +1,186 @@
+"""The master's observability plane: log + ledger + exporter, wired.
+
+One object the :class:`~dlrover_tpu.master.master.JobMaster` composes:
+it owns the :class:`EventLog` (with the :class:`GoodputLedger` and the
+checkpoint-duration tracker subscribed), installs the process-wide emit
+sink, ingests forwarded ``EventReport`` batches, and answers the
+``/metrics`` scrape with one consistent snapshot of goodput, downtime
+attribution, speed, node counts, checkpoint durations and shard queue
+depths.
+"""
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.event_log import EventLog
+from dlrover_tpu.observability.events import EventKind, JobEvent
+from dlrover_tpu.observability.exporter import Metric, MetricsExporter
+from dlrover_tpu.observability.goodput import GoodputLedger
+
+#: Master env knobs: scrape port (unset = exporter off; 0 = ephemeral)
+#: and an on-stop goodput artifact path (the bench harness reads it).
+METRICS_PORT_ENV = "DLROVER_TPU_METRICS_PORT"
+GOODPUT_JSON_ENV = "DLROVER_TPU_GOODPUT_JSON"
+
+_CKPT_PHASES = {
+    EventKind.CKPT_SAVE: "save",
+    EventKind.CKPT_COMMIT: "commit",
+    EventKind.CKPT_RESTORE: "restore",
+}
+
+
+class ObservabilityPlane:
+    def __init__(self, capacity: int = 4096):
+        self.event_log = EventLog(capacity)
+        self.ledger = GoodputLedger()
+        self._ckpt_durations: Dict[str, float] = {}
+        self.event_log.add_listener(self.ledger.ingest)
+        self.event_log.add_listener(self._track_ckpt)
+        self.exporter: Optional[MetricsExporter] = None
+        self._speed_monitor = None
+        self._job_manager = None
+        self._task_manager = None
+
+    def attach(self, speed_monitor=None, job_manager=None,
+               task_manager=None):
+        """Late-bind the metric sources the exporter reads from."""
+        if speed_monitor is not None:
+            self._speed_monitor = speed_monitor
+        if job_manager is not None:
+            self._job_manager = job_manager
+        if task_manager is not None:
+            self._task_manager = task_manager
+
+    # ------------- intake -------------
+    def ingest_report(self, events: List[JobEvent]):
+        """A forwarded EventReport batch. Not re-journaled per event:
+        the EventReport RPC itself is a journaled mutation and replays
+        through this same path."""
+        self.event_log.extend(events, journal=False)
+
+    def note_step(self, step: int, ts: Optional[float] = None):
+        self.ledger.note_step(step, ts)
+
+    def metric_sink(self, kind: str, payload: Dict):
+        """JobMetricCollector sink: metric events join the timeline as
+        ``metric.*`` (ring-only — excluded from the WAL by design)."""
+        self.event_log.append(JobEvent(
+            kind=f"metric.{kind}", ts=time.time(),
+            node_id=int(payload.get("node_id", -1)), role="master",
+            pid=os.getpid(), args=dict(payload),
+        ), journal=False)
+
+    def _track_ckpt(self, ev: JobEvent):
+        phase = _CKPT_PHASES.get(ev.kind)
+        if phase is None:
+            return
+        dur = ev.args.get("duration_s")
+        if dur is not None:
+            self._ckpt_durations[phase] = float(dur)
+
+    # ------------- exporter -------------
+    def start_exporter(self, port: int) -> int:
+        self.exporter = MetricsExporter(self.collect_metrics, port=port)
+        return self.exporter.start()
+
+    def stop(self):
+        if self.exporter is not None:
+            self.exporter.stop()
+            self.exporter = None
+        path = os.getenv(GOODPUT_JSON_ENV, "")
+        if path:
+            try:
+                self.dump_json(path)
+            except Exception:
+                logger.exception("goodput artifact dump failed")
+
+    def collect_metrics(self) -> List[Metric]:
+        s = self.ledger.summary()
+        metrics: List[Metric] = [
+            ("dlrover_tpu_goodput_ratio", "gauge",
+             "Productive fraction of wall time (1 - downtime/wall).",
+             [(None, s["goodput"])]),
+            ("dlrover_tpu_downtime_seconds_total", "counter",
+             "Attributed downtime per root cause.",
+             [({"cause": c}, v)
+              for c, v in sorted(s["downtime_by_cause_s"].items())]),
+            ("dlrover_tpu_incidents_total", "counter",
+             "Downtime incidents per root cause.",
+             [({"cause": c}, v)
+              for c, v in sorted(s["incidents_by_cause"].items())]),
+            ("dlrover_tpu_open_incidents", "gauge",
+             "Incidents without a recovery step yet.",
+             [(None, s["open_incidents"])]),
+        ]
+        if self._speed_monitor is not None:
+            metrics.append((
+                "dlrover_tpu_running_speed_steps_per_second", "gauge",
+                "Recent global training speed.",
+                [(None, self._speed_monitor.running_speed())],
+            ))
+            metrics.append((
+                "dlrover_tpu_global_step", "gauge",
+                "Highest reported global step.",
+                [(None, self._speed_monitor.global_step)],
+            ))
+        if self._job_manager is not None:
+            by_status: Dict[str, int] = {}
+            for node in self._job_manager.all_nodes():
+                by_status[node.status] = by_status.get(node.status, 0) + 1
+            metrics.append((
+                "dlrover_tpu_nodes", "gauge", "Nodes per status.",
+                [({"status": st}, n)
+                 for st, n in sorted(by_status.items())] or [(None, 0)],
+            ))
+        if self._ckpt_durations:
+            metrics.append((
+                "dlrover_tpu_checkpoint_duration_seconds", "gauge",
+                "Last checkpoint phase duration.",
+                [({"phase": p}, v)
+                 for p, v in sorted(self._ckpt_durations.items())],
+            ))
+        if self._task_manager is not None and hasattr(
+            self._task_manager, "queue_depths"
+        ):
+            samples = []
+            for name, depths in sorted(
+                self._task_manager.queue_depths().items()
+            ):
+                for queue in ("todo", "doing"):
+                    samples.append((
+                        {"dataset": name, "queue": queue}, depths[queue]
+                    ))
+            if samples:
+                metrics.append((
+                    "dlrover_tpu_shard_queue_depth", "gauge",
+                    "Shard tasks per dataset queue.", samples,
+                ))
+        counts = self.event_log.counts_by_kind()
+        if counts:
+            metrics.append((
+                "dlrover_tpu_events_total", "counter",
+                "Events observed per kind.",
+                [({"kind": k}, n) for k, n in sorted(counts.items())],
+            ))
+        return metrics
+
+    # ------------- artifacts -------------
+    def dump(self) -> Dict:
+        return {
+            "summary": self.ledger.summary(),
+            "events": [e.to_dict() for e in self.event_log.events()],
+        }
+
+    def dump_json(self, path: str) -> str:
+        """Atomic write (same tmp+replace contract as the port file)."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.dump(), f, indent=2, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path

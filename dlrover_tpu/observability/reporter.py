@@ -1,0 +1,143 @@
+"""Client-side event forwarding: buffer locally, batch over the RPC.
+
+Agents and workers cannot write to the master's EventLog directly, and
+an event source must never block on the network (events fire inside
+monitor loops and restore paths). So :meth:`EventReporter.emit` only
+appends to a bounded in-memory buffer (drop-oldest — a timeline with a
+trimmed head beats a wedged agent), and a daemon thread drains it in
+batches through ``MasterClient.report_events``.
+
+Delivery semantics ride the transport: each ``EventReport`` envelope
+carries a request id and the server dedups it like every mutating RPC,
+so a retried batch is applied exactly once. A short master outage is
+absorbed by the RpcClient's own ride-out; if a flush still fails (the
+master stayed down past the retry deadline) the batch is re-queued at
+the front and the loop backs off with jitter before trying again.
+"""
+
+import atexit
+import threading
+from collections import deque
+from typing import List, Optional
+
+from dlrover_tpu.common.backoff import ExponentialBackoff
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import JobEvent
+
+
+class EventReporter:
+    _instance: Optional["EventReporter"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, client=None, flush_interval: float = 0.5,
+                 max_buffer: int = 4096, batch_size: int = 256):
+        if client is None:
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            client = MasterClient.singleton_instance()
+        self._client = client
+        self._flush_interval = flush_interval
+        self._batch_size = batch_size
+        self._buffer = deque(maxlen=max_buffer)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        self._degraded = False  # last send failed; master presumed gone
+        self.sent = 0
+        self.dropped = 0
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True, name="event-reporter"
+        )
+        self._thread.start()
+
+    @classmethod
+    def singleton_instance(cls) -> "EventReporter":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+                atexit.register(cls._instance.stop)
+        return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._instance_lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            inst.stop(flush=False)
+
+    def emit(self, ev: JobEvent):
+        with self._lock:
+            if len(self._buffer) == self._buffer.maxlen:
+                self.dropped += 1
+            self._buffer.append(ev)
+        self._wake.set()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._buffer)
+
+    def _drain(self) -> List[JobEvent]:
+        with self._lock:
+            batch = []
+            while self._buffer and len(batch) < self._batch_size:
+                batch.append(self._buffer.popleft())
+            return batch
+
+    def _requeue(self, batch: List[JobEvent]):
+        with self._lock:
+            for ev in reversed(batch):
+                if len(self._buffer) == self._buffer.maxlen:
+                    self.dropped += 1
+                self._buffer.appendleft(ev)
+
+    def _flush_loop(self):
+        backoff = ExponentialBackoff(initial=0.2, max_delay=10.0)
+        while not self._stopped.is_set():
+            self._wake.wait(timeout=self._flush_interval)
+            self._wake.clear()
+            while True:
+                batch = self._drain()
+                if not batch:
+                    break
+                try:
+                    # Short per-attempt timeout: event delivery has its
+                    # own retry loop right here, so it must not ride the
+                    # transport's multi-minute control-plane deadline.
+                    self._client.report_events(batch, timeout=10.0)
+                    self.sent += len(batch)
+                    self._degraded = False
+                    backoff.reset()
+                except Exception as e:
+                    # The transport already rode out a brief outage; by
+                    # here the master has been gone for minutes. Keep
+                    # the batch and de-correlate the retry.
+                    self._requeue(batch)
+                    self._degraded = True
+                    logger.warning(
+                        "event flush failed (%s); %s buffered, backing "
+                        "off", e, self.pending(),
+                    )
+                    if self._stopped.is_set():
+                        return
+                    # Interruptible: stop() must not wait out a backoff.
+                    self._stopped.wait(backoff.next_delay())
+                    break
+
+    def flush(self, timeout: float = 3.0):
+        """Best-effort synchronous drain (process shutdown). Gives up
+        immediately once the link is degraded — delivery is best-effort
+        and a dead master must not tax every process exit."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        self._wake.set()
+        while (self.pending() and not self._degraded
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+
+    def stop(self, flush: bool = True):
+        if flush and not self._stopped.is_set() and not self._degraded:
+            self.flush()
+        self._stopped.set()
+        self._wake.set()
+        self._thread.join(timeout=1.0)

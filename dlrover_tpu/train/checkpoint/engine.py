@@ -56,6 +56,7 @@ from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.shared_memory import SharedMemory
 from dlrover_tpu.common.storage import CheckpointStorage, get_checkpoint_storage
+from dlrover_tpu.observability.events import EventKind, emit
 
 _ALIGN = 128  # bytes; keeps row-major copies cache-line aligned
 
@@ -656,6 +657,11 @@ class CheckpointEngine:
                         "restored step %s from memory snapshot (%s)",
                         meta.step, self._restore_stats,
                     )
+                    emit(
+                        EventKind.CKPT_RESTORE, source="memory",
+                        step=meta.step,
+                        duration_s=round(time.perf_counter() - t_load0, 3),
+                    )
                     return meta.step, state
                 except Exception:
                     logger.exception("memory restore failed; trying storage")
@@ -725,6 +731,16 @@ class CheckpointEngine:
             logger.info(
                 "restored step %s from storage (%s shard files, %s)",
                 step, n_shards, self._restore_stats,
+            )
+            if skipped:
+                emit(
+                    EventKind.CKPT_FALLBACK, to_step=step,
+                    from_step=s["fallback_from"],
+                    reason=s["fallback_reason"],
+                )
+            emit(
+                EventKind.CKPT_RESTORE, source="storage", step=step,
+                duration_s=round(time.perf_counter() - t_load0, 3),
             )
             return step, state
         if skipped:

@@ -29,10 +29,47 @@ Usage (the async-pipeline idiom, docs/async_pipeline.md)::
 """
 
 import os
+import time
 from typing import Any, Callable, Iterable, Optional
 
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import EventKind, emit
+
+
+class StepProgressReporter:
+    """Coarse training-progress events for the job timeline.
+
+    Per-step events would swamp the event bus at trainer rates, so this
+    folds ``every`` consecutive steps into one ``step.progress`` range
+    event (start/end step + wall seconds + steps/s). Flush on loop exit
+    so the final partial range is not lost."""
+
+    def __init__(self, every: int = 20):
+        self.every = max(1, int(every))
+        self._start_step: Optional[int] = None
+        self._t0 = 0.0
+
+    def note(self, step: int):
+        if self._start_step is None:
+            self._start_step = step
+            self._t0 = time.perf_counter()
+            return
+        if step - self._start_step + 1 >= self.every:
+            self.flush(step)
+
+    def flush(self, step: Optional[int] = None):
+        if self._start_step is None or step is None:
+            self._start_step = None
+            return
+        wall = max(1e-9, time.perf_counter() - self._t0)
+        steps = step - self._start_step + 1
+        emit(
+            EventKind.STEP_PROGRESS, start_step=self._start_step,
+            end_step=step, wall_s=round(wall, 3),
+            steps_per_s=round(steps / wall, 3),
+        )
+        self._start_step = None
 
 
 class ElasticTrainer:

@@ -156,6 +156,11 @@ class Trainer:
                 self._client = MasterClient.singleton_instance()
             except Exception:
                 self._client = None
+        from dlrover_tpu.train.elastic_trainer import StepProgressReporter
+
+        self._progress = StepProgressReporter(
+            every=int(os.getenv("DLROVER_TPU_PROGRESS_EVERY", "20"))
+        )
 
     @property
     def train_step(self):
@@ -338,6 +343,7 @@ class Trainer:
                         self._client.report_global_step(done, time.time())
                     except Exception:
                         pass
+                    self._progress.note(done)
                 report_training_metrics(done)
             last_loss = metrics["loss"]
             if pipeline:
@@ -377,6 +383,7 @@ class Trainer:
                 logger.info("callback requested stop at step %s", done)
                 break
         deferred.flush()  # drain the lag-1 slot before the boundary work
+        self._progress.flush(done if done > start else None)
         if eval_batches is not None and evaluated_at != done:
             last_eval = self.evaluate(
                 eval_batches(), max_batches=eval_max_batches

@@ -17,6 +17,7 @@ Usage::
     tracer.export("/tmp/trace.json")
 """
 
+import atexit
 import contextlib
 import json
 import os
@@ -71,15 +72,23 @@ class Tracer:
             return list(self._events)
 
     def export(self, path: Optional[str] = None) -> Optional[str]:
-        """Write Chrome trace JSON; default path from the env contract."""
+        """Write Chrome trace JSON; default path from the env contract.
+
+        Atomic (tmp + ``os.replace``, the port-file contract): exports
+        fire mid-run and at exit, and a reader — or a crash between
+        truncate and write — must never see a torn file."""
         path = path or os.getenv(_TRACE_ENV, "")
         if not path:
             return None
         with self._lock:
             events = list(self._events)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w") as f:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump({"traceEvents": events}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
         return path
 
 
@@ -87,9 +96,22 @@ _tracer: Optional[Tracer] = None
 _tracer_lock = threading.Lock()
 
 
+def _export_at_exit():
+    try:
+        tracer = _tracer
+        if tracer is not None:
+            tracer.export()
+    except Exception:
+        pass  # exit paths must never fail on tracing
+
+
 def get_tracer() -> Tracer:
     global _tracer
     with _tracer_lock:
         if _tracer is None:
             _tracer = Tracer()
+            if os.getenv(_TRACE_ENV):
+                # The env contract asked for a file: make sure orderly
+                # exits export even if no code path calls export().
+                atexit.register(_export_at_exit)
         return _tracer
