@@ -24,6 +24,12 @@ select, default all):
 - ``longctx`` — seq-4096/8192 flash attention vs the einsum path at
   batch 1 (where the [S,S] logits dominate): the memory win the Pallas
   kernel exists for.
+- ``ckpt_io`` — striped-vs-serial checkpoint persist/restore A/B at
+  the ``ckpt_persist`` layer (no accelerator involved): pipelined
+  parallel-checksum + positional-write persist against the legacy
+  serial checksum-then-write path, and one-fd ``pread``/``readinto``
+  restore against open-per-block ``read_range``, on a >=200 MB
+  synthetic shard (``DLROVER_TPU_BENCH_CKPT_IO_MB``).
 - ``goodput`` — useful-work fraction under injected failures: the
   elastic stack (CPU backend, real master/agent/worker processes) runs
   the same job with per-step flash snapshots vs periodic-disk-only
@@ -296,8 +302,22 @@ def section_medium(peak):
         row["int8_speedup"] = round(
             row["step_time_ms"] / qrow["step_time_ms"], 3
         )
+        if row["int8_speedup"] < 1.0:
+            # Expected on this XLA build, not a regression: a raw
+            # int8 x int8 -> int32 dot microbenchmark runs at bf16
+            # parity (34.7 TOPS vs 36.2 TFLOP/s — the double-rate int8
+            # MXU mode is not engaged), and the quantize chain + int32
+            # output traffic add ~5%. See the measured analysis in
+            # dlrover_tpu/ops/quantized.py's module docstring; the row
+            # stays so builds that DO expose the 2x int8 rate show it.
+            row["int8_note"] = (
+                "expected <1x on this XLA build: int8 MXU runs at bf16 "
+                "rate (34.7 TOPS vs 36.2 TFLOP/s microbench) and the "
+                "quantize chain adds ~5%; see ops/quantized.py"
+            )
         log(f"bench[medium]: int8 MLP {qrow['step_time_ms']}ms "
-            f"({row['int8_speedup']}x vs bf16)")
+            f"({row['int8_speedup']}x vs bf16"
+            f"{'; expected, see int8_note' if 'int8_note' in row else ''})")
     except Exception as e:
         log(f"bench[medium]: int8 row skipped ({e})")
 
@@ -505,6 +525,146 @@ def section_longctx(peak):
         p, x = out.get(f"s{seq}_pallas"), out.get(f"s{seq}_xla")
         if isinstance(p, (int, float)) and isinstance(x, (int, float)):
             out[f"s{seq}_speedup"] = round(x / p, 2)
+    return out
+
+
+def section_ckpt_io():
+    """Striped parallel checkpoint I/O vs the legacy serial path.
+
+    Pure host-side A/B at the ``ckpt_persist`` layer — the same
+    ``persist_shard`` entry the agent saver calls — on a synthetic
+    multi-block shard (a few large kernels plus a tail of small
+    leaves, like a real pytree). The serial arm is the pre-stripe
+    format (``DLROVER_TPU_CKPT_STRIPE_MB=0``: per-block CRC computed
+    inline, then ``write_chunks``); the striped arm is the default
+    pipeline (per-stripe CRCs on the fastcopy pool overlapped with
+    positional ``pwrite``). Restore compares the one-fd
+    ``pread``/``readinto`` reader (plus full stripe verification)
+    against open-per-block ``read_range`` with per-block CRC checks.
+    Both arms hit the same filesystem and page cache, so the ratios
+    are honest even where /tmp is tmpfs."""
+    import tempfile
+
+    import numpy as np
+
+    from dlrover_tpu.common import ckpt_persist
+    from dlrover_tpu.common.ckpt_meta import ShardMeta, TensorMeta
+    from dlrover_tpu.common.storage import PosixDiskStorage
+
+    mb = int(os.getenv("DLROVER_TPU_BENCH_CKPT_IO_MB", "256"))
+    total = mb << 20
+    # ~94% of the payload in 6 big blocks, the rest in 64 small leaves:
+    # the shape that punishes syscall-per-block patterns.
+    big = (total - total // 16) // 6
+    sizes = [big] * 6
+    small = (total - sum(sizes)) // 64
+    sizes += [small] * 63
+    sizes.append(total - sum(sizes))
+    buf = np.frombuffer(
+        np.random.default_rng(0).bytes(total), dtype=np.uint8
+    )
+    tensors, off = [], 0
+    for i, n in enumerate(sizes):
+        tensors.append(TensorMeta(
+            path=f"leaf_{i}", offset=off, nbytes=n, dtype="uint8",
+            shape=(n,),
+        ))
+        off += n
+    storage = PosixDiskStorage()
+    reps = int(os.getenv("DLROVER_TPU_BENCH_CKPT_IO_REPS", "3"))
+
+    def persist_arm(stripe_env, ckpt_dir):
+        meta = ShardMeta(step=1, used_bytes=total, tensors=tensors)
+        best = None
+        prev = os.environ.get("DLROVER_TPU_CKPT_STRIPE_MB")
+        for _ in range(reps):
+            os.environ["DLROVER_TPU_CKPT_STRIPE_MB"] = stripe_env
+            try:
+                stats = ckpt_persist.persist_shard(
+                    storage, ckpt_dir, meta, memoryview(buf)
+                )
+            finally:
+                if prev is None:
+                    os.environ.pop("DLROVER_TPU_CKPT_STRIPE_MB", None)
+                else:
+                    os.environ["DLROVER_TPU_CKPT_STRIPE_MB"] = prev
+            if best is None or stats["persist_s"] < best["persist_s"]:
+                best = stats
+        return best
+
+    from dlrover_tpu.common import fastcopy
+
+    def read_striped(ckpt_dir):
+        """The engine's new restore path, faithfully: parallel stripe
+        verification, then pool-parallel preads straight into the
+        preallocated destination views through one shared fd."""
+        smeta = ckpt_persist.load_step_metas(storage, ckpt_dir, 1)[0]
+        dst = np.empty(total, dtype=np.uint8)
+        t0 = time.perf_counter()
+        reader = ckpt_persist.open_shard_reader(storage, ckpt_dir, 1, 0)
+        assert reader is not None
+        try:
+            ckpt_persist.verify_stripes(reader, smeta, 1, 0)
+            verify_s = time.perf_counter() - t0
+
+            def _one(t):
+                view = memoryview(dst)[t.offset:t.offset + t.nbytes]
+                assert reader.read_into(t.offset, view) == t.nbytes
+
+            fastcopy.parallel_map(_one, smeta.tensors)
+        finally:
+            reader.close()
+        wall = time.perf_counter() - t0
+        assert bytes(dst[:4096]) == bytes(buf[:4096])
+        return wall, verify_s
+
+    def read_serial(ckpt_dir):
+        """The engine's pre-stripe path, faithfully: pool-parallel
+        open/seek/read/close + per-block CRC, then the batched memcpy
+        into the destination (read_block hands back fresh bytes; the
+        old path always paid this staging copy)."""
+        smeta = ckpt_persist.load_step_metas(storage, ckpt_dir, 1)[0]
+        algo = getattr(smeta, "crc_algo", "")
+        dst = np.empty(total, dtype=np.uint8)
+        t0 = time.perf_counter()
+        srcs = fastcopy.parallel_map(
+            lambda t: ckpt_persist.read_block(
+                storage, ckpt_dir, 1, 0, t, algo
+            ),
+            smeta.tensors,
+        )
+        fastcopy.copy_many([
+            (dst[t.offset:t.offset + t.nbytes], np.frombuffer(
+                src, dtype=np.uint8))
+            for t, src in zip(smeta.tensors, srcs)
+        ])
+        wall = time.perf_counter() - t0
+        assert bytes(dst[:4096]) == bytes(buf[:4096])
+        return wall
+
+    out = {"payload_mb": mb, "blocks": len(tensors),
+           "stripe_mb": ckpt_persist.DEFAULT_STRIPE_MB, "reps": reps}
+    with tempfile.TemporaryDirectory() as td:
+        d_serial = os.path.join(td, "serial")
+        d_striped = os.path.join(td, "striped")
+        serial = persist_arm("0", d_serial)
+        striped = persist_arm("", d_striped)
+        out["persist_serial_mbps"] = round(serial["persist_mbps"], 1)
+        out["persist_striped_mbps"] = round(striped["persist_mbps"], 1)
+        out["persist_speedup"] = round(
+            serial["persist_s"] / striped["persist_s"], 2
+        )
+        out["checksum_overhead_pct"] = round(
+            striped["checksum_s"] / striped["persist_s"] * 100, 1
+        )
+        s_wall = min(read_serial(d_serial) for _ in range(reps))
+        walls = [read_striped(d_striped) for _ in range(reps)]
+        st_wall, verify_s = min(walls)
+        out["read_serial_mbps"] = round(total / s_wall / 1e6, 1)
+        out["read_striped_mbps"] = round(total / st_wall / 1e6, 1)
+        out["read_speedup"] = round(s_wall / st_wall, 2)
+        out["verify_ms"] = round(verify_s * 1e3, 1)
+    log(f"bench[ckpt_io]: {out}")
     return out
 
 
@@ -731,8 +891,8 @@ def main():
     # Most-load-bearing first: if the driver's time limit bites, the
     # budget guard sheds the tail sections, not the headline.
     default_sections = (
-        "small,large,llama,longctx,goodput,medium"
-        if on_tpu else "small,goodput"
+        "small,large,llama,longctx,goodput,ckpt_io,medium"
+        if on_tpu else "small,goodput,ckpt_io"
     )
     sections = os.getenv(
         "DLROVER_TPU_BENCH_SECTIONS", default_sections
@@ -764,6 +924,8 @@ def main():
                 extra["llama"] = section_llama(peak)
             elif name == "longctx":
                 extra["longctx"] = section_longctx(peak)
+            elif name == "ckpt_io":
+                extra["ckpt_io"] = section_ckpt_io()
             elif name == "goodput":
                 extra["goodput"] = section_goodput()
         except Exception as e:
@@ -777,13 +939,22 @@ def main():
 
     baseline_s = 2.0
     value = max(save_block_s if save_block_s is not None else 1.0, 1e-4)
-    print(json.dumps({
+    result = {
         "metric": "flash_ckpt_blocking_save_s",
         "value": round(value, 4),
         "unit": "s",
         "vs_baseline": round(baseline_s / value, 2),
         "extra": extra,
-    }))
+    }
+    print(json.dumps(result))
+    # Round-over-round regression table against the newest archived
+    # BENCH_r*.json — stderr only; stdout stays the one JSON line.
+    try:
+        from tools.bench_delta import compare_latest
+
+        log(compare_latest(result))
+    except Exception as e:
+        log(f"bench: delta table skipped ({e})")
 
 
 if __name__ == "__main__":

@@ -60,6 +60,10 @@ class CommonDirCheckpointSaver:
         self._last_persisted = -1
         self._flush_lock = threading.Lock()
         self._stopped = False
+        # Aggregated persist_shard stats of the current save round,
+        # appended under _io_lock (shards persist concurrently).
+        self._io_lock = threading.Lock()
+        self._io_stats: list = []
 
         self._meta = SharedDict(
             ckpt_meta_dict(self._node_rank), create=True, job=self._job
@@ -154,9 +158,11 @@ class CommonDirCheckpointSaver:
                 return False
             shm = SharedMemory(fresh.shm_name)
             try:
-                ckpt_persist.persist_shard(
+                stats = ckpt_persist.persist_shard(
                     self.storage, self.checkpoint_dir, fresh, shm.buf
                 )
+                with self._io_lock:
+                    self._io_stats.append(stats)
             finally:
                 shm.close()
             return True
@@ -179,6 +185,8 @@ class CommonDirCheckpointSaver:
             return
         commit_at = -1
         persist_t0 = time.monotonic()
+        with self._io_lock:
+            self._io_stats = []
         # The commit wait (potentially minutes, multi-node) runs OUTSIDE
         # _flush_lock — the crash/SIGTERM flush must never queue behind it.
         with self._flush_lock:
@@ -239,9 +247,17 @@ class CommonDirCheckpointSaver:
                     "saver)", step,
                 )
         if commit_at >= 0:
+            with self._io_lock:
+                io_bytes = sum(s["bytes"] for s in self._io_stats)
+                io_wall = max(
+                    (s["persist_s"] for s in self._io_stats), default=0.0
+                )
             emit(
                 EventKind.CKPT_SAVE, step=commit_at,
                 duration_s=round(time.monotonic() - persist_t0, 3),
+                bytes=int(io_bytes),
+                persist_mbps=round(io_bytes / io_wall / 1e6, 1)
+                if io_wall > 0 else 0.0,
             )
             self._finish_step(commit_at, commit_timeout)
 

@@ -25,7 +25,7 @@ from typing import Optional
 
 from dlrover_tpu.chaos.injector import FaultEvent, fault_hit
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.common.storage import CheckpointStorage
+from dlrover_tpu.common.storage import CheckpointStorage, StripeWriter
 
 
 def _mangle(data: bytes, event: FaultEvent) -> Optional[bytes]:
@@ -90,6 +90,18 @@ class ChaosStorage(CheckpointStorage):
         # the persist layer's chunks are an optimization, not a unit of
         # failure atomicity.
         self.write_bytes(b"".join(bytes(c) for c in chunks), path)
+
+    def open_writer(self, path: str, size=None) -> StripeWriter:
+        # Deliberately the buffered base writer: its commit funnels the
+        # fully-assembled file through self.write_bytes, so striped
+        # persists keep the chaos contract — one fault_hit consultation
+        # per file, a corrupt offset can land on any byte.
+        return StripeWriter(self, path, size)
+
+    def open_reader(self, path: str):
+        # Reads pass straight through (chaos mangles only writes), so
+        # hand out the inner backend's native positional reader.
+        return self.inner.open_reader(path)
 
     # reads and namespace ops pass straight through
     def read(self, path: str, mode: str = "r"):

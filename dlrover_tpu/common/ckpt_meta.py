@@ -75,6 +75,22 @@ class TensorMeta:
 
 
 @dataclass
+class StripeMeta:
+    """One fixed-size stripe of a shard's persisted ``.bin`` layout.
+
+    Stripes are cut over the *file* byte range (the concatenation of the
+    persist-owned blocks), independent of block boundaries — one stripe
+    may span many small leaves, one huge leaf may span many stripes.
+    Per-stripe checksums let restore verify in parallel and localize
+    corruption to a stripe instead of failing the whole shard opaquely.
+    """
+
+    offset: int = 0
+    nbytes: int = 0
+    crc: int = 0
+
+
+@dataclass
 class ShardMeta:
     """Everything needed to rebuild one rank's state dict from its buffer."""
 
@@ -97,6 +113,13 @@ class ShardMeta:
     # Checksum algorithm of the tensors' ``crc`` fields ("" = none —
     # shm metas and pre-upgrade checkpoints). Stamped by persist_shard.
     crc_algo: str = ""
+    # Striped-I/O integrity: checksums over fixed-size stripes of the
+    # persisted .bin layout (algorithm = crc_algo). None = pre-stripe
+    # checkpoint (integrity rides per-block in TensorMeta.crc instead).
+    # Read via getattr — metas pickled before these fields existed
+    # resolve to the class defaults.
+    stripes: Optional[List[StripeMeta]] = None
+    stripe_bytes: int = 0
 
 
 @dataclass

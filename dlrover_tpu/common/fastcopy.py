@@ -182,6 +182,15 @@ def as_bytes_view(arr: np.ndarray, writeback: bool = False) -> np.ndarray:
     return arr.reshape(-1).view(np.uint8)
 
 
+def submit(fn, *args):
+    """Schedule ``fn(*args)`` on the shared pool and return its Future.
+
+    The striped persist pipeline uses this to overlap stripe
+    checksumming (pool threads, GIL released in the C crc loop) with
+    the persist thread's positional writes."""
+    return _pool().submit(fn, *args)
+
+
 def parallel_map(fn, items):
     """Run fn over items on the shared pool (restore reads are I/O-bound;
     serializing them leaves disk bandwidth on the table)."""

@@ -47,6 +47,9 @@ class EventKind:
     CKPT_COMMIT = "ckpt.commit"
     CKPT_RESTORE = "ckpt.restore"
     CKPT_FALLBACK = "ckpt.fallback"
+    # Striped checkpoint I/O throughput (op="persist"|"read": bytes,
+    # mbps, checksum_s) — the perf counters behind the goodput story.
+    CKPT_IO = "ckpt.io"
     CHAOS_INJECT = "chaos.inject"
     STEP_PROGRESS = "step.progress"
 
@@ -189,5 +192,5 @@ def emit(_kind: str, _node_id: Optional[int] = None,
     try:
         _route(ev)
     except Exception:
-        logger.exception("event routing failed for %s", kind)
+        logger.exception("event routing failed for %s", _kind)
     return ev

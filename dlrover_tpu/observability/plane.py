@@ -37,6 +37,9 @@ class ObservabilityPlane:
         self.event_log = EventLog(capacity)
         self.ledger = GoodputLedger()
         self._ckpt_durations: Dict[str, float] = {}
+        # Last ckpt.io throughput sample per op ("persist"/"read"):
+        # {"mbps": ..., "checksum_overhead": ...}.
+        self._ckpt_io: Dict[str, Dict[str, float]] = {}
         self.event_log.add_listener(self.ledger.ingest)
         self.event_log.add_listener(self._track_ckpt)
         self.exporter: Optional[MetricsExporter] = None
@@ -74,6 +77,25 @@ class ObservabilityPlane:
         ), journal=False)
 
     def _track_ckpt(self, ev: JobEvent):
+        if ev.kind == EventKind.CKPT_IO:
+            op = str(ev.args.get("op", ""))
+            if not op:
+                return
+            sample: Dict[str, float] = {}
+            mbps = ev.args.get("mbps")
+            if mbps is not None:
+                sample["mbps"] = float(mbps)
+            # Checksum overhead as a fraction of the persist wall: the
+            # cost integrity adds on top of raw I/O.
+            cs, by, mb = (ev.args.get("checksum_s"), ev.args.get("bytes"),
+                          ev.args.get("mbps"))
+            if cs is not None and by and mb:
+                wall = float(by) / (float(mb) * 1e6) if mb else 0.0
+                if wall > 0:
+                    sample["checksum_overhead"] = float(cs) / wall
+            if sample:
+                self._ckpt_io[op] = sample
+            return
         phase = _CKPT_PHASES.get(ev.kind)
         if phase is None:
             return
@@ -142,6 +164,25 @@ class ObservabilityPlane:
                 [({"phase": p}, v)
                  for p, v in sorted(self._ckpt_durations.items())],
             ))
+        if self._ckpt_io:
+            mbps_samples = [({"op": op}, s["mbps"])
+                            for op, s in sorted(self._ckpt_io.items())
+                            if "mbps" in s]
+            if mbps_samples:
+                metrics.append((
+                    "dlrover_tpu_ckpt_io_mbps", "gauge",
+                    "Last checkpoint I/O throughput per op (MB/s).",
+                    mbps_samples,
+                ))
+            overhead = [({"op": op}, s["checksum_overhead"])
+                        for op, s in sorted(self._ckpt_io.items())
+                        if "checksum_overhead" in s]
+            if overhead:
+                metrics.append((
+                    "dlrover_tpu_ckpt_io_checksum_overhead_ratio", "gauge",
+                    "Checksum CPU-seconds over persist wall seconds.",
+                    overhead,
+                ))
         if self._task_manager is not None and hasattr(
             self._task_manager, "queue_depths"
         ):

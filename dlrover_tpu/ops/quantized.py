@@ -114,6 +114,15 @@ def _row_scale(x):
     return jnp.where(s == 0, 1.0, s).astype(jnp.float32)
 
 
+def _col_scale(w):
+    """Symmetric absmax scale over the first (contraction) dim -> [1, N].
+
+    Reduces axis 0 directly instead of the old ``_row_scale(w.T).T``
+    round-trip, so no transpose of the full kernel enters the graph."""
+    s = jnp.max(jnp.abs(w), axis=0, keepdims=True)
+    return jnp.where(s == 0, 1.0, s).astype(jnp.float32)
+
+
 def _quant8(x, scale):
     return jnp.clip(
         jnp.round(x.astype(jnp.float32) / scale * 127.0), -127, 127
@@ -137,7 +146,7 @@ def int8_dot(x, w):
 
 def _int8_dot_fwd(x, w):
     sx = _row_scale(x)                      # [..., 1] per-row
-    sw = _row_scale(w.T).T                  # [1, N] per-column
+    sw = _col_scale(w)                      # [1, N] per-column
     qx = _quant8(x, sx)
     qw = _quant8(w, sw)
     acc = jax.lax.dot_general(

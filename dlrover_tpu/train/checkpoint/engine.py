@@ -34,7 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from dlrover_tpu.chaos.injector import fault_hit
-from dlrover_tpu.common import ckpt_persist, fastcopy
+from dlrover_tpu.common import checksum, ckpt_persist, fastcopy
 from dlrover_tpu.common.ckpt_meta import (
     SaveEvent,
     SaverRegistration,
@@ -104,6 +104,11 @@ def _memo_reader(read: Callable[[], np.ndarray]) -> Callable[[], np.ndarray]:
             cache.append(read())
         return cache[0]
 
+    # Forward the direct-into fast path: exact-match destinations pread
+    # straight into the preallocated view and never need the memo.
+    read_into = getattr(read, "read_into", None)
+    if read_into is not None:
+        cached.read_into = read_into
     return cached
 
 
@@ -742,6 +747,11 @@ class CheckpointEngine:
                 EventKind.CKPT_RESTORE, source="storage", step=step,
                 duration_s=round(time.perf_counter() - t_load0, 3),
             )
+            emit(
+                EventKind.CKPT_IO, op="read", step=step,
+                bytes=int(nbytes), mbps=round(s["read_mbps"], 1),
+                verify_s=round(s["verify_s"], 4),
+            )
             return step, state
         if skipped:
             logger.error(
@@ -754,9 +764,14 @@ class CheckpointEngine:
     def _restore_step(self, template, step: int) -> Tuple[int, int, Any]:
         """Rebuild `template` from one persisted step, fully verified.
 
+        One positional reader is opened per shard bin and shared by all of
+        its block reads (replacing the open-per-block pattern); striped
+        metas are stripe-verified in parallel up front, which localizes
+        corruption and lets the block reads themselves skip re-hashing.
+
         Raises :class:`ckpt_persist.StepCorruptionError` when the step is
         structurally broken (no/undecodable/missing shard metas, missing
-        or truncated bins) or any block fails its checksum."""
+        or truncated bins) or any stripe/block fails its checksum."""
         metas = ckpt_persist.load_step_metas(
             self.storage, self.checkpoint_dir, step
         )
@@ -773,27 +788,53 @@ class CheckpointEngine:
         catalog: Dict[str, List] = {}
         objects: Dict[str, Any] = {}
         nbytes = 0
-        for gid in sorted(metas):
-            meta = metas[gid]
-            algo = getattr(meta, "crc_algo", "")
-            for k, v in meta.objects.items():
-                objects.setdefault(k, v)
-            for t in meta.tensors:
-                nbytes += t.nbytes
-                catalog.setdefault(t.path, []).append(
-                    (t, self._storage_reader(step, gid, t, algo))
+        readers: List[Any] = []
+        try:
+            for gid in sorted(metas):
+                meta = metas[gid]
+                algo = getattr(meta, "crc_algo", "")
+                reader = ckpt_persist.open_shard_reader(
+                    self.storage, self.checkpoint_dir, step, gid
                 )
-        state = self._rebuild(template, catalog, objects)
+                if reader is None and meta.tensors:
+                    raise ckpt_persist.StepCorruptionError(
+                        step, f"shard {gid} bin missing"
+                    )
+                if reader is not None:
+                    readers.append(reader)
+                    t_v0 = time.perf_counter()
+                    ckpt_persist.verify_stripes(reader, meta, step, gid)
+                    if hasattr(self, "_restore_stats"):
+                        self._restore_stats["verify_s"] += (
+                            time.perf_counter() - t_v0
+                        )
+                for k, v in meta.objects.items():
+                    objects.setdefault(k, v)
+                for t in meta.tensors:
+                    nbytes += t.nbytes
+                    catalog.setdefault(t.path, []).append(
+                        (t, self._storage_reader(step, gid, t, algo, reader))
+                    )
+            state = self._rebuild(template, catalog, objects)
+        finally:
+            for r in readers:
+                try:
+                    r.close()
+                except Exception:
+                    pass
         return nbytes, len(metas), state
 
     # ------------- restore attribution -------------
     @property
     def last_restore_stats(self) -> Dict[str, Any]:
         """Phase breakdown of the most recent ``load``: ``read_s``
-        (wall time of the batched parallel block reads — partial-
-        overlap reads count under assemble), ``device_put_s``
-        (host->device transfers for sharded templates), ``assemble_s``
-        (region fill + batched memcpy = total - read - device_put),
+        (wall time of the batched parallel block reads — direct preads
+        into destination views plus staged reads; partial-overlap reads
+        count under assemble) and the derived ``read_mbps``,
+        ``verify_s`` (parallel stripe verification of striped shards),
+        ``device_put_s`` (host->device transfers for sharded
+        templates), ``assemble_s`` (region fill + batched memcpy =
+        total - read - verify - device_put),
         ``total_s``, ``source``, ``bytes``; plus the verified-restore
         chain: ``step`` (the step actually restored), ``skipped``
         (list of (step, reason) pairs rejected on the way down) and,
@@ -803,8 +844,10 @@ class CheckpointEngine:
 
     def _reset_restore_stats(self):
         self._restore_stats = {
-            "source": None, "read_s": 0.0, "device_put_s": 0.0,
+            "source": None, "read_s": 0.0, "verify_s": 0.0,
+            "device_put_s": 0.0,
             "assemble_s": 0.0, "total_s": 0.0, "bytes": 0,
+            "read_mbps": 0.0,
             "step": -1, "skipped": [],
             "fallback_from": None, "fallback_reason": None,
         }
@@ -815,26 +858,67 @@ class CheckpointEngine:
         s["bytes"] = int(nbytes)
         s["total_s"] = time.perf_counter() - t0
         s["assemble_s"] = max(
-            0.0, s["total_s"] - s["read_s"] - s["device_put_s"]
+            0.0,
+            s["total_s"] - s["read_s"] - s["verify_s"] - s["device_put_s"],
         )
+        if s["read_s"] > 0:
+            s["read_mbps"] = s["bytes"] / s["read_s"] / 1e6
 
     def _storage_reader(
-        self, step: int, gid: int, t: TensorMeta, crc_algo: str = ""
+        self, step: int, gid: int, t: TensorMeta, crc_algo: str = "",
+        reader=None,
     ) -> Callable[[], np.ndarray]:
-        def read() -> np.ndarray:
-            # read_block raises StepCorruptionError itself on a checksum
-            # mismatch; a missing/short block is promoted to one here so
-            # the fallback chain treats both as "this step is damaged".
-            raw = ckpt_persist.read_block(
-                self.storage, self.checkpoint_dir, step, gid, t, crc_algo
+        """A block source over the shard's shared positional reader.
+
+        The returned callable materializes the block (used by the
+        partial-overlap reshard path); its ``read_into`` attribute preads
+        the block straight into a preallocated destination view — the
+        exact-match fast path, one copy total. Per-block checksums
+        (legacy metas) are verified either way; striped metas carry
+        ``crc=None`` here because stripe verification already covered
+        every byte. Falls back to ``read_block`` when the storage could
+        not produce a reader."""
+        crc = getattr(t, "crc", None)
+
+        def _corrupt(reason: str):
+            return ckpt_persist.StepCorruptionError(
+                step,
+                f"{reason} in shard {gid} block {t.path!r} "
+                f"(offset {t.offset}, {t.nbytes} bytes)",
             )
-            if raw is None:
-                raise ckpt_persist.StepCorruptionError(
-                    step,
-                    f"block {t.path}{t.index} missing from shard {gid}",
+
+        def read() -> np.ndarray:
+            if reader is None:
+                # read_block raises StepCorruptionError itself on a
+                # checksum mismatch; a missing/short block is promoted to
+                # one here so the fallback chain treats both as "this
+                # step is damaged".
+                raw = ckpt_persist.read_block(
+                    self.storage, self.checkpoint_dir, step, gid, t,
+                    crc_algo,
                 )
+                if raw is None:
+                    raise ckpt_persist.StepCorruptionError(
+                        step,
+                        f"block {t.path}{t.index} missing from shard {gid}",
+                    )
+                return np.frombuffer(raw, dtype=t.dtype).reshape(t.shape)
+            raw = reader.read(t.offset, t.nbytes)
+            if len(raw) != t.nbytes:
+                raise _corrupt("missing/truncated block")
+            if not checksum.verify_block(raw, crc, crc_algo):
+                raise _corrupt("checksum mismatch")
             return np.frombuffer(raw, dtype=t.dtype).reshape(t.shape)
 
+        if reader is not None:
+            def read_into(dst: np.ndarray) -> None:
+                got = reader.read_into(t.offset, dst)
+                if got != t.nbytes:
+                    raise _corrupt("missing/truncated block")
+                if not checksum.verify_block(dst, crc, crc_algo):
+                    raise _corrupt("checksum mismatch")
+
+            read.read_into = read_into
         return read
 
     # ------------- rebuild -------------
@@ -867,17 +951,27 @@ class CheckpointEngine:
                 )
         # Batched block reads run in a thread pool: time the phase at
         # its wall clock here (per-reader timers would race and sum
-        # overlapping durations past total_s).
+        # overlapping durations past total_s). Sources with a
+        # ``read_into`` capability (storage restores) pread straight into
+        # the preallocated destination views — no intermediate bytes, no
+        # separate memcpy pass; the rest (shm restores) keep the
+        # read-then-batched-copy path.
+        direct = [p for p in exact_pairs
+                  if getattr(p[1], "read_into", None) is not None]
+        staged = [p for p in exact_pairs
+                  if getattr(p[1], "read_into", None) is None]
         t_read0 = time.perf_counter()
+        if direct:
+            fastcopy.parallel_map(lambda p: p[1].read_into(p[0]), direct)
         srcs = fastcopy.parallel_map(
-            lambda pair: fastcopy.as_bytes_view(pair[1]()), exact_pairs
+            lambda pair: fastcopy.as_bytes_view(pair[1]()), staged
         )
         if hasattr(self, "_restore_stats"):
             self._restore_stats["read_s"] += (
                 time.perf_counter() - t_read0
             )
         fastcopy.copy_many(
-            [(dst, src) for (dst, _), src in zip(exact_pairs, srcs)]
+            [(dst, src) for (dst, _), src in zip(staged, srcs)]
         )
         return jax.tree_util.tree_unflatten(treedef, out)
 

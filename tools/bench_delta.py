@@ -1,0 +1,214 @@
+"""Round-over-round bench comparison: the two newest ``BENCH_r*.json``.
+
+The driver archives every bench run as ``BENCH_rNN.json`` with the
+bench's single stdout JSON line embedded in the ``tail`` field (or
+pre-parsed under ``parsed``). This tool extracts that line from the two
+newest rounds, flattens the numeric metrics, and prints a focused
+delta table — throughput rows (``tokens_per_s``, ``mbps``), goodput
+percentages, speedup ratios and latency rows — flagging any metric
+that moved more than 5% in the *bad* direction (direction-aware:
+``*_s``/``*_ms``/``wall*``/``overhead*`` want to shrink, everything
+else wants to grow).
+
+Run standalone::
+
+    python tools/bench_delta.py            # two newest rounds
+    python tools/bench_delta.py OLD NEW    # explicit artifacts
+
+or let ``bench.py`` call :func:`compare_latest` with its fresh
+in-memory result so every bench run ends with the regression table on
+stderr (stdout stays the one JSON line).
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: |delta| beyond this fraction in the bad direction gets flagged.
+REGRESSION_PCT = 5.0
+
+#: Flattened-key patterns worth a row. Everything numeric is compared,
+#: but the table stays readable by showing only the load-bearing rows.
+_INTERESTING = re.compile(
+    r"(tokens_per_s|goodput_.*_pct|mbps|speedup|mfu_pct|step_time_ms"
+    r"|_save_s|restore_ms|overhead|wall_.*_s|blocking_save)", re.I,
+)
+
+#: Lower-is-better keys: latencies, wall clocks, overheads.
+#: (``(?<!per)_s`` keeps rate keys like ``tokens_per_s`` out.)
+_LOWER_BETTER = re.compile(
+    r"(_ms$|(?<!per)_s$|_s_per_gb$|wall|overhead|step_time|compile)",
+    re.I,
+)
+
+
+def extract_result(doc: Dict) -> Optional[Dict]:
+    """The bench stdout line from one artifact, whatever its vintage."""
+    if "metric" in doc:
+        return doc
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return parsed
+    tail = doc.get("tail", "")
+    # Last line of the tail that parses as the bench JSON contract.
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj
+    return _recover_truncated(tail)
+
+
+def _recover_truncated(tail: str) -> Optional[Dict]:
+    """Salvage named sections from a front-truncated bench JSON line.
+
+    Driver artifacts keep only the last N bytes of output, so a long
+    result line can arrive with its head cut off. Every ``"name": {...}``
+    whose braces balance inside the surviving text is still a complete
+    JSON object — harvest those so at least the tail sections (medium,
+    goodput, ckpt_io, ...) stay comparable."""
+    line = tail.splitlines()[-1] if tail.splitlines() else ""
+    extra: Dict = {}
+    for m in re.finditer(r'"(\w+)":\s*\{', line):
+        depth, i = 0, m.end() - 1
+        while i < len(line):
+            if line[i] == "{":
+                depth += 1
+            elif line[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            i += 1
+        if depth != 0:
+            continue
+        try:
+            obj = json.loads(line[m.end() - 1:i + 1])
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            extra.setdefault(m.group(1), obj)
+    if not extra:
+        return None
+    return {"metric": "recovered_truncated", "extra": extra}
+
+
+def _flatten(obj, prefix="") -> Dict[str, float]:
+    """Numeric leaves of a nested dict as dotted keys; lists skipped
+    (restart_breakdown etc. are per-incident records, not metrics)."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.update(_flatten(v, key))
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+    return out
+
+
+def delta_rows(old: Dict, new: Dict) -> List[Tuple]:
+    """(key, old, new, pct_change, flag) for interesting shared keys."""
+    fo, fn = _flatten(old), _flatten(new)
+    rows = []
+    for key in sorted(fo.keys() & fn.keys()):
+        if not _INTERESTING.search(key):
+            continue
+        a, b = fo[key], fn[key]
+        if a == 0:
+            continue
+        pct = (b - a) / abs(a) * 100.0
+        worse = -pct if _LOWER_BETTER.search(key) else pct
+        flag = "REGRESSION" if worse < -REGRESSION_PCT else (
+            "improved" if worse > REGRESSION_PCT else "")
+        rows.append((key, a, b, pct, flag))
+    return rows
+
+
+def format_table(rows: List[Tuple], old_name: str, new_name: str) -> str:
+    if not rows:
+        return (f"bench-delta: no shared numeric metrics between "
+                f"{old_name} and {new_name}")
+    width = max(len(r[0]) for r in rows)
+    lines = [f"bench-delta: {old_name} -> {new_name} "
+             f"(flag = >{REGRESSION_PCT:.0f}% in the bad direction)"]
+    lines.append(f"  {'metric'.ljust(width)}  {'old':>12}  {'new':>12}"
+                 f"  {'delta':>8}")
+    n_reg = 0
+    for key, a, b, pct, flag in rows:
+        n_reg += flag == "REGRESSION"
+        lines.append(
+            f"  {key.ljust(width)}  {a:>12.4g}  {b:>12.4g}"
+            f"  {pct:>+7.1f}%  {flag}".rstrip()
+        )
+    lines.append(f"  {n_reg} regression(s) flagged" if n_reg
+                 else "  no regressions flagged")
+    return "\n".join(lines)
+
+
+def newest_artifacts(repo: str, n: int = 2) -> List[str]:
+    paths = glob.glob(os.path.join(repo, "BENCH_r*.json"))
+
+    def round_no(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    return sorted(paths, key=round_no)[-n:]
+
+
+def compare_latest(new_result: Optional[Dict] = None,
+                   repo: Optional[str] = None) -> str:
+    """The delta table as a string.
+
+    With ``new_result`` (bench.py's fresh in-memory dict) the newest
+    archived round is the baseline; otherwise the two newest archived
+    rounds are compared against each other.
+    """
+    repo = repo or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    want = 1 if new_result is not None else 2
+    arts = newest_artifacts(repo, want)
+    if len(arts) < want:
+        return "bench-delta: not enough BENCH_r*.json rounds to compare"
+    old = extract_result(json.load(open(arts[0])))
+    if old is None:
+        return (f"bench-delta: no bench JSON line found in "
+                f"{os.path.basename(arts[0])}")
+    if new_result is not None:
+        new, new_name = new_result, "current run"
+    else:
+        new = extract_result(json.load(open(arts[1])))
+        new_name = os.path.basename(arts[1])
+        if new is None:
+            return f"bench-delta: no bench JSON line found in {new_name}"
+    return format_table(
+        delta_rows(old, new), os.path.basename(arts[0]), new_name
+    )
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) == 2:
+        old = extract_result(json.load(open(argv[0])))
+        new = extract_result(json.load(open(argv[1])))
+        if old is None or new is None:
+            print("bench-delta: could not extract a bench JSON line",
+                  file=sys.stderr)
+            return 1
+        print(format_table(delta_rows(old, new),
+                           os.path.basename(argv[0]),
+                           os.path.basename(argv[1])))
+        return 0
+    print(compare_latest())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
