@@ -26,7 +26,10 @@ _DEFAULT_HBM = 16e9  # v5e-class chip; overridable via device memory stats
 @dataclass(frozen=True)
 class ParallelSpec:
     """Mesh degrees — the Strategy object (parity: accelerate.py Strategy +
-    parallel_mode, condensed)."""
+    parallel_mode, condensed). ``zero`` is not a mesh axis: it flags
+    ZeRO-1 weight-update sharding of the optimizer state over the
+    existing ``data`` axis (``accel/zero.py``), composable with any of
+    the degrees."""
 
     data: int = 1
     fsdp: int = 1
@@ -34,6 +37,7 @@ class ParallelSpec:
     seq: int = 1
     expert: int = 1
     pipe: int = 1
+    zero: bool = False
 
     def __post_init__(self):
         for name in ("data", "fsdp", "tensor", "seq", "expert", "pipe"):
@@ -326,6 +330,15 @@ def auto_accelerate(
             )
             abstract = (reg or default_registry).annotate_state(abstract)
         _check_spec_axes_used(sp, abstract)
+        if sp.zero:
+            # ZeRO-1: re-annotate opt-state leaves with the zero_dp axis
+            # (rules already map it to "data" — sp.rules() saw zero=True).
+            # Everything downstream is unchanged: the shardings computed
+            # from the relabeled tree land in the jit in/out shardings
+            # and GSPMD schedules the RS/AG. No optimizer wrapper.
+            from dlrover_tpu.accel.zero import apply_zero
+
+            abstract = apply_zero(abstract, sp, rules)
         shardings = state_shardings(mesh, abstract, rules)
         opt = optimizer
         if offload_optimizer:

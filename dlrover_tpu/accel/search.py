@@ -122,7 +122,16 @@ class ModelProfile:
     expert_ffn_params: int = 0       # expert-sharded FFN params (all
                                      # layers, all experts); 0 when dense
     dtype_bytes: int = 2             # activation dtype (bf16)
-    state_bytes_per_param: float = 16.0  # fp32 param + adam m/v + grad
+    param_dtype_bytes: int = 4       # param (and grad) dtype; bf16
+                                     # models store/grad in 2 bytes but
+                                     # their optimizer state still
+                                     # widens to fp32 (see below)
+    # Analytic train-state bytes/param, mixed-precision recipe: param +
+    # grad at param dtype, fp32 adam m/v (8), plus a separate fp32
+    # master copy (4) when params are not already fp32 — the dtype
+    # widening ZeRO exists to shard. fp32: 4+4+8=16; bf16: 2+2+8+4=16.
+    # Exact when the abstract tree is available (state_bytes_per_device).
+    state_bytes_per_param: float = 16.0
     flops_per_token: float = 0.0
 
     @staticmethod
@@ -149,6 +158,18 @@ class ModelProfile:
             getattr(cfg, "num_layers", 0) * n_exp * per_expert
             if n_exp > 1 else 0
         )
+        import numpy as np
+
+        pd = 4
+        try:
+            pdt = getattr(cfg, "param_dtype", None)
+            if pdt is not None:
+                pd = int(np.dtype(pdt).itemsize)
+        except Exception:
+            pd = 4
+        # Widened-optimizer recipe (see the field comment): param + grad
+        # at param dtype + fp32 m/v + fp32 master for non-fp32 params.
+        sbpp = 2.0 * pd + 8.0 + (0.0 if pd == 4 else 4.0)
         return ModelProfile(
             param_count=count,
             num_layers=getattr(cfg, "num_layers", 0),
@@ -171,6 +192,8 @@ class ModelProfile:
                 * getattr(cfg, "d_model", 0)
             ),
             expert_ffn_params=expert_ffn,
+            param_dtype_bytes=pd,
+            state_bytes_per_param=sbpp,
             flops_per_token=(
                 float(cfg.flops_per_token())
                 if hasattr(cfg, "flops_per_token") else 6.0 * count
@@ -236,10 +259,19 @@ def state_bytes_per_device(abstract_state, spec) -> int:
     through ``spec.rules()`` to mesh axes, and every sharded dim is
     ceil-divided by the product of its mesh-axis sizes — the same
     arithmetic GSPMD performs, without building a mesh or compiling.
+    ``zero`` specs first re-annotate the opt subtree exactly the way
+    ``build`` will, so the memory model prices the sharded slices.
     """
     import jax
 
-    rules = dict(spec.rules())
+    rules_seq = spec.rules()
+    if getattr(spec, "zero", False) and getattr(spec, "data", 1) > 1:
+        from dlrover_tpu.accel.zero import apply_zero
+
+        abstract_state = apply_zero(
+            abstract_state, spec, rules_seq, warn=False
+        )
+    rules = dict(rules_seq)
     sizes = _axis_sizes(spec)
 
     def leaf_bytes(leaf):
@@ -307,14 +339,28 @@ def estimate(
     dtype_b = p.dtype_bytes
 
     # --- memory ---
+    zero_shard = (
+        spec.data if getattr(spec, "zero", False) and spec.data > 1 else 1
+    )
     if abstract_state is not None:
+        # Exact walk (zero specs re-slice the opt subtree inside);
+        # transient grads are priced at the *param* dtype — a bf16 model
+        # backprops bf16 grads, not fp32 (the old 4.0 double-counted).
         state_b = float(state_bytes_per_device(abstract_state, spec))
-        # abstract state = fp32 params + opt moments; grads transient:
         param_shard = spec.fsdp * spec.tensor * spec.expert * spec.pipe
-        grad_b = 4.0 * p.param_count / param_shard
+        grad_b = float(p.param_dtype_bytes) * p.param_count / param_shard
     else:
         param_shard = spec.fsdp * spec.tensor * spec.expert * spec.pipe
-        state_b = p.state_bytes_per_param * p.param_count / param_shard
+        # Split state_bytes_per_param into the param+grad share (stays
+        # with the params) and the widened optimizer share (fp32 m/v +
+        # master) — only the latter divides by the zero degree.
+        opt_pp = max(
+            p.state_bytes_per_param - 2.0 * p.param_dtype_bytes, 0.0
+        )
+        state_b = (
+            (p.state_bytes_per_param - opt_pp) * p.param_count / param_shard
+            + opt_pp * p.param_count / (param_shard * zero_shard)
+        )
         grad_b = 0.0
     layers_dev = max(p.num_layers, 1) / spec.pipe
     act_b = (
@@ -377,6 +423,17 @@ def estimate(
         comm_ov_s += (2.0 * (pbytes_tp / spec.fsdp)
                       * (spec.data - 1) / spec.data / bw("data"))
         comm_cp_s += lat("data")
+    if zero_shard > 1:
+        # ZeRO-1 swaps the grad all-reduce for reduce-scatter + an
+        # all-gather of the updated params — the same wire volume (the
+        # overlap term above already covers it), but the gather sits at
+        # the step boundary where the backward pass can no longer hide
+        # it: price a quarter of it exposed plus one extra collective
+        # launch. This keeps replicated Adam winning ties when both
+        # fit; when it doesn't fit, the memory column decides.
+        ag = ((pbytes_tp / spec.fsdp) * (spec.data - 1) / spec.data
+              / bw("data"))
+        comm_cp_s += 0.25 * ag + lat("data")
     if spec.tensor > 1:
         # Megatron semantics: 2 activation all-reduces fwd + 2 bwd per
         # layer of [tokens, d_model]; an all-reduce moves 2x the payload
@@ -504,6 +561,13 @@ def enumerate_specs(
             continue            # microbatching needs divisibility
         out.append(ParallelSpec(data=data, fsdp=fsdp, tensor=tensor,
                                 seq=seq, expert=expert, pipe=pipe))
+    # ZeRO-1 weight-update sharding (accel/zero.py) composes with any
+    # spec that has a data axis. The estimator prices its memory cut and
+    # its exposed param all-gather, so a zero variant only wins when the
+    # replicated optimizer state is the binding constraint.
+    out += [
+        dataclasses.replace(s, zero=True) for s in out if s.data > 1
+    ]
     return out
 
 

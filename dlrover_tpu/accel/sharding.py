@@ -7,6 +7,9 @@ parallelism is just a mapping from *logical* axis names (annotated on model
 params/activations) to *mesh* axis names; GSPMD inserts the collectives:
 
 - DP:   batch -> data axis (gradient psum)
+- ZeRO-1: zero_dp -> data axis on optimizer-state dims only
+        (``accel/zero.py``; params stay replicated — weight-update
+        sharding from annotations alone)
 - FSDP: batch -> fsdp axis too; embed -> fsdp (params+opt state sharded,
         all-gathered per layer = ZeRO-3)
 - TP:   heads/mlp/vocab -> tensor axis (sharded matmuls, activation
@@ -32,6 +35,7 @@ def logical_rules(
     expert: int = 1,
     pipe: int = 1,
     vocab_size: int = 0,
+    zero: bool = False,
 ) -> List[Tuple[str, Any]]:
     """Build flax logical-axis rules for the given parallel degrees.
 
@@ -77,6 +81,14 @@ def logical_rules(
         ("expert", "expert" if expert > 1 else None),
         ("stage", "pipe" if pipe > 1 else None),
     ]
+    if zero and data > 1:
+        # ZeRO-1 weight-update sharding (accel/zero.py): optimizer-state
+        # dims relabeled to this axis shard over the data replicas while
+        # the params they update stay replicated — GSPMD turns the pair
+        # into reduce-scatter(grads) / sliced update / all-gather(params).
+        from dlrover_tpu.accel.zero import ZERO_AXIS
+
+        rules.append((ZERO_AXIS, "data"))
     return rules
 
 

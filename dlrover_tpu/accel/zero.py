@@ -1,0 +1,149 @@
+"""Compile-level ZeRO-1: weight-update sharding as sharding annotations.
+
+Parity: the reference reaches ZeRO through ATorch's optimizer shims
+(fairscale ``zero_optimization.py:115-240`` — a wrapper that partitions
+the optimizer, reduce-scatters gradients, and all-gathers updated params
+by hand). On TPU none of that machinery is needed: following SimpleFSDP
+(arxiv 2411.00284) the *entire* transform is metadata. Re-annotate the
+optimizer-state leaves of the abstract train state so each one carries a
+``zero_dp`` logical axis on a dim the spec leaves unsharded, map that
+axis to the ``data`` mesh axis in the sharding rules, and hand the
+result to the same jitted train step everyone else uses. XLA's SPMD
+partitioner sees replicated params, data-sharded optimizer state, and a
+gradient that feeds both — and schedules the reduce-scatter / slice
+update / updated-param all-gather of ZeRO-1 (arxiv 2004.13336) on its
+own. The optimizer's ``update`` function is never touched; shapes,
+dtypes and values are identical — only ``.names`` metadata changes
+(asserted by ``tests/test_zero.py``).
+
+What gets sharded: everything ``optimizer.init`` produced — Adam m/v,
+the fp32 master copies of ``optim/bf16.py``'s ``bf16_master_weights``,
+AGD's ``exp_avg``/``exp_avg_sq``/``max_exp_avg_sq``. Scalar leaves
+(optax step counts) and leaves with no dim divisible by the data degree
+stay replicated; they are bytes-irrelevant.
+
+The checkpoint engine already stages sharded leaves block-per-shard and
+persists only replica-0 copies, so under multi-process ZeRO each replica
+persists only its owned optimizer slice (~Ndp× less per rank); the saved
+degree is stamped into ``ShardMeta.zero_degree`` so a cross-degree
+restore that cannot be re-sliced fails naming both degrees. See
+``docs/zero.md``.
+"""
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.common.log import logger
+
+# Logical axis name carried by zero-sharded optimizer-state dims; mapped
+# to the "data" mesh axis by sharding.logical_rules(zero=True).
+ZERO_AXIS = "zero_dp"
+
+
+def zero_degree_of(spec) -> int:
+    """Data-axis degree the optimizer state is ZeRO-sharded over under
+    ``spec`` (0 when the spec doesn't shard weight updates)."""
+    if getattr(spec, "zero", False) and getattr(spec, "data", 1) > 1:
+        return spec.data
+    return 0
+
+
+def _is_box(x) -> bool:
+    return hasattr(x, "names") and hasattr(x, "value")
+
+
+def _resolved_axes(name, rules: Dict[str, Any]):
+    """Mesh axes a logical dim name maps to under the spec's rules."""
+    if not name:
+        return ()
+    axes = rules.get(name)
+    if axes is None:
+        return ()
+    if isinstance(axes, str):
+        return (axes,)
+    return tuple(axes)
+
+
+def shard_optimizer_state(
+    abstract_opt,
+    data: int,
+    rules: Sequence[Tuple[str, Any]],
+    axis_name: str = ZERO_AXIS,
+):
+    """Re-annotate optimizer-state leaves with a data-axis sharding.
+
+    For every boxed leaf (``nn.Partitioned`` / ``nn.LogicallyPartitioned``
+    — optax ``init`` tree-maps over boxed params, so opt state mirrors
+    the params' boxes) pick the largest dim that (a) resolves to no mesh
+    axis under ``rules`` — dims the spec already shards over fsdp/tensor
+    stay put, ZeRO composes with them — and (b) is divisible by ``data``,
+    and rename it to ``axis_name``. Leaves with no eligible dim (scalars,
+    odd shapes) are returned unchanged, i.e. replicated.
+
+    Pure metadata: shapes, dtypes, values and the optimizer ``update``
+    fn are untouched; GSPMD derives the ZeRO-1 collectives from the
+    resulting jit in/out shardings alone.
+    """
+    import jax
+
+    if data <= 1:
+        return abstract_opt
+    rd = dict(rules)
+
+    def relabel(leaf):
+        if not _is_box(leaf):
+            return leaf
+        names = tuple(leaf.names)
+        shape = getattr(leaf.value, "shape", ())
+        if len(names) != len(shape):
+            return leaf
+        best: Optional[int] = None
+        for i, dim in enumerate(shape):
+            if _resolved_axes(names[i], rd):
+                continue                     # already mesh-sharded
+            if dim < data or dim % data:
+                continue                     # uneven slice: keep replicated
+            if best is None or dim > shape[best]:
+                best = i
+        if best is None:
+            return leaf
+        new_names = names[:best] + (axis_name,) + names[best + 1:]
+        return type(leaf)(value=leaf.value, names=new_names)
+
+    return jax.tree_util.tree_map(relabel, abstract_opt, is_leaf=_is_box)
+
+
+def zero_sharded_paths(opt_tree, axis_name: str = ZERO_AXIS) -> List[str]:
+    """Key paths of opt-state leaves carrying the zero axis (for tests,
+    bench, and the engine's shard accounting)."""
+    import jax
+
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        opt_tree, is_leaf=_is_box
+    )[0]:
+        if _is_box(leaf) and axis_name in tuple(leaf.names):
+            out.append(jax.tree_util.keystr(path))
+    return out
+
+
+def apply_zero(abstract_state, spec, rules, warn: bool = True):
+    """Apply the ZeRO-1 transform to a full abstract train state for
+    ``spec`` (no-op unless ``spec.zero`` with a real data axis). Returns
+    a shallow-copied state dict with the ``opt`` subtree re-annotated."""
+    degree = zero_degree_of(spec)
+    if not degree or not isinstance(abstract_state, dict):
+        return abstract_state
+    opt = abstract_state.get("opt")
+    if opt is None:
+        return abstract_state
+    sharded = shard_optimizer_state(opt, degree, rules)
+    n = len(zero_sharded_paths(sharded))
+    if not n and warn:
+        logger.warning(
+            "zero=True but no optimizer-state leaf could be sharded over "
+            "data=%s (no boxed leaf has an unsharded dim divisible by the "
+            "degree) — optimizer state stays replicated", degree,
+        )
+    out = dict(abstract_state)
+    out["opt"] = sharded
+    return out

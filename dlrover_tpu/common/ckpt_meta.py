@@ -120,6 +120,11 @@ class ShardMeta:
     # resolve to the class defaults.
     stripes: Optional[List[StripeMeta]] = None
     stripe_bytes: int = 0
+    # ZeRO-1 weight-update sharding degree the optimizer state was saved
+    # under (``accel/zero.py``; 0 = opt state replicated). Restore uses it
+    # to name both degrees when a cross-degree re-slice can't cover the
+    # requested template. Read via getattr — old pickles lack the field.
+    zero_degree: int = 0
 
 
 @dataclass

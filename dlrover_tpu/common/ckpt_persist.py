@@ -60,6 +60,37 @@ class StepCorruptionError(Exception):
         self.reason = reason
 
 
+class ZeroDegreeMismatchError(Exception):
+    """A ZeRO-sharded checkpoint can't be re-sliced for the restoring spec.
+
+    Deliberately *not* a :class:`StepCorruptionError`: the step on disk is
+    intact — it just belongs to a different weight-update sharding degree
+    (``accel/zero.py``) and the persisted slices don't tile the requested
+    template. Letting the restore fallback chain treat it as corruption
+    would silently fall through to an older step (or a fresh init), which
+    is exactly the wrong-slice load the guardrail exists to prevent — so
+    this propagates to the caller, naming both degrees."""
+
+    def __init__(self, step: int, saved_degree: int, restore_degree: int,
+                 detail: str = ""):
+        msg = (
+            f"checkpoint step {step} was saved with zero_degree="
+            f"{saved_degree} but is being restored with zero_degree="
+            f"{restore_degree}, and the persisted optimizer-state slices "
+            "do not cover the requested template"
+        )
+        if detail:
+            msg += f" ({detail})"
+        msg += (
+            "; restore with the original parallel spec or re-save under "
+            "the new degree"
+        )
+        super().__init__(msg)
+        self.step = step
+        self.saved_degree = saved_degree
+        self.restore_degree = restore_degree
+
+
 def step_dir(ckpt_dir: str, step: int) -> str:
     return os.path.join(ckpt_dir, f"{CheckpointConstant.STEP_DIR_PREFIX}{step}")
 
@@ -195,11 +226,17 @@ def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
     prefix = os.path.join(d, f"{CheckpointConstant.SHARD_FILE_PREFIX}{gid}")
     pairs: List[Tuple[TensorMeta, memoryview]] = []
     offset = 0
+    opt_bytes = 0
     for t in meta.tensors:
         if not t.persist:
             continue
         pairs.append((t, buf[t.offset:t.offset + t.nbytes]))
         offset += t.nbytes
+        # Optimizer-state share of this shard's persist volume — the
+        # number ZeRO-1 shrinks ~Ndp× (state paths are keystr paths into
+        # the train-state dict, so opt leaves start with ['opt']).
+        if t.path.startswith("['opt']"):
+            opt_bytes += t.nbytes
 
     stripe_bytes = stripe_bytes_config()
     t0 = time.perf_counter()
@@ -241,6 +278,7 @@ def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
     )
     stats = {
         "bytes": float(offset),
+        "opt_bytes": float(opt_bytes),
         "persist_s": persist_s,
         "persist_mbps": (offset / persist_s / 1e6) if persist_s > 0 else 0.0,
         "checksum_s": checksum_s,
@@ -253,6 +291,8 @@ def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
             EventKind.CKPT_IO, op="persist", step=meta.step, shard=gid,
             bytes=offset, mbps=round(stats["persist_mbps"], 1),
             checksum_s=round(checksum_s, 4), striped=bool(stripe_bytes),
+            opt_bytes=opt_bytes,
+            zero_degree=getattr(meta, "zero_degree", 0),
         )
     except Exception:  # observability must never fail a persist
         pass

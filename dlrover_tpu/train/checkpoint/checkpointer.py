@@ -74,7 +74,8 @@ class FlashCheckpointer(Checkpointer):
 
     def __init__(self, checkpoint_dir: str,
                  storage: Optional[CheckpointStorage] = None,
-                 keep_latest: int = 3):
+                 keep_latest: int = 3,
+                 zero_degree: int = 0):
         rank = int(os.getenv(NodeEnv.PROCESS_ID, "0"))
         super().__init__(
             CheckpointEngine(
@@ -84,6 +85,7 @@ class FlashCheckpointer(Checkpointer):
                 persist_shard=rank == 0,
                 storage=storage,
                 keep_latest=keep_latest,
+                zero_degree=zero_degree,
             )
         )
 
@@ -102,7 +104,8 @@ class ShardedCheckpointer(Checkpointer):
 
     def __init__(self, checkpoint_dir: str,
                  storage: Optional[CheckpointStorage] = None,
-                 keep_latest: int = 3):
+                 keep_latest: int = 3,
+                 zero_degree: int = 0):
         rank = int(os.getenv(NodeEnv.PROCESS_ID, "0"))
         world = int(os.getenv(NodeEnv.NUM_PROCESSES, "1"))
         super().__init__(
@@ -113,5 +116,6 @@ class ShardedCheckpointer(Checkpointer):
                 persist_shard=True,
                 storage=storage,
                 keep_latest=keep_latest,
+                zero_degree=zero_degree,
             )
         )

@@ -142,6 +142,7 @@ class CheckpointEngine:
         storage: Optional[CheckpointStorage] = None,
         keep_latest: int = 3,
         job: str = "",
+        zero_degree: int = 0,
     ):
         # Warm the copy engine off the critical path: the first snapshot
         # must not stall behind a toolchain build or calibration.
@@ -152,6 +153,10 @@ class CheckpointEngine:
         # Every process stages to its own shm (so memory restore is local);
         # only processes with persist_shard=True own a disk shard.
         self.persist_shard = persist_shard
+        # ZeRO-1 degree the optimizer state is sharded over (0 = replicated).
+        # Stamped into every ShardMeta so restore can name both degrees when
+        # a checkpoint saved under a different data degree can't be re-sliced.
+        self.zero_degree = int(zero_degree)
         self.storage = get_checkpoint_storage(storage)
         self.keep_latest = keep_latest
         self._job = job or os.getenv(NodeEnv.JOB_NAME, "local-job")
@@ -518,6 +523,7 @@ class CheckpointEngine:
                     global_shard_num=self.global_shard_num,
                     persist=self.persist_shard,
                     layout_version=self._layout_version,
+                    zero_degree=self.zero_degree,
                 )
                 self._publish_meta(shard_meta)
                 self._cached_step = step
@@ -815,7 +821,24 @@ class CheckpointEngine:
                     catalog.setdefault(t.path, []).append(
                         (t, self._storage_reader(step, gid, t, algo, reader))
                     )
-            state = self._rebuild(template, catalog, objects)
+            try:
+                state = self._rebuild(template, catalog, objects)
+            except KeyError as e:
+                saved_zero = max(
+                    (getattr(m, "zero_degree", 0) for m in metas.values()),
+                    default=0,
+                )
+                if "cover" in str(e) and saved_zero != self.zero_degree:
+                    # The persisted blocks don't tile the requested leaf and
+                    # the ZeRO degrees disagree: optimizer slices saved under
+                    # one data degree are being restored under another. This
+                    # error is NOT StepCorruptionError on purpose — the
+                    # fallback chain must not skip to an older step and load
+                    # a wrong slice silently; it propagates to the caller.
+                    raise ckpt_persist.ZeroDegreeMismatchError(
+                        step, saved_zero, self.zero_degree, str(e)
+                    ) from e
+                raise
         finally:
             for r in readers:
                 try:

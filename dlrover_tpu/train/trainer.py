@@ -147,7 +147,15 @@ class Trainer:
                 ShardedCheckpointer if jax.process_count() > 1
                 else FlashCheckpointer
             )
-            self._ckpt = cls(checkpoint_dir)
+            from dlrover_tpu.accel.zero import zero_degree_of
+
+            # Stamp the ZeRO degree into every ShardMeta so a restore
+            # under a different data degree fails naming both degrees
+            # instead of loading a wrong optimizer slice.
+            self._ckpt = cls(
+                checkpoint_dir,
+                zero_degree=zero_degree_of(self._result.spec),
+            )
         self._client = None
         if report_metrics and os.getenv("DLROVER_TPU_MASTER_ADDR"):
             from dlrover_tpu.agent.master_client import MasterClient

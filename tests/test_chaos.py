@@ -51,6 +51,7 @@ from tests.conftest import cpu_subprocess_env
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "examples", "train_tiny.py")
+ZERO_SCRIPT = os.path.join(REPO, "examples", "train_zero.py")
 
 
 @pytest.fixture(autouse=True)
@@ -857,6 +858,73 @@ class TestEndToEndDrills:
         self._kill_drill(tmp_path, "b", j2)
         assert open(j1).read() == open(j2).read(), (
             "fault schedule was not reproducible for the same seed"
+        )
+
+    def _zero_drill(self, tmp_path, tag, kill: bool):
+        """Run examples/train_zero.py (tiny GPT under ZeRO-1 on the
+        8-device CPU mesh) under the agent; optionally kill the worker
+        mid-run. Returns the final param bytes + the checkpoint dir."""
+        job = f"chaos-{uuid.uuid4().hex[:6]}"
+        ckpt_dir = str(tmp_path / f"zckpts-{tag}")
+        marker = str(tmp_path / f"zresumed-{tag}.txt")
+        final = str(tmp_path / f"zfinal-{tag}.bin")
+        extra_env = None
+        if kill:
+            # auto_accelerate + compile put ~10 s of startup before the
+            # first snapshot; at=60 (~12 s of 0.2 s polls) lands inside
+            # the 14 x ~0.55 s stepping window that follows.
+            plan = FaultPlan(seed=13, events=[
+                FaultEvent(site="agent.monitor", kind="kill", at=60,
+                           args={"rank": 0}),
+            ])
+            extra_env = {CHAOS_ENV: plan.to_json()}
+        result = _run_cli(
+            [
+                "--standalone", "--nproc_per_node=1", f"--job_name={job}",
+                "--monitor_interval=0.2", "--max_restarts=2",
+                ZERO_SCRIPT, "--",
+                "--steps", "14", "--step-sleep", "0.5",
+                "--ckpt-dir", ckpt_dir, "--persist-every", "50",
+                "--resume-marker", marker, "--final-state", final,
+            ],
+            extra_env=extra_env, timeout=420,
+        )
+        assert result.returncode == 0, result.stderr[-3000:]
+        if kill:
+            assert os.path.exists(marker), (
+                "worker was never killed + resumed under ZeRO-1:\n"
+                + result.stderr[-2000:]
+            )
+        return open(final, "rb").read(), ckpt_dir
+
+    def test_zero1_worker_kill_resumes_bit_identical(self, tmp_path):
+        """ISSUE 6 drill: kill a worker mid-step while the optimizer
+        state lives ZeRO-1-sliced over the data axis; the flushed sliced
+        checkpoint must resume to final weights bit-identical to an
+        uninterrupted run, and the persisted meta must carry the sliced
+        opt blocks + the zero_degree stamp."""
+        final_killed, ckpt_dir = self._zero_drill(tmp_path, "a", kill=True)
+        final_ref, _ = self._zero_drill(tmp_path, "ref", kill=False)
+        assert final_killed == final_ref, (
+            "ZeRO-1 crash+resume diverged from the uninterrupted run"
+        )
+        # The flushed checkpoint is genuinely sliced: opt leaves staged
+        # block-per-shard, stamped with the saved degree.
+        steps = ckpt_persist.list_steps(
+            get_checkpoint_storage(None), ckpt_dir
+        )
+        assert steps, "kill drill left no flushed checkpoint"
+        metas = ckpt_persist.load_step_metas(
+            get_checkpoint_storage(None), ckpt_dir, steps[-1]
+        )
+        assert metas
+        sliced_opt = [
+            t for m in metas.values() for t in m.tensors
+            if t.path.startswith("['opt']") and t.index is not None
+        ]
+        assert sliced_opt, "no sliced optimizer blocks in the checkpoint"
+        assert all(
+            getattr(m, "zero_degree", 0) == 8 for m in metas.values()
         )
 
     @staticmethod

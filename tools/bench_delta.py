@@ -34,13 +34,17 @@ REGRESSION_PCT = 5.0
 #: but the table stays readable by showing only the load-bearing rows.
 _INTERESTING = re.compile(
     r"(tokens_per_s|goodput_.*_pct|mbps|speedup|mfu_pct|step_time_ms"
-    r"|_save_s|restore_ms|overhead|wall_.*_s|blocking_save)", re.I,
+    r"|_save_s|restore_ms|overhead|wall_.*_s|blocking_save"
+    r"|_gb$|_bytes|_cut_x)", re.I,
 )
 
-#: Lower-is-better keys: latencies, wall clocks, overheads.
-#: (``(?<!per)_s`` keeps rate keys like ``tokens_per_s`` out.)
+#: Lower-is-better keys: latencies, wall clocks, overheads — and memory
+#: footprints (``*_gb``/``*_bytes``: train-state, peak-HBM and the
+#: opt_shard section's per-device/persist byte metrics all want to
+#: shrink; the ``_cut_x`` ratios stay higher-is-better).
 _LOWER_BETTER = re.compile(
-    r"(_ms$|(?<!per)_s$|_s_per_gb$|wall|overhead|step_time|compile)",
+    r"(_ms$|(?<!per)_s$|_s_per_gb$|wall|overhead|step_time|compile"
+    r"|_gb$|_bytes(?!_per_s|_cut))",
     re.I,
 )
 
