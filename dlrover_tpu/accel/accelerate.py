@@ -84,7 +84,7 @@ def _device_hbm(devices) -> float:
         if stats and "bytes_limit" in stats:
             return float(stats["bytes_limit"])
     except Exception:
-        pass
+        logger.debug("device memory_stats probe failed", exc_info=True)
     return _DEFAULT_HBM
 
 

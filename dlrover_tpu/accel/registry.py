@@ -115,7 +115,7 @@ class ShardingRegistry:
                             )
                         ],
                     )
-            except Exception:
+            except Exception:  # dtlint: disable=DT001 -- layout probe: any failure means "not this optimizer layout" and the walk falls back
                 pass
             return None
 

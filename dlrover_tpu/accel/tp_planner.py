@@ -92,7 +92,7 @@ def _trace_projections(module, rng, *example_args) -> List[_ProjRecord]:
                     order=counter[0],
                 ))
                 counter[0] += 1
-        except Exception:
+        except Exception:  # dtlint: disable=DT001 -- shape probe inside the flax interceptor: failure means "site not traceable", the planner proceeds without it
             pass
         return out
 

@@ -23,11 +23,15 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.chaos.sites import ChaosSite
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.constants import (
     NodeEnv,
     NodeStatus,
@@ -93,6 +97,7 @@ class MasterRendezvousHandler:
             self._name, self._node_rank, self._local_world_size
         )
         deadline = time.monotonic() + self._timeout
+        backoff = ExponentialBackoff(initial=0.1, max_delay=1.0)
         while time.monotonic() < deadline:
             round_, _, world = self._client.get_comm_world(
                 self._name, self._node_rank
@@ -107,7 +112,7 @@ class MasterRendezvousHandler:
                 self._client.join_rendezvous(
                     self._name, self._node_rank, self._local_world_size
                 )
-            time.sleep(0.2)
+            backoff.sleep(deadline - time.monotonic())
         raise TimeoutError(
             f"rendezvous {self._name} did not complete within {self._timeout}s"
         )
@@ -118,7 +123,7 @@ class MasterRendezvousHandler:
         key = f"coordinator/{self._name}/{round_}"
         first = sorted(world)[0]
         if self._node_rank == first:
-            host = os.getenv("DLROVER_TPU_HOST_IP", "127.0.0.1")
+            host = env_utils.HOST_IP.get()
             addr = f"{host}:{find_free_port()}"
             self._client.kv_store_set(key, addr.encode())
             return addr
@@ -142,7 +147,7 @@ class ElasticTrainingAgent:
         self._workers: List[subprocess.Popen] = []
         self._restart_count = 0
         self._ckpt_saver = None  # wired by start_saver()
-        self._stopped = False
+        self._stopped = threading.Event()
 
     # ---------------- checkpoint saver hook ----------------
     def start_saver(self):
@@ -313,7 +318,7 @@ class ElasticTrainingAgent:
                 NodeEnv.RESTART_COUNT: str(self._restart_count),
                 # Restart-latency attribution: workers measure their
                 # spawn->entry phase against this stamp.
-                "DLROVER_TPU_SPAWN_TS": repr(time.time()),
+                env_utils.SPAWN_TS.name: repr(time.time()),
             }
         )
         # One persistent compile cache per job: every incarnation of
@@ -322,7 +327,7 @@ class ElasticTrainingAgent:
         from dlrover_tpu.common.env_utils import default_compile_cache_dir
 
         env.setdefault(
-            "DLROVER_TPU_COMPILE_CACHE",
+            env_utils.COMPILE_CACHE.name,
             default_compile_cache_dir(self._config.job_name),
         )
         return env
@@ -402,7 +407,7 @@ class ElasticTrainingAgent:
         flagged by the master's hang detection."""
         from dlrover_tpu.chaos.injector import fault_hit
 
-        event = fault_hit("agent.monitor")
+        event = fault_hit(ChaosSite.AGENT_MONITOR)
         if event is None:
             return
         local_rank = int(event.args.get("rank", 0))
@@ -429,8 +434,6 @@ class ElasticTrainingAgent:
             os.killpg(pgid, signal.SIGSTOP)
             resume_after = float(event.args.get("resume_after_s", 0))
             if resume_after > 0:
-                import threading
-
                 def _resume():
                     try:
                         os.killpg(pgid, signal.SIGCONT)
@@ -440,8 +443,11 @@ class ElasticTrainingAgent:
                 threading.Timer(resume_after, _resume).start()
 
     def _monitor_workers(self, outcome: RendezvousOutcome) -> str:
-        while not self._stopped:
-            time.sleep(self._config.monitor_interval)
+        while not self._stopped.is_set():
+            # Interruptible: stop() wakes the monitor immediately
+            # instead of leaving it asleep for a full poll interval.
+            if self._stopped.wait(self._config.monitor_interval):
+                break
             self._chaos_hit_workers()
             codes = [p.poll() for p in self._workers]
             if any(c is not None and c != 0 for c in codes):
@@ -505,7 +511,7 @@ class ElasticTrainingAgent:
         self._workers = []
 
     def stop(self):
-        self._stopped = True
+        self._stopped.set()
         for attr in ("_heartbeat_task", "_resource_monitor",
                      "_training_monitor", "_config_tuner"):
             task = getattr(self, attr, None)

@@ -21,7 +21,8 @@ import threading
 import time
 from typing import Dict, Optional
 
-from dlrover_tpu.common import ckpt_persist
+from dlrover_tpu.common import ckpt_persist, env_utils
+from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.ckpt_meta import (
     SaveEvent,
     SaverRegistration,
@@ -49,7 +50,7 @@ class CommonDirCheckpointSaver:
     """
 
     def __init__(self, reg: SaverRegistration, job: str = ""):
-        self._job = job or os.getenv("DLROVER_TPU_JOB_NAME", "local-job")
+        self._job = job or env_utils.JOB_NAME.get()
         self._node_rank = reg.node_rank
         self.checkpoint_dir = reg.checkpoint_dir
         self.local_shard_num = reg.local_shard_num
@@ -102,6 +103,7 @@ class CommonDirCheckpointSaver:
 
     # ------------- persist machinery -------------
     def _persist_loop(self):
+        backoff = ExponentialBackoff(initial=0.5, max_delay=5.0)
         while not self._stopped:
             try:
                 event: SaveEvent = self._events.get(block=True, timeout=5.0)
@@ -111,8 +113,9 @@ class CommonDirCheckpointSaver:
                 if self._stopped:
                     return
                 logger.exception("checkpoint event queue failure")
-                time.sleep(1.0)
+                backoff.sleep()
                 continue
+            backoff.reset()
             if event.kind == "stop":
                 return
             try:
@@ -265,13 +268,14 @@ class CommonDirCheckpointSaver:
         """Give laggard local ranks a moment to finish their memory copy of
         `step` before declaring them stale."""
         deadline = time.monotonic() + timeout
+        backoff = ExponentialBackoff(initial=0.05, max_delay=0.5)
         while True:
             metas = self._local_metas()
             if metas and all(m.step >= step for m in metas.values()):
                 return metas
             if time.monotonic() >= deadline:
                 return metas
-            time.sleep(0.2)
+            backoff.sleep(deadline - time.monotonic())
 
     def _finish_step(self, step: int, commit_timeout: float):
         if self.is_committer:
@@ -335,7 +339,7 @@ class CommonDirCheckpointSaver:
         self._stopped = True
         try:
             self._events.put(SaveEvent(kind="stop"), timeout=1.0)
-        except Exception:
+        except Exception:  # dtlint: disable=DT001 -- shutdown: the IPC queue may already be closed or full; stop() must not raise
             pass
         self._persist_thread.join(timeout=5.0)
         self._meta.close()
@@ -373,6 +377,7 @@ class AsyncCheckpointSaver:
 
     @classmethod
     def _factory_loop(cls):
+        backoff = ExponentialBackoff(initial=0.5, max_delay=5.0)
         while not cls._stopped:
             try:
                 reg: SaverRegistration = cls._factory.get(
@@ -383,8 +388,9 @@ class AsyncCheckpointSaver:
             except Exception:
                 if cls._stopped:
                     return
-                time.sleep(1.0)
+                backoff.sleep()
                 continue
+            backoff.reset()
             with cls._lock:
                 if cls._stopped:
                     # stop() won the lock between our dequeue and here; do

@@ -23,21 +23,19 @@ import tempfile
 import time
 from typing import Dict, Tuple
 
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.constants import NodeEnv, RendezvousName
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import find_free_port
 
-# How long a single exercise process may run before we call the node (or
-# its partner) faulty. Tests shrink this via the environment.
-_EXERCISE_TIMEOUT_ENV = "DLROVER_TPU_CHECK_EXERCISE_TIMEOUT"
 _MAX_CHECK_ROUNDS = 3
 
 
 def _exercise_timeout() -> float:
-    try:
-        return float(os.getenv(_EXERCISE_TIMEOUT_ENV, "60"))
-    except ValueError:
-        return 60.0
+    # How long a single exercise process may run before we call the node
+    # (or its partner) faulty. Tests shrink this via the environment.
+    return env_utils.CHECK_EXERCISE_TIMEOUT.get()
 
 
 def _setup_group_coordinator(client, round_: int, group: int,
@@ -47,7 +45,7 @@ def _setup_group_coordinator(client, round_: int, group: int,
     key = f"devcheck/{round_}/{group}"
     first = sorted(world)[0]
     if node_rank == first:
-        host = os.getenv("DLROVER_TPU_HOST_IP", "127.0.0.1")
+        host = env_utils.HOST_IP.get()
         addr = f"{host}:{find_free_port()}"
         client.kv_store_set(key, addr.encode())
         return addr
@@ -75,7 +73,7 @@ def _run_exercise(config, client, round_: int, group: int,
         NodeEnv.COORDINATOR_ADDR: coordinator,
         NodeEnv.PROCESS_ID: str(members.index(node_rank)),
         NodeEnv.NUM_PROCESSES: str(len(members)),
-        "DLROVER_TPU_CHECK_RESULT_PATH": result_path,
+        env_utils.CHECK_RESULT_PATH.name: result_path,
     })
     cmd = [sys.executable, "-m", "dlrover_tpu.agent.run_device_check"]
     start = time.monotonic()
@@ -95,14 +93,16 @@ def _run_exercise(config, client, round_: int, group: int,
         logger.error("device-check exercise timed out after %ss", timeout)
         normal = False
     elapsed = time.monotonic() - start
-    if normal and os.path.exists(result_path):
+    if normal:
         try:
             with open(result_path) as f:
                 elapsed = float(f.read().strip())
         except (ValueError, OSError):
-            pass
-    if os.path.exists(result_path):
+            pass  # no/garbled result file: fall back to wall time
+    try:
         os.unlink(result_path)
+    except FileNotFoundError:
+        pass
     return normal, elapsed
 
 
@@ -120,13 +120,14 @@ def run_device_check(config, client) -> bool:
         # Wait for the master to freeze the round and hand us a group.
         deadline = time.monotonic() + config.rdzv_timeout
         world: Dict[int, int] = {}
+        backoff = ExponentialBackoff(initial=0.1, max_delay=1.0)
         while time.monotonic() < deadline:
             round_, group, world = client.get_comm_world(
                 RendezvousName.DEVICE_CHECK, node_rank
             )
             if world and node_rank in world:
                 break
-            time.sleep(0.2)
+            backoff.sleep(deadline - time.monotonic())
         if not world:
             logger.warning("device check round never formed; skipping check")
             return True
@@ -143,6 +144,7 @@ def run_device_check(config, client) -> bool:
         # reported -> another round; otherwise keep waiting for reports.
         poll_deadline = time.monotonic() + _exercise_timeout() + 60.0
         need_new_round = False
+        backoff = ExponentialBackoff(initial=0.1, max_delay=1.0)
         while time.monotonic() < poll_deadline:
             fault_nodes, done, completed = client.get_fault_nodes()
             if done:
@@ -168,7 +170,7 @@ def run_device_check(config, client) -> bool:
             if fault_nodes and completed >= round_:
                 need_new_round = True
                 break
-            time.sleep(0.3)
+            backoff.sleep(poll_deadline - time.monotonic())
         if not need_new_round:
             logger.warning("device-check diagnosis timed out; proceeding")
             return True

@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.log import logger
 
 _LEN = struct.Struct(">I")
@@ -91,7 +93,7 @@ def _child_main(req: Dict):
         import dlrover_tpu.train as _t
 
         _t._ENTRY_TS = time.time()
-    except Exception:
+    except Exception:  # dtlint: disable=DT001 -- forked worker boot must never die on a metrics stamp
         pass
     import runpy
 
@@ -208,12 +210,15 @@ class ForkedWorker:
         deadline = None if timeout is None else (
             time.monotonic() + timeout
         )
+        backoff = ExponentialBackoff(initial=0.01, max_delay=0.2)
         while self.poll() is None:
             if deadline is not None and time.monotonic() > deadline:
                 raise subprocess.TimeoutExpired(
                     f"forked-worker-{self.pid}", timeout
                 )
-            time.sleep(0.02)
+            backoff.sleep(
+                None if deadline is None else deadline - time.monotonic()
+            )
         return self.returncode
 
 
@@ -229,9 +234,7 @@ class ForkServer:
 
     @staticmethod
     def enabled() -> bool:
-        return os.getenv("DLROVER_TPU_FORKSERVER", "1") not in (
-            "0", "false", "off",
-        )
+        return env_utils.FORKSERVER.get()
 
     def start(self, timeout: float = 120.0):
         import select
@@ -293,12 +296,13 @@ class ForkServer:
         # spawn() concurrently would otherwise each pop whichever reply
         # landed first and hand back the OTHER spawn's pid.
         deadline = time.monotonic() + timeout
+        backoff = ExponentialBackoff(initial=0.002, max_delay=0.05)
         while time.monotonic() < deadline:
             with self._lock:
                 for i, msg in enumerate(self._pending):
                     if msg.get("token") == token:
                         return self._pending.pop(i)
-            time.sleep(0.005)
+            backoff.sleep(deadline - time.monotonic())
         raise TimeoutError("fork server did not answer")
 
     def spawn(self, entrypoint: str, args: List[str], env: Dict[str, str],

@@ -13,6 +13,7 @@ from typing import Dict, Optional, Tuple
 from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.constants import NodeEnv, NodeStatus
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RpcClient
 
@@ -32,7 +33,7 @@ class MasterClient:
         # new master incarnation so records it holds are neither
         # re-dispatched to someone else nor dropped.
         self._inflight_tasks: Dict[Tuple[str, int], m.ShardTask] = {}
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = instrumented_lock("master_client.inflight")
         self.fenced_count = 0
 
     # ---------------- singleton wiring ----------------

@@ -115,11 +115,13 @@ class TrainingMonitor:
         self._task.stop()
 
     def report_once(self):
-        if not os.path.exists(self._path):
+        try:
+            f = open(self._path)
+        except FileNotFoundError:
             return
-        if os.path.getsize(self._path) < self._offset:
-            self._offset = 0  # file was rotated: re-tail from the start
-        with open(self._path) as f:
+        with f:
+            if os.fstat(f.fileno()).st_size < self._offset:
+                self._offset = 0  # file was rotated: re-tail from the start
             f.seek(self._offset)
             lines = f.readlines()
             self._offset = f.tell()

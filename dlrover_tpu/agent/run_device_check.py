@@ -14,11 +14,13 @@ import os
 import sys
 import time
 
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.fsutil import atomic_write_text
 from dlrover_tpu.common.log import logger
 
-_MATMUL_SIZE = int(os.getenv("DLROVER_TPU_CHECK_MATMUL_SIZE", "1024"))
-_ALLGATHER_ROUNDS = int(os.getenv("DLROVER_TPU_CHECK_ALLGATHER_ROUNDS", "10"))
+_MATMUL_SIZE = env_utils.CHECK_MATMUL_SIZE.get()
+_ALLGATHER_ROUNDS = env_utils.CHECK_ALLGATHER_ROUNDS.get()
 
 
 def main() -> int:
@@ -78,13 +80,14 @@ def main() -> int:
 
     mock_straggler = os.getenv(NodeEnv.MOCK_STRAGGLER_RANK, "")
     if mock_straggler and int(mock_straggler) == node_rank:
-        time.sleep(float(os.getenv("DLROVER_TPU_MOCK_STRAGGLER_SECS", "3")))
+        time.sleep(env_utils.MOCK_STRAGGLER_SECS.get())
 
     elapsed = time.monotonic() - start
-    result_path = os.getenv("DLROVER_TPU_CHECK_RESULT_PATH", "")
+    result_path = env_utils.CHECK_RESULT_PATH.get()
     if result_path:
-        with open(result_path, "w") as f:
-            f.write(str(elapsed))
+        # Atomic: the agent polls this path and must never read a torn
+        # result as "check passed in 0s".
+        atomic_write_text(result_path, str(elapsed))
     logger.info(
         "device check ok: matmul %.4fs allgather %.4fs total %.4fs",
         matmul_time, allgather_time, elapsed,

@@ -27,12 +27,15 @@ import threading
 from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, List, Optional
 
+from dlrover_tpu.chaos.sites import validate_sites
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 
 #: Inline JSON plan, or ``@<path>`` to a JSON file. Unset => chaos off.
-CHAOS_ENV = "DLROVER_TPU_CHAOS"
+CHAOS_ENV = env_utils.CHAOS.name
 #: Optional journal: one JSON line per fired event (reproducibility).
-CHAOS_LOG_ENV = "DLROVER_TPU_CHAOS_LOG"
+CHAOS_LOG_ENV = env_utils.CHAOS_LOG.name
 
 
 @dataclass
@@ -104,13 +107,17 @@ class FaultPlan:
 
     @classmethod
     def from_env(cls) -> Optional["FaultPlan"]:
-        raw = os.getenv(CHAOS_ENV, "")
+        raw = env_utils.CHAOS.get()
         if not raw:
             return None
         if raw.startswith("@"):
             with open(raw[1:]) as f:
                 raw = f.read()
-        return cls.from_json(raw)
+        plan = cls.from_json(raw)
+        # Fail fast on a typo'd site: an event that can never match any
+        # instrumented call silently disables the drill it scripts.
+        validate_sites(e.site for e in plan.events)
+        return plan
 
 
 class FaultInjector:
@@ -126,8 +133,8 @@ class FaultInjector:
         self._by_site: Dict[str, List[FaultEvent]] = {}
         for e in plan.events:
             self._by_site.setdefault(e.site, []).append(e)
-        self._lock = threading.Lock()
-        self._log_path = os.getenv(CHAOS_LOG_ENV, "")
+        self._lock = instrumented_lock("chaos.injector")
+        self._log_path = env_utils.CHAOS_LOG.get()
 
     # ------------- singleton -------------
     @classmethod
@@ -141,7 +148,7 @@ class FaultInjector:
         inst = cls._instance
         if inst is not None:
             return inst
-        if not os.getenv(CHAOS_ENV):
+        if not env_utils.CHAOS.get():
             return None
         with cls._instance_lock:
             if cls._instance is None:
@@ -196,19 +203,17 @@ class FaultInjector:
         if fired is not None:
             # Self-report into the job timeline (outside our lock — the
             # emit path may take the master's journal lock). Lazy import:
-            # chaos must stay importable with zero dependencies.
+            # chaos must stay importable with zero dependencies, so only
+            # an import failure is absorbed; emit() itself never raises.
             try:
-                from dlrover_tpu.observability.events import (
-                    EventKind,
-                    emit,
-                )
-
+                from dlrover_tpu.observability.events import EventKind, emit
+            except ImportError:
+                pass
+            else:
                 emit(
                     EventKind.CHAOS_INJECT, site=site, kind=fired.kind,
                     detail=detail, n=n,
                 )
-            except Exception:
-                pass
         return fired
 
     def occurrences(self, site: str) -> int:

@@ -1,0 +1,62 @@
+"""The chaos-site registry: every legal fault-injection site name.
+
+The injector matches fault-plan events to instrumented call sites by
+string name. A typo on either side does not error — it silently never
+fires, and the drill reports green while injecting nothing. Two
+enforcement layers close that hole:
+
+- **statically**, dtlint DT007 requires instrumented calls to pass a
+  ``ChaosSite`` constant (or at minimum a literal that matches one);
+- **at arm time**, :meth:`~dlrover_tpu.chaos.injector.FaultInjector.get`
+  validates every plan event's site against :data:`ALL_SITES` and
+  refuses to arm an unknown one (fail fast beats a drill that tests
+  nothing).
+
+Keep this module dependency-free: it is imported by the injector, which
+must stay importable from every process with zero side effects.
+"""
+
+
+class ChaosSite:
+    """Instrumented fault-injection points (see docs/fault_tolerance.md
+    for the per-site fault matrix)."""
+
+    #: RpcClient.call, before the payload is written to the socket.
+    RPC_CLIENT_SEND = "rpc.client.send"
+    #: RpcServer connection loop, after decode, before dispatch.
+    RPC_SERVER_RECV = "rpc.server.recv"
+    #: Agent monitor tick over live worker processes (kill/hang).
+    AGENT_MONITOR = "agent.monitor"
+    #: Trainer step boundary (straggle/raise), detail = step number.
+    TRAINER_STEP = "trainer.step"
+    #: Checkpoint engine shm snapshot commit (lose), detail = shm name.
+    CKPT_SHM = "ckpt.shm"
+    #: ChaosStorage write path (corrupt/truncate/drop), detail = path.
+    STORAGE_WRITE = "storage.write"
+    #: MasterServicer.handle, before dispatch (kill/exit), detail =
+    #: request message type name.
+    MASTER_CRASH = "master.crash"
+    #: Lockdep drill marker: named acquisitions in lock-order tests.
+    LOCKDEP_ACQUIRE = "lockdep.acquire"
+    #: Reserved for unit drills of the injector mechanics themselves
+    #: (schedules, journaling): never instrumented in product code.
+    TEST_PROBE = "test.probe"
+    TEST_PROBE_B = "test.probe.b"
+
+
+ALL_SITES = frozenset(
+    value
+    for name, value in vars(ChaosSite).items()
+    if not name.startswith("_") and isinstance(value, str)
+)
+
+
+def validate_sites(sites) -> None:
+    """Raise ``ValueError`` naming every unregistered site in `sites`."""
+    unknown = sorted(set(sites) - ALL_SITES)
+    if unknown:
+        raise ValueError(
+            f"unknown chaos site(s) {unknown}; registered sites are "
+            f"{sorted(ALL_SITES)} (chaos/sites.py). A typo'd site would "
+            "silently never fire — refusing to arm."
+        )

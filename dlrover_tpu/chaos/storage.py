@@ -24,6 +24,7 @@ import time
 from typing import Optional
 
 from dlrover_tpu.chaos.injector import FaultEvent, fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.storage import CheckpointStorage, StripeWriter
 
@@ -60,7 +61,7 @@ class ChaosStorage(CheckpointStorage):
         self.inner = inner
 
     def _faulted(self, data: bytes, path: str) -> Optional[bytes]:
-        event = fault_hit("storage.write", detail=path)
+        event = fault_hit(ChaosSite.STORAGE_WRITE, detail=path)
         if event is None:
             return data
         return _mangle(data, event)

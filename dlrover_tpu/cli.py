@@ -23,6 +23,7 @@ from typing import List, Optional, Tuple
 
 from dlrover_tpu.agent.agent import ElasticLaunchConfig, launch_agent
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import logger
 
@@ -80,16 +81,19 @@ def _launch_local_master(job_name: str, node_num: int) -> Tuple[subprocess.Popen
         start_new_session=True,
     )
     deadline = time.monotonic() + 30
+    backoff = ExponentialBackoff(initial=0.02, max_delay=0.5)
     while time.monotonic() < deadline:
-        if os.path.exists(port_file):
+        try:
             with open(port_file) as f:
                 content = f.read().strip()
-            if content:
-                os.unlink(port_file)
-                return proc, f"127.0.0.1:{content}"
+        except FileNotFoundError:
+            content = ""
+        if content:
+            os.unlink(port_file)
+            return proc, f"127.0.0.1:{content}"
         if proc.poll() is not None:
             raise RuntimeError("local master exited during startup")
-        time.sleep(0.05)
+        backoff.sleep(deadline - time.monotonic())
     raise TimeoutError("local master did not report its port in 30s")
 
 
@@ -140,7 +144,7 @@ def run(args) -> int:
     try:
         client.report_job_exit(success=(code == 0))
     except Exception:
-        pass
+        logger.warning("job-exit report to master failed", exc_info=True)
     if master_proc is not None:
         try:
             master_proc.wait(timeout=10)

@@ -38,7 +38,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from dlrover_tpu.common import checksum, fastcopy
+from dlrover_tpu.common import checksum, env_utils, fastcopy
 from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.ckpt_meta import ShardMeta, StripeMeta, TensorMeta
 from dlrover_tpu.common.constants import CheckpointConstant
@@ -116,11 +116,7 @@ def stripe_bytes_config() -> int:
     (legacy per-block-CRC format, kept for A/B benchmarking and as the
     writer of old-format fixtures in tests). Clamped to >= 1 MB so a
     misconfigured env cannot explode a shard into millions of stripes."""
-    raw = os.getenv("DLROVER_TPU_CKPT_STRIPE_MB", "")
-    try:
-        mb = float(raw) if raw else float(DEFAULT_STRIPE_MB)
-    except ValueError:
-        mb = float(DEFAULT_STRIPE_MB)
+    mb = env_utils.CKPT_STRIPE_MB.get()
     if mb <= 0:
         return 0
     return max(1 << 20, int(mb * (1 << 20)))
@@ -294,7 +290,7 @@ def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
             opt_bytes=opt_bytes,
             zero_degree=getattr(meta, "zero_degree", 0),
         )
-    except Exception:  # observability must never fail a persist
+    except Exception:  # dtlint: disable=DT001 -- observability must never fail a persist
         pass
     return stats
 
@@ -597,7 +593,7 @@ def _step_shard_num(storage: CheckpointStorage, ckpt_dir: str,
                 continue
             try:
                 return int(pickle.loads(raw).global_shard_num)
-            except Exception:
+            except Exception:  # dtlint: disable=DT001 -- corrupt/foreign meta file: skip this candidate, try the next shard
                 continue
     return 0
 

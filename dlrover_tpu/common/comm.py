@@ -22,6 +22,8 @@ import time
 import uuid
 from typing import Any, Dict, Optional, Tuple
 
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.constants import CommResource
 from dlrover_tpu.common.log import logger
 
@@ -63,7 +65,7 @@ class LocalSocketComm:
 
     def __init__(self, name: str, create: bool = False, job: str = ""):
         self.name = name
-        self._job = job or os.getenv("DLROVER_TPU_JOB_NAME", "local-job")
+        self._job = job or env_utils.JOB_NAME.get()
         self._path = _sock_path(self._job, self.KIND, name)
         self._server_sock: Optional[socket.socket] = None
         self._stopped = False
@@ -125,6 +127,7 @@ class LocalSocketComm:
     def _call(self, method: str, *args, timeout: float = 60.0, **kwargs):
         deadline = time.monotonic() + timeout
         last_err: Optional[Exception] = None
+        backoff = ExponentialBackoff(initial=0.02, max_delay=0.5)
         while time.monotonic() < deadline:
             try:
                 with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
@@ -137,7 +140,7 @@ class LocalSocketComm:
                 raise RuntimeError(f"remote {self.KIND}.{method} failed: {payload}")
             except (FileNotFoundError, ConnectionError, socket.timeout) as e:
                 last_err = e
-                time.sleep(0.05)
+                backoff.sleep(deadline - time.monotonic())
         raise TimeoutError(
             f"{self.KIND} '{self.name}' unreachable at {self._path}: {last_err}"
         )
@@ -347,7 +350,7 @@ def server_exists(kind: str, name: str, job: str = "") -> bool:
     agent mode (stage to shm, agent persists asynchronously) and standalone
     mode (persist inline).
     """
-    job = job or os.getenv("DLROVER_TPU_JOB_NAME", "local-job")
+    job = job or env_utils.JOB_NAME.get()
     path = _sock_path(job, kind, name)
     if not os.path.exists(path):
         return False

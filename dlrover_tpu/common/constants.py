@@ -8,6 +8,8 @@ slice), not PS/worker GPU pods.
 
 import os
 
+from dlrover_tpu.common import env_utils as _env
+
 
 class NodeType:
     """Roles a node can play in a job."""
@@ -82,12 +84,16 @@ class PlatformType:
 
 
 class ConfigPath:
-    """Host-local runtime file contract between agent and trainers."""
+    """Host-local runtime file contract between agent and trainers.
 
-    ROOT = os.getenv("DLROVER_TPU_RUNTIME_DIR", "/tmp/dlrover_tpu")
-    ENV_RUNTIME_METRICS = "DLROVER_TPU_RUNTIME_METRICS_PATH"
+    Names come from the typed env registry (``common/env_utils.py``);
+    this class only composes the derived paths.
+    """
+
+    ROOT = _env.RUNTIME_DIR.get()
+    ENV_RUNTIME_METRICS = _env.RUNTIME_METRICS_PATH.name
     RUNTIME_METRICS = os.path.join(ROOT, "runtime_metrics.json")
-    ENV_PARAL_CONFIG = "DLROVER_TPU_PARAL_CONFIG_PATH"
+    ENV_PARAL_CONFIG = _env.PARAL_CONFIG_PATH.name
     PARAL_CONFIG = os.path.join(ROOT, "auto_paral_config.json")
 
 
@@ -111,31 +117,34 @@ class CheckpointConstant:
 
 
 class NodeEnv:
-    """Environment variables the launcher/agent sets for every process."""
+    """Environment variables the launcher/agent sets for every process.
 
-    JOB_NAME = "DLROVER_TPU_JOB_NAME"
-    MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
-    NODE_ID = "DLROVER_TPU_NODE_ID"
-    NODE_RANK = "DLROVER_TPU_NODE_RANK"
-    NODE_NUM = "DLROVER_TPU_NODE_NUM"
+    Values are the registry-declared names (``common/env_utils.py``) —
+    typed defaults and docs live there, this class is the stable
+    string-keyed view used when composing child environments.
+    """
+
+    JOB_NAME = _env.JOB_NAME.name
+    MASTER_ADDR = _env.MASTER_ADDR.name
+    NODE_ID = _env.NODE_ID.name
+    NODE_RANK = _env.NODE_RANK.name
+    NODE_NUM = _env.NODE_NUM.name
     # Worker-process contract (consumed by jax.distributed.initialize).
-    COORDINATOR_ADDR = "DLROVER_TPU_COORDINATOR_ADDR"
-    PROCESS_ID = "DLROVER_TPU_PROCESS_ID"
-    NUM_PROCESSES = "DLROVER_TPU_NUM_PROCESSES"
-    LOCAL_RANK = "DLROVER_TPU_LOCAL_RANK"
-    LOCAL_WORLD_SIZE = "DLROVER_TPU_LOCAL_WORLD_SIZE"
-    RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
+    COORDINATOR_ADDR = _env.COORDINATOR_ADDR.name
+    PROCESS_ID = _env.PROCESS_ID.name
+    NUM_PROCESSES = _env.NUM_PROCESSES.name
+    LOCAL_RANK = _env.LOCAL_RANK.name
+    LOCAL_WORLD_SIZE = _env.LOCAL_WORLD_SIZE.name
+    RESTART_COUNT = _env.RESTART_COUNT.name
     # Fault-injection knobs for tests (reference: MOCK_ERR_RANK).
-    MOCK_ERR_RANK = "DLROVER_TPU_MOCK_ERR_RANK"
-    MOCK_STRAGGLER_RANK = "DLROVER_TPU_MOCK_STRAGGLER_RANK"
+    MOCK_ERR_RANK = _env.MOCK_ERR_RANK.name
+    MOCK_STRAGGLER_RANK = _env.MOCK_STRAGGLER_RANK.name
 
 
 class CommResource:
     """Unix-socket namespace for on-host shared objects."""
 
-    SOCKET_DIR_FMT = os.path.join(
-        os.getenv("DLROVER_TPU_SOCK_DIR", "/tmp/dlrover_tpu/sock"), "{job}"
-    )
+    SOCKET_DIR_FMT = os.path.join(_env.SOCK_DIR.get(), "{job}")
 
 
 class DefaultPort:

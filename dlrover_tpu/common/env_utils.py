@@ -1,64 +1,343 @@
-"""Helpers to read the NodeEnv contract (parity: reference ``common/env_utils.py``)."""
+"""The typed env-var registry: every ``DLROVER_TPU_*`` knob, declared once.
+
+Before this registry the package had 71 scattered ``os.getenv`` reads
+across 24 files, each hand-rolling its own default and coercion — a
+typo'd name silently read the default forever, and two sites could
+disagree about what the default even was. Now:
+
+- every variable is declared here exactly once with a name, type,
+  default, and doc string;
+- every other module references the registry constant (``ENV.FOO.get()``
+  to read, ``ENV.FOO.name`` when exporting into a child environment);
+- dtlint rule **DT006** rejects any ``DLROVER_TPU_*`` string literal
+  outside this module, so an undeclared name cannot ship;
+- the table in docs/configuration.md is *generated* from these
+  declarations (``python -m tools.dtlint --env-table``) and a tier-1
+  test fails when it drifts.
+
+Reads go to ``os.environ`` at call time (not import time) — the agent
+mutates the environment for spawned workers, and tests monkeypatch
+freely.
+"""
 
 import os
+from typing import Dict, List, Optional
 
-from dlrover_tpu.common.constants import NodeEnv
+_UNSET = object()
+
+_TRUTHY = ("1", "true", "yes", "on")
 
 
-def _get_int(name: str, default: int = 0) -> int:
-    try:
-        return int(os.getenv(name, default))
-    except (TypeError, ValueError):
-        return default
+class EnvVar:
+    """One declared variable. ``get()`` returns the typed value, the
+    declared default when unset, or the caller's override default."""
+
+    __slots__ = ("name", "kind", "default", "doc")
+
+    def __init__(self, name: str, kind: str, default, doc: str):
+        self.name = name
+        self.kind = kind
+        self.default = default
+        self.doc = doc
+
+    def raw(self) -> Optional[str]:
+        return os.environ.get(self.name)
+
+    def is_set(self) -> bool:
+        return self.name in os.environ
+
+    def get(self, default=_UNSET):
+        fallback = self.default if default is _UNSET else default
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return fallback
+        if self.kind in ("str", "path"):
+            return raw
+        if self.kind == "bool":
+            return raw.strip().lower() in _TRUTHY
+        try:
+            if self.kind == "int":
+                return int(float(raw)) if "." in raw else int(raw)
+            if self.kind == "float":
+                return float(raw)
+        except (TypeError, ValueError):
+            return fallback
+        return raw  # pragma: no cover - unknown kind, declared types only
+
+    def set_in(self, env: Dict[str, str], value) -> None:
+        """Export into a child-process environment mapping."""
+        env[self.name] = str(value)
+
+    def __repr__(self):
+        return f"EnvVar({self.name}, {self.kind}, default={self.default!r})"
+
+
+class EnvRegistry:
+    def __init__(self):
+        self._vars: Dict[str, EnvVar] = {}
+
+    def _declare(self, name: str, kind: str, default, doc: str) -> EnvVar:
+        if not doc:
+            raise ValueError(f"env var {name} declared without a doc string")
+        if name in self._vars:
+            raise ValueError(f"env var {name} declared twice")
+        var = EnvVar(name, kind, default, doc)
+        self._vars[name] = var
+        return var
+
+    def str(self, name: str, default: str = "", doc: str = "") -> EnvVar:
+        return self._declare(name, "str", default, doc)
+
+    def path(self, name: str, default: str = "", doc: str = "") -> EnvVar:
+        return self._declare(name, "path", default, doc)
+
+    def int(self, name: str, default: int = 0, doc: str = "") -> EnvVar:
+        return self._declare(name, "int", default, doc)
+
+    def float(self, name: str, default: float = 0.0, doc: str = "") -> EnvVar:
+        return self._declare(name, "float", default, doc)
+
+    def bool(self, name: str, default: bool = False, doc: str = "") -> EnvVar:
+        return self._declare(name, "bool", default, doc)
+
+    def names(self) -> List[str]:
+        return sorted(self._vars)
+
+    def all(self) -> List[EnvVar]:
+        return [self._vars[n] for n in sorted(self._vars)]
+
+    def lookup(self, name: str) -> Optional[EnvVar]:
+        return self._vars.get(name)
+
+
+ENV = EnvRegistry()
+
+# ---------------- identity / launch contract ----------------
+JOB_NAME = ENV.str(
+    "DLROVER_TPU_JOB_NAME", "local-job",
+    "Job name; namespaces shm segments, unix sockets, and event identity.")
+MASTER_ADDR = ENV.str(
+    "DLROVER_TPU_MASTER_ADDR", "",
+    "host:port of the job master; empty = no master (local run).")
+NODE_ID = ENV.int(
+    "DLROVER_TPU_NODE_ID", 0,
+    "Stable node id assigned by the launcher (master-side identity).")
+NODE_RANK = ENV.int(
+    "DLROVER_TPU_NODE_RANK", 0,
+    "Rendezvous rank of this node; defaults to the node id.")
+NODE_NUM = ENV.int(
+    "DLROVER_TPU_NODE_NUM", 1,
+    "Number of nodes the job was launched with.")
+COORDINATOR_ADDR = ENV.str(
+    "DLROVER_TPU_COORDINATOR_ADDR", "",
+    "host:port of the JAX distributed coordinator, exported by the agent "
+    "for jax.distributed.initialize.")
+PROCESS_ID = ENV.int(
+    "DLROVER_TPU_PROCESS_ID", 0,
+    "This worker's process index in the JAX distributed world.")
+NUM_PROCESSES = ENV.int(
+    "DLROVER_TPU_NUM_PROCESSES", 1,
+    "Total process count in the JAX distributed world.")
+LOCAL_RANK = ENV.int(
+    "DLROVER_TPU_LOCAL_RANK", 0,
+    "Worker index on this host.")
+LOCAL_WORLD_SIZE = ENV.int(
+    "DLROVER_TPU_LOCAL_WORLD_SIZE", 1,
+    "Worker processes per host.")
+RESTART_COUNT = ENV.int(
+    "DLROVER_TPU_RESTART_COUNT", 0,
+    "How many times the agent has restarted this worker.")
+HOST_IP = ENV.str(
+    "DLROVER_TPU_HOST_IP", "127.0.0.1",
+    "Address other nodes can reach this host at (coordinator binding).")
+SPAWN_TS = ENV.float(
+    "DLROVER_TPU_SPAWN_TS", 0.0,
+    "time.time() stamped by the agent at worker spawn; startup_s in "
+    "worker boot metrics is measured from it.")
+
+# ---------------- paths / runtime files ----------------
+RUNTIME_DIR = ENV.path(
+    "DLROVER_TPU_RUNTIME_DIR", "/tmp/dlrover_tpu",
+    "Root of the host-local agent<->trainer runtime file contract.")
+RUNTIME_METRICS_PATH = ENV.path(
+    "DLROVER_TPU_RUNTIME_METRICS_PATH", "",
+    "Override for the runtime-metrics JSON the trainer drops for the "
+    "agent's config tuner.")
+PARAL_CONFIG_PATH = ENV.path(
+    "DLROVER_TPU_PARAL_CONFIG_PATH", "",
+    "Override for the auto-parallelism config JSON the tuner writes.")
+SOCK_DIR = ENV.path(
+    "DLROVER_TPU_SOCK_DIR", "/tmp/dlrover_tpu/sock",
+    "Directory for per-job unix sockets (shm coordination).")
+SHM_DIR = ENV.path(
+    "DLROVER_TPU_SHM_DIR", "/dev/shm",
+    "Backing directory for flash-checkpoint shared-memory segments.")
+COMPILE_CACHE = ENV.path(
+    "DLROVER_TPU_COMPILE_CACHE", "",
+    "Persistent XLA compile-cache dir shared by every incarnation of "
+    "every worker on a host (the restart-cheapness lever).")
+TRACE_FILE = ENV.path(
+    "DLROVER_TPU_TRACE_FILE", "",
+    "When set, the Tracer exports a Chrome trace here atomically at "
+    "exit (and on demand).")
+GOODPUT_JSON = ENV.path(
+    "DLROVER_TPU_GOODPUT_JSON", "",
+    "When set, the master writes its goodput-ledger summary JSON here "
+    "on stop.")
+LOG_LEVEL = ENV.str(
+    "DLROVER_TPU_LOG_LEVEL", "INFO",
+    "Python logging level for every process of the job.")
+
+# ---------------- master / control plane ----------------
+METRICS_PORT = ENV.int(
+    "DLROVER_TPU_METRICS_PORT", -1,
+    "Port for the master's Prometheus /metrics exporter; 0 = ephemeral, "
+    "unset = exporter off.")
+STATE_SNAPSHOT_SECS = ENV.float(
+    "DLROVER_TPU_STATE_SNAPSHOT_SECS", 30.0,
+    "Seconds between periodic master state-store snapshots (journal "
+    "rotation).")
+SHARD_TIMEOUT = ENV.float(
+    "DLROVER_TPU_SHARD_TIMEOUT", 300.0,
+    "Seconds a dispatched data shard may stay unacked before the master "
+    "reclaims it into todo.")
+HANG_DETECTION_SECS = ENV.float(
+    "DLROVER_TPU_HANG_DETECTION_SECS", 1800.0,
+    "No step progress for this long marks the job hung.")
+HEARTBEAT_TIMEOUT = ENV.float(
+    "DLROVER_TPU_HEARTBEAT_TIMEOUT", 60.0,
+    "Agent heartbeat silence after which the master declares the node "
+    "dead.")
+NODE_MONITOR_INTERVAL = ENV.float(
+    "DLROVER_TPU_NODE_MONITOR_INTERVAL", 2.0,
+    "Master-side node-liveness sweep interval.")
+DEVICE_CHECK_TIMEOUT = ENV.float(
+    "DLROVER_TPU_DEVICE_CHECK_TIMEOUT", 300.0,
+    "Wall-clock budget for a whole device-check rendezvous round.")
+AUTO_PARAL = ENV.bool(
+    "DLROVER_TPU_AUTO_PARAL", False,
+    "Opt-in: master pushes tuned dataloader configs to workers.")
+
+# ---------------- worker / training ----------------
+PROGRESS_EVERY = ENV.int(
+    "DLROVER_TPU_PROGRESS_EVERY", 20,
+    "Steps between step.progress event ranges from the trainer.")
+PEAK_FLOPS = ENV.float(
+    "DLROVER_TPU_PEAK_FLOPS", 0.0,
+    "Override for the device peak FLOP/s used in MFU math when the "
+    "device kind is unknown.")
+FORKSERVER = ENV.bool(
+    "DLROVER_TPU_FORKSERVER", True,
+    "Spawn workers from the preloaded forkserver template (fast "
+    "restarts); 0/false/off disables.")
+
+# ---------------- checkpoint I/O ----------------
+CKPT_STRIPE_MB = ENV.float(
+    "DLROVER_TPU_CKPT_STRIPE_MB", 32.0,
+    "Stripe size for parallel checkpoint I/O; 0 = legacy per-block "
+    "format; clamped to >= 1 MB otherwise.")
+COPY_THREADS = ENV.int(
+    "DLROVER_TPU_COPY_THREADS", 8,
+    "Worker threads in the fastcopy pool (checksum + memcpy pipeline).")
+DISABLE_NATIVE_COPY = ENV.bool(
+    "DLROVER_TPU_DISABLE_NATIVE_COPY", False,
+    "Force the Python fallback for fastcopy even when the native op "
+    "builds.")
+DISABLE_NATIVE = ENV.bool(
+    "DLROVER_TPU_DISABLE_NATIVE", False,
+    "Turn every native op builder off (pure-Python fallbacks).")
+
+# ---------------- device check ----------------
+CHECK_RESULT_PATH = ENV.path(
+    "DLROVER_TPU_CHECK_RESULT_PATH", "",
+    "File the device-check exercise writes its result JSON to "
+    "(atomically) for the agent to read back.")
+CHECK_MATMUL_SIZE = ENV.int(
+    "DLROVER_TPU_CHECK_MATMUL_SIZE", 1024,
+    "Square matmul size exercised per chip by the device check.")
+CHECK_ALLGATHER_ROUNDS = ENV.int(
+    "DLROVER_TPU_CHECK_ALLGATHER_ROUNDS", 10,
+    "All-gather repetitions in the device-check collective exercise.")
+CHECK_EXERCISE_TIMEOUT = ENV.float(
+    "DLROVER_TPU_CHECK_EXERCISE_TIMEOUT", 60.0,
+    "Seconds one device-check exercise process may run before the node "
+    "(or its partner) is called faulty.")
+
+# ---------------- fault injection / debug ----------------
+CHAOS = ENV.str(
+    "DLROVER_TPU_CHAOS", "",
+    "Fault plan: inline JSON or @/path/to/plan.json; unset = chaos off. "
+    "Inherited by every process of the job.")
+CHAOS_LOG = ENV.path(
+    "DLROVER_TPU_CHAOS_LOG", "",
+    "Journal of fired chaos events (one JSON line each) for "
+    "reproducibility drills.")
+LOCKDEP = ENV.bool(
+    "DLROVER_TPU_LOCKDEP", False,
+    "Arm the runtime lock-order detector: instrumented locks record the "
+    "acquisition graph and fail fast on a cycle. Debug-only; plain "
+    "threading locks (zero overhead) when unset.")
+MOCK_ERR_RANK = ENV.int(
+    "DLROVER_TPU_MOCK_ERR_RANK", -1,
+    "Test knob: node rank that fails its device check.")
+MOCK_STRAGGLER_RANK = ENV.int(
+    "DLROVER_TPU_MOCK_STRAGGLER_RANK", -1,
+    "Test knob: node rank that straggles in the device check.")
+MOCK_STRAGGLER_SECS = ENV.float(
+    "DLROVER_TPU_MOCK_STRAGGLER_SECS", 3.0,
+    "Test knob: how long the mock straggler sleeps.")
+
+
+# ---------------- typed helpers (NodeEnv contract) ----------------
 
 
 def get_node_id() -> int:
-    return _get_int(NodeEnv.NODE_ID, 0)
+    return NODE_ID.get()
 
 
 def get_node_rank() -> int:
-    return _get_int(NodeEnv.NODE_RANK, get_node_id())
+    return NODE_RANK.get(default=get_node_id())
 
 
 def get_node_num() -> int:
-    return _get_int(NodeEnv.NODE_NUM, 1)
+    return NODE_NUM.get()
 
 
 def get_process_id() -> int:
-    return _get_int(NodeEnv.PROCESS_ID, 0)
+    return PROCESS_ID.get()
 
 
 def get_num_processes() -> int:
-    return _get_int(NodeEnv.NUM_PROCESSES, 1)
+    return NUM_PROCESSES.get()
 
 
 def get_local_rank() -> int:
-    return _get_int(NodeEnv.LOCAL_RANK, 0)
+    return LOCAL_RANK.get()
 
 
 def get_local_world_size() -> int:
-    return _get_int(NodeEnv.LOCAL_WORLD_SIZE, 1)
+    return LOCAL_WORLD_SIZE.get()
 
 
 def get_job_name() -> str:
-    return os.getenv(NodeEnv.JOB_NAME, "local-job")
+    return JOB_NAME.get()
 
 
 def get_master_addr() -> str:
-    return os.getenv(NodeEnv.MASTER_ADDR, "")
+    return MASTER_ADDR.get()
 
 
 def default_compile_cache_dir(job_name: str = "") -> str:
     """One persistent XLA compile-cache dir per (user, job): the agent
-    exports it (DLROVER_TPU_COMPILE_CACHE) and the worker bootstrap
-    falls back to it, so every incarnation of every worker on a host
-    shares one cache — the restart-cheapness lever. The root is
-    per-uid: compiled executables are code, and a world-shared /tmp
-    path would let another user pre-plant them."""
+    exports it (see ``COMPILE_CACHE``) and the worker bootstrap falls
+    back to it, so every incarnation of every worker on a host shares
+    one cache — the restart-cheapness lever. The root is per-uid:
+    compiled executables are code, and a world-shared /tmp path would
+    let another user pre-plant them."""
     import stat
     import tempfile
 
-    job = job_name or os.getenv(NodeEnv.JOB_NAME, "local-job")
+    job = job_name or JOB_NAME.get()
     uid = os.getuid() if hasattr(os, "getuid") else 0
     root = os.path.join("/tmp", f"dlrover_tpu_cache-{uid}")
     try:

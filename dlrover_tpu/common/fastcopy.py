@@ -22,6 +22,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.log import logger
 
 _CHUNK = 64 << 20  # 64 MB per task: large enough to amortize, small enough to balance
@@ -63,9 +64,8 @@ def _threads() -> int:
 
 def _threads_locked() -> int:
     global _THREADS
-    env = os.getenv("DLROVER_TPU_COPY_THREADS", "")
-    if env:
-        _THREADS = max(1, int(env))
+    if env_utils.COPY_THREADS.is_set():
+        _THREADS = max(1, env_utils.COPY_THREADS.get())
         return _THREADS
     lib = _native_locked()
     try:
@@ -118,7 +118,7 @@ def _native_locked():
     if _NATIVE_TRIED:
         return _NATIVE
     _NATIVE_TRIED = True
-    if os.getenv("DLROVER_TPU_DISABLE_NATIVE_COPY"):
+    if env_utils.DISABLE_NATIVE_COPY.get():
         return None
     # The general op-builder (ops/builder.py) owns build + staleness +
     # load; this module owns only the symbol signatures.
@@ -159,7 +159,7 @@ def prime(background: bool = True):
 def _pool() -> ThreadPoolExecutor:
     global _POOL
     if _POOL is None:
-        workers = int(os.getenv("DLROVER_TPU_COPY_THREADS", "8") or 8)
+        workers = env_utils.COPY_THREADS.get()
         _POOL = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="fastcopy"
         )

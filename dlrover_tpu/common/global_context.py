@@ -1,15 +1,7 @@
 """Master-side tunables singleton (parity: reference ``common/global_context.py``)."""
 
-import os
-
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.singleton import Singleton
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.getenv(name, default))
-    except ValueError:
-        return default
 
 
 class Context(Singleton):
@@ -19,30 +11,20 @@ class Context(Singleton):
         self.seconds_to_wait_failed_node = 120.0
         self.seconds_for_stable_worker_count = 60.0
         self.seconds_to_wait_pending_node = 900.0
-        self.hang_detection_seconds = _env_float(
-            "DLROVER_TPU_HANG_DETECTION_SECS", 1800.0
-        )
-        self.heartbeat_timeout = _env_float(
-            "DLROVER_TPU_HEARTBEAT_TIMEOUT", 60.0
-        )
-        self.node_monitor_interval = _env_float(
-            "DLROVER_TPU_NODE_MONITOR_INTERVAL", 2.0
-        )
+        self.hang_detection_seconds = env_utils.HANG_DETECTION_SECS.get()
+        self.heartbeat_timeout = env_utils.HEARTBEAT_TIMEOUT.get()
+        self.node_monitor_interval = env_utils.NODE_MONITOR_INTERVAL.get()
         self.relaunch_always = False
         self.max_relaunch_count = 3
         self.rdzv_waiting_timeout = 30.0
         self.rdzv_lastcall_timeout = 3.0
-        self.device_check_timeout = _env_float(
-            "DLROVER_TPU_DEVICE_CHECK_TIMEOUT", 300.0
-        )
+        self.device_check_timeout = env_utils.DEVICE_CHECK_TIMEOUT.get()
         self.straggler_time_ratio = 2.0
         self.auto_scale_enabled = False
         self.checkpoint_gc_keep = 3
         # Opt-in: let the master push tuned dataloader configs to workers
         # (reference gates auto-tuning the same way).
-        self.auto_paral_tuning = (
-            os.getenv("DLROVER_TPU_AUTO_PARAL", "") in ("1", "true", "True")
-        )
+        self.auto_paral_tuning = env_utils.AUTO_PARAL.get()
 
 
 def get_context() -> Context:

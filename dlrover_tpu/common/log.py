@@ -1,8 +1,9 @@
 """Structured default logger (parity: reference ``common/log.py``)."""
 
 import logging
-import os
 import sys
+
+from dlrover_tpu.common import env_utils
 
 _FORMAT = (
     "[%(asctime)s] [%(levelname)s] "
@@ -14,7 +15,7 @@ def _build_logger() -> logging.Logger:
     logger = logging.getLogger("dlrover_tpu")
     if logger.handlers:
         return logger
-    level = os.getenv("DLROVER_TPU_LOG_LEVEL", "INFO").upper()
+    level = env_utils.LOG_LEVEL.get().upper()
     logger.setLevel(getattr(logging, level, logging.INFO))
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(logging.Formatter(_FORMAT))

@@ -3,6 +3,14 @@
 Capability parity with the reference's ``common/grpc.py`` (~40 pickled
 dataclasses dispatched by ``servicer.py`` on message class). Every message
 carries ``node_id``/``node_type`` implicitly via the envelope below.
+
+Contract (checked statically by dtlint DT008): every ``BaseRequest``
+subclass here must have a handler in ``master/servicer.py``, and every
+request whose handler mutates durable master state declares it with a
+``journaled`` class attribute — ``True`` for write-ahead journaling,
+``"apply-then-log"`` for dispatch-style records logged after the handler
+picks the payload. The servicer's ``_JOURNALED``/``_APPLY_THEN_LOG``
+tuples must list exactly the marked classes.
 """
 
 from dataclasses import dataclass, field
@@ -100,6 +108,8 @@ class DiagnosisResult:
 
 @dataclass
 class KVStoreSet(BaseRequest):
+    journaled = True
+
     key: str = ""
     value: bytes = b""
 
@@ -111,6 +121,8 @@ class KVStoreGet(BaseRequest):
 
 @dataclass
 class KVStoreAdd(BaseRequest):
+    journaled = True
+
     key: str = ""
     amount: int = 1
 
@@ -122,6 +134,8 @@ class KVStoreMultiGet(BaseRequest):
 
 @dataclass
 class KVStoreDelete(BaseRequest):
+    journaled = True
+
     key: str = ""
 
 
@@ -130,6 +144,8 @@ class KVStoreDelete(BaseRequest):
 
 @dataclass
 class DatasetShardParams(BaseRequest):
+    journaled = True
+
     dataset_name: str = ""
     dataset_size: int = 0
     shard_size: int = 0
@@ -141,6 +157,10 @@ class DatasetShardParams(BaseRequest):
 
 @dataclass
 class TaskRequest(BaseRequest):
+    # Logged after dispatch (the record must carry the chosen shard's
+    # exact range), not write-ahead — see servicer._APPLY_THEN_LOG.
+    journaled = "apply-then-log"
+
     dataset_name: str = ""
 
 
@@ -168,6 +188,8 @@ class ShardTask:
 
 @dataclass
 class TaskReport(BaseRequest):
+    journaled = True
+
     dataset_name: str = ""
     task_id: int = -1
     success: bool = True
@@ -184,6 +206,8 @@ class TaskHoldReport(BaseRequest):
     from the carried range so the records cannot be dispatched twice or
     dropped.
     """
+
+    journaled = True
 
     dataset_name: str = ""
     task_id: int = -1
@@ -235,6 +259,8 @@ class ModelInfo(BaseRequest):
 
 @dataclass
 class NodeFailure(BaseRequest):
+    journaled = True
+
     error_data: str = ""
     level: str = "process_error"
     restart_count: int = 0
@@ -252,6 +278,8 @@ class EventReport(BaseRequest):
     Journaled + request-id-deduped like every mutating RPC, so a retried
     batch lands in the master's EventLog exactly once.
     """
+
+    journaled = True
 
     events: List = field(default_factory=list)
 
@@ -296,17 +324,26 @@ class ParallelConfig:
 
 @dataclass
 class NodeStatusReport(BaseRequest):
+    journaled = True
+
     status: str = ""
     exit_reason: str = ""
 
 
 @dataclass
 class ClusterVersionRequest(BaseRequest):
+    """Poll the master's fencing epoch (state-store incarnation).
+
+    A client that cached tasks across a master restart compares epochs
+    to decide whether it must re-register/re-report (see
+    :class:`TaskHoldReport`).
+    """
+
     version_type: str = "local"
 
 
 @dataclass
-class ClusterVersion(BaseRequest):
+class ClusterVersion:
     version_type: str = "local"
     version: int = 0
 

@@ -22,6 +22,9 @@ from collections import OrderedDict
 from typing import Any, Callable, Optional, Tuple
 
 from dlrover_tpu.chaos.injector import fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
+from dlrover_tpu.common.backoff import ExponentialBackoff
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 
 _LEN = struct.Struct(">I")
@@ -90,7 +93,7 @@ class _DedupCache:
         # pending Event is registered while the handler is executing.
         self._entries: "OrderedDict[str, Tuple[float, Any]]" = OrderedDict()
         self._pending: dict = {}
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("rpc.dedup")
         self._maxsize = maxsize
         self._ttl = ttl
 
@@ -155,7 +158,7 @@ class RpcServer:
         # stopped server that keeps answering on old connections would
         # let clients talk to a master that no longer exists logically.
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = instrumented_lock("rpc.server_conns")
 
         outer = self
 
@@ -181,11 +184,12 @@ class RpcServer:
                     else:  # bare request (tests / simple callers)
                         req_id, request = None, envelope
                     chaos = fault_hit(
-                        "rpc.server.recv", detail=type(request).__name__
+                        ChaosSite.RPC_SERVER_RECV,
+                        detail=type(request).__name__,
                     )
                     if chaos is not None:
                         if chaos.kind == "delay":
-                            time.sleep(chaos.delay_s)
+                            time.sleep(chaos.delay_s)  # dtlint: disable=DT003 -- scripted chaos delay, not a poll
                         elif chaos.kind == "drop":
                             # Request lost before execution: the client
                             # sees a dead connection and must retry.
@@ -291,7 +295,7 @@ class RpcClient:
         self._retry_deadline = retry_deadline
         self._connect_timeout = connect_timeout
         self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("rpc.client")
         # Last master incarnation observed in a response (None until an
         # incarnation-stamping server answers). A change means the
         # master restarted: the observer below is invoked once per
@@ -318,7 +322,7 @@ class RpcClient:
 
     def call(self, request: Any, timeout: Optional[float] = None) -> Any:
         envelope = (uuid.uuid4().hex, request)
-        delay = 0.1
+        backoff = ExponentialBackoff(initial=0.1, max_delay=2.0)
         reported = False
         fence = None
         while True:
@@ -335,12 +339,12 @@ class RpcClient:
                 if outage_err is None:
                     try:
                         chaos = fault_hit(
-                            "rpc.client.send",
+                            ChaosSite.RPC_CLIENT_SEND,
                             detail=type(request).__name__,
                         )
                         if chaos is not None:
                             if chaos.kind == "delay":
-                                time.sleep(chaos.delay_s)
+                                time.sleep(chaos.delay_s)  # dtlint: disable=DT002,DT003 -- scripted chaos delay: simulating a slow link must hold the client lock like a real slow send
                             elif chaos.kind in ("drop", "reset"):
                                 # Tear the connection down before the
                                 # send: flows through the normal
@@ -390,6 +394,7 @@ class RpcClient:
                 now = time.monotonic()
                 if self._down_since is None:
                     self._down_since = now
+                delay = backoff.next_delay()
                 expired = (
                     now + delay
                     > self._down_since + self._retry_deadline
@@ -405,8 +410,7 @@ class RpcClient:
                 reported = True
             # Sleep OUTSIDE the lock: other threads (heartbeat,
             # monitors) must not serialize behind this backoff.
-            time.sleep(delay)
-            delay = min(delay * 2, 2.0)
+            time.sleep(delay)  # dtlint: disable=DT003 -- delay comes from ExponentialBackoff above; backoff.sleep() would re-draw a different delay than the expiry check used
         if fence is not None and self.on_incarnation_change is not None:
             # Outside the lock: the observer re-registers over this same
             # client, which must not deadlock or serialize other threads.

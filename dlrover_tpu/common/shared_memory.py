@@ -13,7 +13,9 @@ import mmap
 import os
 from typing import Optional
 
-SHM_DIR = os.getenv("DLROVER_TPU_SHM_DIR", "/dev/shm")
+from dlrover_tpu.common import env_utils
+
+SHM_DIR = env_utils.SHM_DIR.get()
 
 
 def _path(name: str) -> str:
@@ -110,5 +112,5 @@ class SharedMemory:
     def __del__(self):  # close the map, never unlink implicitly
         try:
             self.close()
-        except Exception:
+        except Exception:  # dtlint: disable=DT001 -- __del__ can run during interpreter teardown and must never raise
             pass

@@ -6,13 +6,15 @@ reference ``master/elastic_training/kv_store_service.py`` +
 """
 
 import threading
+
+from dlrover_tpu.common.lockdep import instrumented_lock
 from typing import Dict, Optional, Tuple
 
 
 class KVStoreService:
     def __init__(self):
         self._store: Dict[str, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("master.kv_store")
 
     def set(self, key: str, value: bytes):
         with self._lock:

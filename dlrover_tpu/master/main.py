@@ -7,6 +7,7 @@ import argparse
 import os
 import sys
 
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.master import JobMaster
 
@@ -27,8 +28,8 @@ def parse_args(argv=None):
                         "previous incarnation's job state")
     parser.add_argument("--metrics_port", type=int, default=None,
                         help="serve Prometheus /metrics on this port "
-                        "(0 = ephemeral; unset = DLROVER_TPU_METRICS_PORT "
-                        "env or disabled)")
+                        "(0 = ephemeral; unset = "
+                        f"{env_utils.METRICS_PORT.name} env or disabled)")
     return parser.parse_args(argv)
 
 

@@ -11,6 +11,7 @@ import threading
 import time
 from typing import Optional
 
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.constants import JobStage, RendezvousName
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
@@ -278,8 +279,8 @@ class JobMaster:
             self.auto_scaler.start()
         port_cfg = self._metrics_port_cfg
         if port_cfg is None:
-            env = os.getenv(METRICS_PORT_ENV, "")
-            port_cfg = int(env) if env else None
+            env_port = env_utils.METRICS_PORT.get()
+            port_cfg = env_port if env_port >= 0 else None
         if port_cfg is not None and port_cfg >= 0:
             try:
                 self.metrics_port = self.observability.start_exporter(

@@ -20,6 +20,7 @@ from dlrover_tpu.common.constants import (
     NodeStatus,
     NodeType,
 )
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
 from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
@@ -71,7 +72,7 @@ class JobManager:
         heartbeat_timeout: float = 120.0,
         resource_manager=None,
     ):
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("master.node_manager")
         self._nodes: Dict[int, Node] = {}
         self._node_num = node_num
         self._max_relaunch_count = max_relaunch_count

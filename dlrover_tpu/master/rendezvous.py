@@ -21,6 +21,7 @@ import time
 from abc import ABC, abstractmethod
 from typing import Dict, List, Optional, Set, Tuple
 
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.events import EventKind, emit
 
@@ -28,7 +29,7 @@ from dlrover_tpu.observability.events import EventKind, emit
 class RendezvousManager(ABC):
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock(f"rdzv.{name}")
         self._min_nodes = 1
         self._max_nodes = 1
         self._node_unit = 1

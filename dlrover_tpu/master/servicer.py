@@ -12,6 +12,7 @@ import time
 from typing import Any, Dict
 
 from dlrover_tpu.chaos.injector import fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
 from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import logger
@@ -34,6 +35,14 @@ _JOURNALED = (
     # Forwarded event batches are state: the timeline must survive a
     # master failover, and a retried batch must land exactly once.
     m.EventReport,
+)
+
+#: Mutating messages journaled AFTER their handler runs: the record must
+#: carry data the handler chose (e.g. which shard was dispatched), and a
+#: record lost to a crash between apply and append is recoverable by the
+#: fencing protocol (clients re-report held tasks on incarnation change).
+_APPLY_THEN_LOG = (
+    m.TaskRequest,
 )
 
 
@@ -65,7 +74,7 @@ class MasterServicer:
 
     # The transport handler.
     def handle(self, request: Any) -> Any:
-        chaos = fault_hit("master.crash", detail=type(request).__name__)
+        chaos = fault_hit(ChaosSite.MASTER_CRASH, detail=type(request).__name__)
         if chaos is not None:
             if chaos.kind == "kill":
                 # A real master death: no flushes, no atexit — exactly
@@ -79,7 +88,7 @@ class MasterServicer:
         store = self._state_store
         if store is None or store.replaying:
             return handler(self, request)
-        if isinstance(request, m.TaskRequest):
+        if isinstance(request, _APPLY_THEN_LOG):
             # Dispatch is journaled AFTER the handler (apply-then-log):
             # the record must carry the chosen shard's exact range, and
             # a lost record is safe — the replayed master still holds
@@ -313,6 +322,12 @@ class MasterServicer:
         config.version = self._paral_config.version + 1
         self._paral_config = config
 
+    # ---------------- cluster version ----------------
+    def _get_cluster_version(self, req: m.ClusterVersionRequest):
+        store = self._state_store
+        version = store.incarnation if store is not None else 0
+        return m.ClusterVersion(version_type=req.version_type, version=version)
+
     # ---------------- job exit ----------------
     def _handle_job_exit(self, req: m.JobExitRequest):
         self._job_exit = req
@@ -357,6 +372,7 @@ MasterServicer._HANDLERS = {
     m.SyncFinish: MasterServicer._sync_finished,
     m.SyncBarrierRequest: MasterServicer._sync_barrier,
     m.ParallelConfigRequest: MasterServicer._get_paral_config,
+    m.ClusterVersionRequest: MasterServicer._get_cluster_version,
     m.JobExitRequest: MasterServicer._handle_job_exit,
 }
 

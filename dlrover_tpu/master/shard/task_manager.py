@@ -14,6 +14,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, Optional, Tuple
 
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.messages import ShardTask
 from dlrover_tpu.master.shard.splitter import (
@@ -319,7 +321,7 @@ class TaskManager:
     """All datasets of a job + the worker-failure recovery hook."""
 
     def __init__(self, speed_monitor=None):
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("master.task_manager")
         self._datasets: Dict[str, DatasetManager] = {}
         self._speed_monitor = speed_monitor
         self._worker_last_task: Dict[int, float] = {}
@@ -356,9 +358,9 @@ class TaskManager:
             dataset_name, dataset_size, shard_size, num_epochs, shuffle,
             storage_type,
         )
-        timeout = float(os.getenv(
-            "DLROVER_TPU_SHARD_TIMEOUT", DatasetManager.DOING_TASK_TIMEOUT
-        ))
+        timeout = env_utils.SHARD_TIMEOUT.get(
+            default=DatasetManager.DOING_TASK_TIMEOUT
+        )
         manager = DatasetManager(splitter, doing_timeout=timeout)
         manager.journal = self._journal
         self._datasets[dataset_name] = manager

@@ -36,6 +36,8 @@ from dlrover_tpu.common.checksum import (
     block_checksum,
     verify_block,
 )
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 
 _FRAME = struct.Struct(">II")  # payload length, payload checksum
@@ -51,7 +53,7 @@ INCARNATION_FILE = "incarnation"
 
 #: Seconds between periodic snapshots (journal rotation), and the
 #: journal-growth backstop that forces one sooner.
-SNAPSHOT_INTERVAL_ENV = "DLROVER_TPU_STATE_SNAPSHOT_SECS"
+SNAPSHOT_INTERVAL_ENV = env_utils.STATE_SNAPSHOT_SECS.name
 DEFAULT_SNAPSHOT_INTERVAL = 30.0
 DEFAULT_SNAPSHOT_EVERY_RECORDS = 2048
 
@@ -134,15 +136,15 @@ class MasterStateStore:
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
         self._algo = DEFAULT_ALGO
-        self._lock = threading.RLock()
+        self._lock = instrumented_lock("master.state_store", rlock=True)
         self._journal_file = None
         self._seq = 0
         self._records_since_snapshot = 0
         self._appended_records = 0
         self._last_snapshot_time = time.monotonic()
         if snapshot_interval is None:
-            snapshot_interval = float(
-                os.getenv(SNAPSHOT_INTERVAL_ENV, DEFAULT_SNAPSHOT_INTERVAL)
+            snapshot_interval = env_utils.STATE_SNAPSHOT_SECS.get(
+                default=DEFAULT_SNAPSHOT_INTERVAL
             )
         self._snapshot_interval = snapshot_interval
         self._snapshot_every_records = snapshot_every_records
@@ -221,7 +223,7 @@ class MasterStateStore:
                 self.state_dir, f"{SNAPSHOT_PREFIX}{seq}{SNAPSHOT_SUFFIX}"
             )
             tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
+            with open(tmp, "wb") as f:  # dtlint: disable=DT002 -- snapshot+rotate must be atomic w.r.t. appends; mutations block on the lock by design
                 _write_header(f, _SNAP_MAGIC, self._algo)
                 f.write(_frame(payload, self._algo))
                 f.flush()

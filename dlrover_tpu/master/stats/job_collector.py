@@ -15,6 +15,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, List, Optional
 
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 
 
@@ -30,7 +31,7 @@ class JobMetricCollector:
     """Aggregate per-node resource usage + model info for one job."""
 
     def __init__(self, history: int = 256):
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("master.job_collector")
         self._history = history
         self._node_samples: Dict[int, Deque[ResourceSample]] = {}
         self._device_stats: Dict[int, List[Dict]] = {}

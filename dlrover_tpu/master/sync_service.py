@@ -6,6 +6,8 @@ separate notify/wait barrier lets one worker release the rest.
 """
 
 import threading
+
+from dlrover_tpu.common.lockdep import instrumented_lock
 from typing import Dict, Set
 
 
@@ -15,7 +17,7 @@ class SyncService:
         self._sync_objs: Dict[str, Set[int]] = {}
         self._finished_syncs: Set[str] = set()
         self._barriers: Set[str] = set()
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("master.sync_service")
 
     def _alive_workers(self) -> Set[int]:
         if self._job_manager is None:

@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Callable, Iterable, List, Optional
 
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.events import JobEvent
 
@@ -30,7 +31,7 @@ class EventLog:
     def __init__(self, capacity: int = 4096):
         self._capacity = capacity
         self._events: List[JobEvent] = []
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("observability.event_log")
         self._seq = 0
         self._listeners: List[Callable[[JobEvent], None]] = []
         #: Optional WAL hook (MasterStateStore.append-compatible).

@@ -28,6 +28,7 @@ from dataclasses import asdict, dataclass, field
 from typing import Any, Callable, Dict, Optional
 
 from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 
 
@@ -76,7 +77,7 @@ class JobEvent:
 
 # ---------------- process-local routing ----------------
 
-_lock = threading.Lock()
+_lock = instrumented_lock("observability.events_route")
 _sink: Optional[Callable[[JobEvent], None]] = None
 _identity: Optional[Dict[str, Any]] = None
 _reporter = None          # lazy EventReporter, see _route()
@@ -115,7 +116,7 @@ def reset():
     if rep is not None:
         try:
             rep.stop(flush=False)
-        except Exception:
+        except Exception:  # dtlint: disable=DT001 -- test-teardown hook: a half-stopped reporter must not fail the reset
             pass
 
 
@@ -126,7 +127,7 @@ def flush_events(timeout: float = 3.0):
     if rep is not None:
         try:
             rep.flush(timeout)
-        except Exception:
+        except Exception:  # dtlint: disable=DT001 -- best-effort shutdown drain: a dead master must not tax process exit
             pass
 
 
@@ -185,10 +186,10 @@ def emit(_kind: str, _node_id: Optional[int] = None,
     )
     try:
         from dlrover_tpu.utils.tracing import get_tracer
-
-        get_tracer().instant(_kind, **args)
-    except Exception:
+    except ImportError:
         pass
+    else:
+        get_tracer().instant(_kind, **args)
     try:
         _route(ev)
     except Exception:

@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.observability.events import EventKind, JobEvent
 
 #: kind -> default cause label for incident-opening events.
@@ -116,7 +117,7 @@ class GoodputLedger:
     STEP_GAP_CAP = 120.0
 
     def __init__(self, now: Optional[float] = None):
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("observability.goodput")
         self._t0 = now if now is not None else time.time()
         self._incidents: List[Incident] = []
         self._steps = 0

@@ -14,6 +14,7 @@ import os
 import time
 from typing import Dict, List, Optional
 
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.event_log import EventLog
 from dlrover_tpu.observability.events import EventKind, JobEvent
@@ -22,8 +23,8 @@ from dlrover_tpu.observability.goodput import GoodputLedger
 
 #: Master env knobs: scrape port (unset = exporter off; 0 = ephemeral)
 #: and an on-stop goodput artifact path (the bench harness reads it).
-METRICS_PORT_ENV = "DLROVER_TPU_METRICS_PORT"
-GOODPUT_JSON_ENV = "DLROVER_TPU_GOODPUT_JSON"
+METRICS_PORT_ENV = env_utils.METRICS_PORT.name
+GOODPUT_JSON_ENV = env_utils.GOODPUT_JSON.name
 
 _CKPT_PHASES = {
     EventKind.CKPT_SAVE: "save",
@@ -112,7 +113,7 @@ class ObservabilityPlane:
         if self.exporter is not None:
             self.exporter.stop()
             self.exporter = None
-        path = os.getenv(GOODPUT_JSON_ENV, "")
+        path = env_utils.GOODPUT_JSON.get()
         if path:
             try:
                 self.dump_json(path)

@@ -20,7 +20,8 @@ import threading
 from collections import deque
 from typing import List, Optional
 
-from dlrover_tpu.common.backoff import ExponentialBackoff
+from dlrover_tpu.common.backoff import ExponentialBackoff, poll_until
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.events import JobEvent
 
@@ -39,7 +40,7 @@ class EventReporter:
         self._flush_interval = flush_interval
         self._batch_size = batch_size
         self._buffer = deque(maxlen=max_buffer)
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("observability.reporter")
         self._wake = threading.Event()
         self._stopped = threading.Event()
         self._degraded = False  # last send failed; master presumed gone
@@ -127,13 +128,11 @@ class EventReporter:
         """Best-effort synchronous drain (process shutdown). Gives up
         immediately once the link is degraded — delivery is best-effort
         and a dead master must not tax every process exit."""
-        import time
-
-        deadline = time.monotonic() + timeout
         self._wake.set()
-        while (self.pending() and not self._degraded
-               and time.monotonic() < deadline):
-            time.sleep(0.05)
+        poll_until(
+            lambda: not self.pending() or self._degraded,
+            timeout, initial=0.02, max_delay=0.2,
+        )
 
     def stop(self, flush: bool = True):
         if flush and not self._stopped.is_set() and not self._degraded:

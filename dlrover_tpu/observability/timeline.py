@@ -48,7 +48,7 @@ def load_events_from_state_dir(state_dir: str) -> List[JobEvent]:
                 events.append(rec[1])
             elif rec[0] == "rpc" and isinstance(rec[2], m.EventReport):
                 events.extend(rec[2].events)
-        except Exception:
+        except Exception:  # dtlint: disable=DT001 -- replaying a possibly-corrupt journal: skip the bad record, keep the timeline
             continue
     return events
 

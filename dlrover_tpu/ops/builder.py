@@ -27,6 +27,7 @@ import subprocess
 import threading
 from typing import Dict, List, Optional, Sequence
 
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.log import logger
 
 __all__ = ["OpBuilder", "register_builder", "get_op", "all_ops"]
@@ -91,7 +92,7 @@ class OpBuilder:
             if self._tried:
                 return self._lib
             self._tried = True
-            if os.getenv("DLROVER_TPU_DISABLE_NATIVE"):
+            if env_utils.DISABLE_NATIVE.get():
                 return None
             if self.stale() and not self.build():
                 return None

@@ -102,7 +102,7 @@ def _ambient_mesh():
             mesh = pxla.thread_resources.env.physical_mesh
             if mesh is not None and not mesh.empty:
                 return mesh
-        except Exception:
+        except Exception:  # dtlint: disable=DT001 -- JAX-version API probe: no mesh found either way, caller falls back to SPMD axis env
             pass
     return None
 

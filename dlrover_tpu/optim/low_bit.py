@@ -283,8 +283,8 @@ def adam8bit(
             import flax.linen as nn
 
             params = nn.meta.unbox(params)
-        except Exception:
-            pass
+        except (ImportError, AttributeError):
+            pass  # flax absent or too old to have meta.unbox: params are plain
 
         def qzero(p):
             z = jnp.zeros_like(p, jnp.float32)

@@ -4,6 +4,7 @@ import os
 import time as _time
 from typing import Dict, Optional
 
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import logger
 
@@ -30,9 +31,10 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> str:
 
     from dlrover_tpu.common.env_utils import default_compile_cache_dir
 
-    cache_dir = cache_dir or os.getenv(
-        "DLROVER_TPU_COMPILE_CACHE", ""
-    ) or default_compile_cache_dir()
+    cache_dir = (
+        cache_dir or env_utils.COMPILE_CACHE.get()
+        or default_compile_cache_dir()
+    )
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
@@ -91,7 +93,7 @@ def bootstrap_timings() -> Dict[str, float]:
     wall: compile-cache setup + jax.distributed). Callers add their own
     restore / first-step phases."""
     out: Dict[str, float] = {}
-    spawn_ts = float(os.getenv("DLROVER_TPU_SPAWN_TS", "0") or 0)
+    spawn_ts = env_utils.SPAWN_TS.get()
     if spawn_ts:
         out["spawn_s"] = round(_ENTRY_TS - spawn_ts, 3)
     if _INIT_DONE_TS is not None:

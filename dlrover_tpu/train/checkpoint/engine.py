@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from dlrover_tpu.chaos.injector import fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
 from dlrover_tpu.common import checksum, ckpt_persist, fastcopy
 from dlrover_tpu.common.ckpt_meta import (
     SaveEvent,
@@ -628,7 +629,7 @@ class CheckpointEngine:
         # phase number means what it says.
         self._reset_restore_stats()
         t_load0 = time.perf_counter()
-        chaos = fault_hit("ckpt.shm", detail=self._shm_name)
+        chaos = fault_hit(ChaosSite.CKPT_SHM, detail=self._shm_name)
         if chaos is not None and chaos.kind == "lose":
             # Simulate a host reboot that wiped /dev/shm: the warm
             # snapshot is gone and restore must fall back to storage.
@@ -843,8 +844,8 @@ class CheckpointEngine:
             for r in readers:
                 try:
                     r.close()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # best-effort close; the read outcome already stands
         return nbytes, len(metas), state
 
     # ------------- restore attribution -------------

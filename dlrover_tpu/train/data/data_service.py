@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common.backoff import poll_until
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.comm import SharedQueue
 from dlrover_tpu.common.shared_memory import SharedMemory
@@ -418,8 +419,8 @@ class CoworkerDataService:
                     self._tasks.put(task)
                 for t in pending:
                     self._tasks.put(t)
-            except Exception:
-                pass  # queue already closed during stop()
+            except Exception:  # dtlint: disable=DT001 -- task re-queue races stop(): the mp queue may be closed mid-put, losing tasks is fine at shutdown
+                pass
         finally:
             with self._remote_lock:
                 if conn in self._remote_conns:
@@ -472,8 +473,11 @@ class CoworkerDataService:
             if w.is_alive():
                 w.terminate()
                 w.join(timeout=5.0)  # reap: is_alive() must settle
-        while time.time() < deadline and self.remote_workers:
-            time.sleep(0.05)
+        poll_until(
+            lambda: not self.remote_workers,
+            max(0.0, deadline - time.time()),
+            initial=0.02, max_delay=0.2,
+        )
         with self._remote_lock:
             for conn in list(self._remote_conns):
                 try:

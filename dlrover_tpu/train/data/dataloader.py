@@ -91,13 +91,13 @@ class ElasticDataLoader:
     def load_config(self):
         """Pick up a master-tuned batch size if the config file advanced."""
         path = self._config_file
-        if not path or not os.path.exists(path):
+        if not path:
             return
         try:
             with open(path) as f:
                 cfg = json.load(f)
         except (OSError, ValueError):
-            return
+            return  # absent or mid-write config: keep the current one
         version = cfg.get("version", 0)
         if version <= self._config_version:
             return

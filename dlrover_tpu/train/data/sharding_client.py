@@ -23,6 +23,7 @@ from collections import deque
 from typing import Callable, List, Optional, Tuple
 
 from dlrover_tpu.agent.master_client import MasterClient, build_master_client
+from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.messages import ShardTask
 
@@ -96,6 +97,9 @@ class ShardingClient:
         deadline = (
             None if max_wait is None else time.monotonic() + max_wait
         )
+        backoff = ExponentialBackoff(
+            initial=retry_interval, max_delay=retry_interval * 4
+        )
         while True:
             task: ShardTask = self._client.get_task(self.dataset_name)
             if task.exists:
@@ -118,7 +122,9 @@ class ShardingClient:
                 return None
             if deadline is not None and time.monotonic() >= deadline:
                 return None
-            time.sleep(retry_interval)
+            backoff.sleep(
+                None if deadline is None else deadline - time.monotonic()
+            )
 
     def report_batch_done(self, task_id: Optional[int] = None,
                           success: bool = True) -> bool:

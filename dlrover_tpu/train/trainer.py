@@ -31,6 +31,8 @@ import time
 from typing import Any, Callable, Iterable, Optional, Sequence
 
 from dlrover_tpu.chaos.injector import fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.log import logger
 
 
@@ -157,7 +159,7 @@ class Trainer:
                 zero_degree=zero_degree_of(self._result.spec),
             )
         self._client = None
-        if report_metrics and os.getenv("DLROVER_TPU_MASTER_ADDR"):
+        if report_metrics and env_utils.MASTER_ADDR.get():
             from dlrover_tpu.agent.master_client import MasterClient
 
             try:
@@ -167,7 +169,7 @@ class Trainer:
         from dlrover_tpu.train.elastic_trainer import StepProgressReporter
 
         self._progress = StepProgressReporter(
-            every=int(os.getenv("DLROVER_TPU_PROGRESS_EVERY", "20"))
+            every=env_utils.PROGRESS_EVERY.get()
         )
 
     @property
@@ -311,13 +313,13 @@ class Trainer:
                 else contextlib.nullcontext()
             )
             t_step0 = time.perf_counter()
-            chaos = fault_hit("trainer.step", detail=str(step))
+            chaos = fault_hit(ChaosSite.TRAINER_STEP, detail=str(step))
             if chaos is not None and chaos.kind in ("straggle", "delay"):
                 # Scripted straggler: the sleep lands inside the step's
                 # wall time (after t_step0), so the slowdown is visible
                 # to the same step-rate reporting the master's speed
                 # monitor reads.
-                time.sleep(chaos.delay_s)
+                time.sleep(chaos.delay_s)  # dtlint: disable=DT003 -- scripted chaos straggle, not a poll
             with ctx:
                 if not pipeline:
                     batch = jax.device_put(batch, self.batch_sharding)
@@ -350,7 +352,9 @@ class Trainer:
                     try:
                         self._client.report_global_step(done, time.time())
                     except Exception:
-                        pass
+                        # Step reporting is best-effort but a broken
+                        # link should be visible once per occurrence.
+                        logger.debug("step report failed", exc_info=True)
                     self._progress.note(done)
                 report_training_metrics(done)
             last_loss = metrics["loss"]

@@ -27,6 +27,7 @@ import time
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.log import logger
 
 # Peak dense fp/bf16 FLOPs by TPU generation substring (public specs).
@@ -47,7 +48,7 @@ def device_peak_flops(device=None) -> float:
     for key, peak in _PEAK_FLOPS:
         if key in kind:
             return peak
-    return float(os.getenv("DLROVER_TPU_PEAK_FLOPS", 0)) or 0.0
+    return env_utils.PEAK_FLOPS.get()
 
 
 class StepStats:

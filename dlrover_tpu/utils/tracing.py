@@ -26,7 +26,9 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, Optional
 
-_TRACE_ENV = "DLROVER_TPU_TRACE_FILE"
+from dlrover_tpu.common import env_utils
+
+_TRACE_ENV = env_utils.TRACE_FILE.name
 
 
 class Tracer:
@@ -77,7 +79,7 @@ class Tracer:
         Atomic (tmp + ``os.replace``, the port-file contract): exports
         fire mid-run and at exit, and a reader — or a crash between
         truncate and write — must never see a torn file."""
-        path = path or os.getenv(_TRACE_ENV, "")
+        path = path or env_utils.TRACE_FILE.get()
         if not path:
             return None
         with self._lock:
@@ -101,8 +103,8 @@ def _export_at_exit():
         tracer = _tracer
         if tracer is not None:
             tracer.export()
-    except Exception:
-        pass  # exit paths must never fail on tracing
+    except Exception:  # dtlint: disable=DT001 -- atexit path: exits must never fail on tracing
+        pass
 
 
 def get_tracer() -> Tracer:
@@ -110,7 +112,7 @@ def get_tracer() -> Tracer:
     with _tracer_lock:
         if _tracer is None:
             _tracer = Tracer()
-            if os.getenv(_TRACE_ENV):
+            if env_utils.TRACE_FILE.get():
                 # The env contract asked for a file: make sure orderly
                 # exits export even if no code path calls export().
                 atexit.register(_export_at_exit)

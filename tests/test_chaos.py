@@ -103,44 +103,44 @@ class TestInjector:
 
     def test_at_fires_once_on_nth_occurrence(self, monkeypatch):
         arm(monkeypatch, FaultPlan(seed=1, events=[
-            FaultEvent(site="s", kind="k", at=3),
+            FaultEvent(site="test.probe", kind="k", at=3),
         ]))
-        fires = [fault_hit("s") is not None for _ in range(6)]
+        fires = [fault_hit("test.probe") is not None for _ in range(6)]
         assert fires == [False, False, True, False, False, False]
 
     def test_every_and_max_fires(self, monkeypatch):
         arm(monkeypatch, FaultPlan(seed=1, events=[
-            FaultEvent(site="s", kind="k", every=2, max_fires=2),
+            FaultEvent(site="test.probe", kind="k", every=2, max_fires=2),
         ]))
-        fires = [fault_hit("s") is not None for _ in range(8)]
+        fires = [fault_hit("test.probe") is not None for _ in range(8)]
         assert fires == [False, True, False, True, False, False, False, False]
 
     def test_match_filters_on_detail(self, monkeypatch):
         arm(monkeypatch, FaultPlan(seed=1, events=[
-            FaultEvent(site="s", kind="k", every=1, match=".bin"),
+            FaultEvent(site="test.probe", kind="k", every=1, match=".bin"),
         ]))
-        assert fault_hit("s", detail="x.meta") is None
-        assert fault_hit("s", detail="x.bin") is not None
+        assert fault_hit("test.probe", detail="x.meta") is None
+        assert fault_hit("test.probe", detail="x.bin") is not None
 
     def test_prob_schedule_is_seed_deterministic(self, monkeypatch):
         plan = FaultPlan(seed=7, events=[
-            FaultEvent(site="s", kind="k", prob=0.4, max_fires=4),
+            FaultEvent(site="test.probe", kind="k", prob=0.4, max_fires=4),
         ])
         arm(monkeypatch, plan)
-        seq1 = [fault_hit("s") is not None for _ in range(30)]
+        seq1 = [fault_hit("test.probe") is not None for _ in range(30)]
         arm(monkeypatch, plan)  # re-arm: fresh counters, same seed
-        seq2 = [fault_hit("s") is not None for _ in range(30)]
+        seq2 = [fault_hit("test.probe") is not None for _ in range(30)]
         assert seq1 == seq2
         assert sum(seq1) == 4
 
     def test_plan_roundtrip_and_file_loading(self, monkeypatch, tmp_path):
         plan = FaultPlan(seed=9, events=[
-            FaultEvent(site="a.b", kind="kill", at=2, args={"rank": 1}),
-            FaultEvent(site="c", kind="delay", every=3, delay_s=0.5),
+            FaultEvent(site="test.probe", kind="kill", at=2, args={"rank": 1}),
+            FaultEvent(site="test.probe.b", kind="delay", every=3, delay_s=0.5),
         ])
         restored = FaultPlan.from_json(plan.to_json())
         assert restored.seed == 9
-        assert [e.site for e in restored.events] == ["a.b", "c"]
+        assert [e.site for e in restored.events] == ["test.probe", "test.probe.b"]
         assert restored.events[0].args == {"rank": 1}
         p = tmp_path / "plan.json"
         p.write_text(plan.to_json())
@@ -149,15 +149,26 @@ class TestInjector:
         inj = FaultInjector.get()
         assert inj is not None and len(inj._by_site) == 2
 
+    def test_typoed_site_refuses_to_arm(self, monkeypatch):
+        """A plan naming an unregistered site must not arm silently:
+        from_env fails fast, and the hot-path get() disables chaos with
+        an error instead of running a drill that injects nothing."""
+        arm(monkeypatch, FaultPlan(events=[
+            FaultEvent(site="trainer.stpe", kind="k", at=1),  # typo
+        ]))
+        with pytest.raises(ValueError, match="trainer.stpe"):
+            FaultPlan.from_env()
+        assert FaultInjector.get() is None
+
     def test_journal_records_fired_events(self, monkeypatch, tmp_path):
         log = str(tmp_path / "journal.jsonl")
         arm(monkeypatch, FaultPlan(seed=1, events=[
-            FaultEvent(site="s", kind="k", at=2),
+            FaultEvent(site="test.probe", kind="k", at=2),
         ]), log_path=log)
         for _ in range(4):
-            fault_hit("s", detail="d")
+            fault_hit("test.probe", detail="d")
         lines = [json.loads(x) for x in open(log).read().splitlines()]
-        assert lines == [{"site": "s", "n": 2, "kind": "k", "detail": "d"}]
+        assert lines == [{"site": "test.probe", "n": 2, "kind": "k", "detail": "d"}]
 
 
 class TestChaosStorage:

@@ -1,0 +1,479 @@
+"""dtlint analyzer drills: per-rule fixtures (fire on the bad shape,
+stay quiet on the good one), the suppression audit, the CLI contract,
+the docs/env-table sync — and the tier-1 gate: the analyzer runs over
+the whole ``dlrover_tpu`` package and must report zero unsuppressed
+findings."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.dtlint.__main__ import build_env_table, main
+from tools.dtlint.core import lint_source
+from tools.dtlint.project import Project
+from tools.dtlint.rules import ALL_RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "dlrover_tpu")
+
+PROJECT = Project(REPO)
+
+
+def run_rule(rule_id, source, path="dlrover_tpu/somewhere/mod.py",
+             project=PROJECT):
+    rules = [r for r in ALL_RULES if r.id == rule_id]
+    assert rules, f"unknown rule {rule_id}"
+    return lint_source(textwrap.dedent(source), path, rules, project)
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+class TestDT001SwallowedException:
+    def test_fires_on_except_exception_pass(self):
+        active, _ = run_rule("DT001", """\
+            try:
+                risky()
+            except Exception:
+                pass
+        """)
+        assert rule_ids(active) == ["DT001"]
+
+    def test_fires_on_bare_except_without_reraise(self):
+        active, _ = run_rule("DT001", """\
+            try:
+                risky()
+            except:
+                cleanup()
+        """)
+        assert rule_ids(active) == ["DT001"]
+
+    def test_quiet_on_bare_except_with_reraise(self):
+        active, _ = run_rule("DT001", """\
+            try:
+                risky()
+            except:
+                cleanup()
+                raise
+        """)
+        assert active == []
+
+    def test_quiet_when_logged_or_narrowed(self):
+        active, _ = run_rule("DT001", """\
+            try:
+                risky()
+            except Exception:
+                logger.warning("boom", exc_info=True)
+            try:
+                risky()
+            except (OSError, ValueError):
+                pass
+        """)
+        assert active == []
+
+    def test_suppression_with_reason_moves_to_suppressed(self):
+        active, suppressed = run_rule("DT001", """\
+            try:
+                risky()
+            except Exception:  # dtlint: disable=DT001 -- emit() never raises by contract
+                pass
+        """)
+        assert active == []
+        assert rule_ids(suppressed) == ["DT001"]
+
+
+class TestDT002BlockingUnderLock:
+    def test_fires_on_sleep_under_lock(self):
+        active, _ = run_rule("DT002", """\
+            import time
+
+            def f(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """)
+        assert rule_ids(active) == ["DT002"]
+
+    def test_fires_on_emit_and_open_under_lock(self):
+        active, _ = run_rule("DT002", """\
+            def f(self):
+                with self._state_lock:
+                    emit("kind", step=1)
+                    data = open("/tmp/x").read()
+        """)
+        assert rule_ids(active) == ["DT002", "DT002"]
+
+    def test_quiet_outside_lock_and_in_nested_def(self):
+        active, _ = run_rule("DT002", """\
+            import time
+
+            def f(self):
+                with self._lock:
+                    x = compute()
+
+                    def later():
+                        time.sleep(1.0)  # runs after release
+                time.sleep(0.1)
+        """)
+        assert active == []
+
+    def test_quiet_on_non_lock_context(self):
+        active, _ = run_rule("DT002", """\
+            import time
+
+            def f(self):
+                with open("/tmp/x") as fh:
+                    time.sleep(0.1)
+        """)
+        assert active == []
+
+
+class TestDT003BusyPoll:
+    def test_fires_on_while_sleep(self):
+        active, _ = run_rule("DT003", """\
+            import time
+
+            def f():
+                while not done():
+                    time.sleep(0.1)
+        """)
+        assert rule_ids(active) == ["DT003"]
+
+    def test_quiet_on_backoff_and_event_wait(self):
+        active, _ = run_rule("DT003", """\
+            import time
+
+            def f(backoff, stop):
+                while not done():
+                    backoff.sleep()
+                while not stop.is_set():
+                    stop.wait(0.5)
+                time.sleep(1.0)  # not in a loop: a one-shot delay
+        """)
+        assert active == []
+
+    def test_nested_function_in_loop_is_its_own_scope(self):
+        active, _ = run_rule("DT003", """\
+            import time
+
+            def f():
+                while True:
+                    def cb():
+                        time.sleep(0.1)  # runs elsewhere, not this loop
+                    register(cb)
+                    if done():
+                        break
+        """)
+        assert active == []
+
+
+class TestDT004Toctou:
+    def test_fires_on_exists_then_open(self):
+        active, _ = run_rule("DT004", """\
+            import os
+
+            def f(path):
+                if os.path.exists(path):
+                    with open(path) as fh:
+                        return fh.read()
+        """)
+        assert rule_ids(active) == ["DT004"]
+
+    def test_quiet_on_open_and_catch(self):
+        active, _ = run_rule("DT004", """\
+            def f(path):
+                try:
+                    with open(path) as fh:
+                        return fh.read()
+                except FileNotFoundError:
+                    return None
+        """)
+        assert active == []
+
+    def test_quiet_when_check_gates_no_open(self):
+        active, _ = run_rule("DT004", """\
+            import os
+
+            def f(path, other):
+                if os.path.exists(path):
+                    os.unlink(path)
+                with open(other) as fh:
+                    return fh.read()
+        """)
+        assert active == []
+
+    def test_scopes_are_independent(self):
+        active, _ = run_rule("DT004", """\
+            import os
+
+            def check(path):
+                return os.path.exists(path)
+
+            def read(path):
+                return open(path).read()
+        """)
+        assert active == []
+
+
+class TestDT005AtomicWrite:
+    DURABLE = "dlrover_tpu/master/state_store.py"
+
+    def test_fires_on_write_open_in_durable_module(self):
+        active, _ = run_rule("DT005", """\
+            def save(path, data):
+                with open(path, "wb") as fh:
+                    fh.write(data)
+        """, path=self.DURABLE)
+        assert rule_ids(active) == ["DT005"]
+
+    def test_quiet_on_tmp_then_replace_and_append(self):
+        active, _ = run_rule("DT005", """\
+            import os
+
+            def save(path, data):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as fh:
+                    fh.write(data)
+                os.replace(tmp, path)
+
+            def journal(path, rec):
+                with open(path, "ab") as fh:
+                    fh.write(rec)
+        """, path=self.DURABLE)
+        assert active == []
+
+    def test_quiet_outside_durable_modules(self):
+        active, _ = run_rule("DT005", """\
+            def save(path, data):
+                with open(path, "w") as fh:
+                    fh.write(data)
+        """, path="dlrover_tpu/utils/scratch.py")
+        assert active == []
+
+
+class TestDT006EnvRegistry:
+    def test_declared_literal_is_a_bypass(self):
+        active, _ = run_rule("DT006", """\
+            import os
+
+            flag = os.getenv("DLROVER_TPU_LOCKDEP")
+        """)
+        assert rule_ids(active) == ["DT006"]
+        assert "env_utils" in active[0].message or "registry" in active[0].message
+
+    def test_undeclared_literal_is_a_typo(self):
+        active, _ = run_rule("DT006", """\
+            import os
+
+            flag = os.getenv("DLROVER_TPU_NO_SUCH_KNOB_EVER")
+        """)
+        assert rule_ids(active) == ["DT006"]
+
+    def test_docstrings_and_registry_module_exempt(self):
+        active, _ = run_rule("DT006", '''\
+            """Set DLROVER_TPU_LOCKDEP=1 to arm lockdep."""
+        ''')
+        assert active == []
+        active, _ = run_rule(
+            "DT006",
+            'LOCKDEP = _REG.bool("DLROVER_TPU_LOCKDEP", False, "doc")\n',
+            path=PROJECT.env_registry_path,
+        )
+        assert active == []
+
+
+class TestDT007ChaosSites:
+    def test_registered_literal_is_a_bypass(self):
+        active, _ = run_rule("DT007", """\
+            chaos = fault_hit("trainer.step", detail="3")
+        """)
+        assert rule_ids(active) == ["DT007"]
+        assert "ChaosSite" in active[0].message
+
+    def test_unregistered_literal_is_a_typo(self):
+        active, _ = run_rule("DT007", """\
+            chaos = fault_hit("trainer.stpe")
+        """)
+        assert rule_ids(active) == ["DT007"]
+        assert "not registered" in active[0].message
+
+    def test_constant_reference_is_quiet(self):
+        active, _ = run_rule("DT007", """\
+            chaos = fault_hit(ChaosSite.TRAINER_STEP, detail="3")
+        """)
+        assert active == []
+
+
+class TestDT008RpcContract:
+    def _project(self, tmp_path, messages_src, servicer_src):
+        messages = tmp_path / "messages.py"
+        servicer = tmp_path / "servicer.py"
+        messages.write_text(textwrap.dedent(messages_src))
+        servicer.write_text(textwrap.dedent(servicer_src))
+        return Project(
+            REPO,
+            messages_path=str(messages),
+            servicer_path=str(servicer),
+        ), str(messages), str(servicer)
+
+    MESSAGES = """\
+        class BaseRequest:
+            pass
+
+        class Covered(BaseRequest):
+            journaled = True
+
+        class Orphan(BaseRequest):
+            pass
+    """
+
+    SERVICER = """\
+        _HANDLERS = {m.Covered: 1}
+        _JOURNALED = (m.Covered,)
+        _APPLY_THEN_LOG = ()
+    """
+
+    def test_unhandled_request_flagged_in_messages(self, tmp_path):
+        project, messages, _ = self._project(
+            tmp_path, self.MESSAGES, self.SERVICER)
+        active, _ = lint_source(
+            open(messages).read(), messages,
+            [r for r in ALL_RULES if r.id == "DT008"], project)
+        assert ["DT008"] == rule_ids(active)
+        assert "Orphan" in active[0].message
+
+    def test_journal_tuple_mismatch_flagged_both_ways(self, tmp_path):
+        project, messages, servicer = self._project(tmp_path, """\
+            class BaseRequest:
+                pass
+
+            class Marked(BaseRequest):
+                journaled = True
+        """, """\
+            _HANDLERS = {m.Marked: 1, m.Ghost: 2}
+            _JOURNALED = (m.Ghost,)
+            _APPLY_THEN_LOG = ()
+        """)
+        rule = [r for r in ALL_RULES if r.id == "DT008"]
+        active, _ = lint_source(open(messages).read(), messages, rule, project)
+        # Marked is journaled=True but missing from _JOURNALED.
+        assert any("Marked" in f.message for f in active)
+        active, _ = lint_source(open(servicer).read(), servicer, rule, project)
+        # Ghost is handled+journaled but is not a declared request.
+        assert any("Ghost" in f.message for f in active)
+
+    def test_real_contract_is_clean(self):
+        rule = [r for r in ALL_RULES if r.id == "DT008"]
+        for path in (PROJECT.messages_path, PROJECT.servicer_path):
+            active, _ = lint_source(open(path).read(), path, rule, PROJECT)
+            assert active == [], [f.format() for f in active]
+
+
+class TestSuppressionAudit:
+    def test_reasonless_disable_is_dt000_and_does_not_suppress(self):
+        active, suppressed = run_rule("DT001", """\
+            try:
+                risky()
+            except Exception:  # dtlint: disable=DT001
+                pass
+        """)
+        assert sorted(rule_ids(active)) == ["DT000", "DT001"]
+        assert suppressed == []
+
+    def test_unknown_rule_id_is_dt000(self):
+        active, _ = run_rule("DT001", """\
+            x = 1  # dtlint: disable=BOGUS -- because
+        """)
+        assert rule_ids(active) == ["DT000"]
+
+    def test_dt000_cannot_be_suppressed(self):
+        active, _ = run_rule("DT001", """\
+            x = 1  # dtlint: disable=DT000 -- trying to silence the audit
+        """)
+        assert rule_ids(active) == ["DT000"]
+
+    def test_malformed_directive_is_dt000(self):
+        active, _ = run_rule("DT001", """\
+            x = 1  # dtlint disable DT001 because reasons
+        """)
+        assert rule_ids(active) == ["DT000"]
+
+    def test_multi_rule_disable_covers_both(self):
+        active, suppressed = run_rule("DT003", """\
+            import time
+
+            def f():
+                while not done():
+                    time.sleep(0.5)  # dtlint: disable=DT002,DT003 -- scripted fixed cadence is the contract here
+        """)
+        assert active == []
+        assert rule_ids(suppressed) == ["DT003"]
+
+
+class TestCli:
+    BAD = "try:\n    x()\nexcept Exception:\n    pass\n"
+
+    def test_exit_one_on_findings_and_zero_on_clean(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        assert main([str(bad)]) == 1
+        out = capsys.readouterr().out
+        assert "DT001" in out
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        assert main([str(good)]) == 0
+
+    def test_github_format(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        assert main(["--format=github", str(bad)]) == 1
+        assert "::error file=" in capsys.readouterr().out
+
+    def test_syntax_error_is_reported_not_crashed(self, tmp_path, capsys):
+        broken = tmp_path / "broken.py"
+        broken.write_text("def f(:\n")
+        assert main([str(broken)]) == 1
+        assert "syntax error" in capsys.readouterr().err
+
+    def test_list_rules_names_all_eight(self, capsys):
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("DT001", "DT002", "DT003", "DT004",
+                    "DT005", "DT006", "DT007", "DT008"):
+            assert rid in out
+
+    def test_module_entrypoint(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dtlint", str(bad)],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        assert proc.returncode == 1
+        assert "DT001" in proc.stdout
+
+
+class TestTier1Gate:
+    def test_package_has_zero_unsuppressed_findings(self, capsys):
+        """THE gate: dlrover_tpu/ must lint clean. A new finding either
+        gets fixed or carries a reasoned suppression — never lands raw."""
+        rc = main([PKG])
+        captured = capsys.readouterr()
+        assert rc == 0, f"dtlint findings:\n{captured.out}\n{captured.err}"
+
+    def test_env_table_matches_docs(self):
+        """docs/configuration.md embeds the generated table verbatim
+        (regenerate with `python -m tools.dtlint --env-table`)."""
+        table = build_env_table(PROJECT.env_registry_path)
+        doc_path = os.path.join(REPO, "docs", "configuration.md")
+        doc = open(doc_path).read()
+        begin, end = "<!-- env-table:begin -->", "<!-- env-table:end -->"
+        assert begin in doc and end in doc
+        embedded = doc.split(begin, 1)[1].split(end, 1)[0].strip()
+        assert embedded == table.strip(), (
+            "docs/configuration.md env table drifted from the registry; "
+            "regenerate with: python -m tools.dtlint --env-table"
+        )

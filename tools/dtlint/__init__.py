@@ -1,0 +1,17 @@
+"""dtlint — repo-native static analysis for dlrover_tpu's distributed-systems invariants.
+
+Every rule here encodes a bug class this codebase already paid to learn
+(see docs/static_analysis.md for the catalog and the PRs that motivated
+each rule). The analyzer is AST-based, dependency-free, and runs as a
+tier-1 test over ``dlrover_tpu/`` asserting zero unsuppressed findings.
+
+Suppression is inline and audited::
+
+    except Exception:  # dtlint: disable=DT001 -- emit() must never raise
+
+A disable without a ``-- <reason>`` is itself a finding (DT000).
+"""
+
+from tools.dtlint.core import Finding, lint_paths, lint_source  # noqa: F401
+from tools.dtlint.project import Project  # noqa: F401
+from tools.dtlint.rules import ALL_RULES  # noqa: F401

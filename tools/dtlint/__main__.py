@@ -1,0 +1,117 @@
+"""CLI: ``python -m tools.dtlint [--format=text|github] [paths...]``.
+
+Exit status: 0 = clean, 1 = findings (or unparseable files), 2 = usage
+error. ``--env-table`` prints the generated markdown table for
+docs/configuration.md from the typed registry (and is how the docs-sync
+test asserts the table never drifts).
+"""
+
+import argparse
+import ast
+import os
+import sys
+
+from tools.dtlint.core import lint_paths
+from tools.dtlint.project import Project
+from tools.dtlint.rules import ALL_RULES
+
+
+def build_env_table(registry_path: str) -> str:
+    """Markdown table of every registry declaration, straight from the
+    AST (name, type, default, doc) — regenerated, never hand-edited."""
+    with open(registry_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=registry_path)
+    rows = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("str", "int", "float", "bool", "path")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+        ):
+            continue
+        name = node.args[0].value
+        kind = node.func.attr
+        default = ""
+        doc = ""
+        if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+            default = repr(node.args[1].value)
+        if len(node.args) > 2 and isinstance(node.args[2], ast.Constant):
+            doc = str(node.args[2].value)
+        for kw in node.keywords:
+            if kw.arg == "default" and isinstance(kw.value, ast.Constant):
+                default = repr(kw.value.value)
+            elif kw.arg == "doc" and isinstance(kw.value, ast.Constant):
+                doc = str(kw.value.value)
+        doc = " ".join(doc.split())
+        rows.append((name, kind, default, doc))
+    rows.sort()
+    out = ["| Variable | Type | Default | Purpose |",
+           "| --- | --- | --- | --- |"]
+    for name, kind, default, doc in rows:
+        out.append(f"| `{name}` | {kind} | `{default}` | {doc} |")
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.dtlint",
+        description="dlrover_tpu distributed-systems invariant linter",
+    )
+    parser.add_argument("paths", nargs="*", help="files/dirs to lint "
+                        "(default: the dlrover_tpu package)")
+    parser.add_argument("--format", choices=("text", "github"),
+                        default="text")
+    parser.add_argument("--root", default=None,
+                        help="repo root for cross-file contracts "
+                        "(default: auto-detected from this package)")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings (with the "
+                        "suppression reasons audited separately)")
+    parser.add_argument("--env-table", action="store_true",
+                        help="print the generated env-var markdown table "
+                        "and exit")
+    args = parser.parse_args(argv)
+
+    project = Project(args.root) if args.root else Project.default()
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}  {rule.title}")
+        return 0
+
+    if args.env_table:
+        try:
+            sys.stdout.write(build_env_table(project.env_registry_path))
+        except OSError as exc:
+            print(f"cannot read env registry: {exc}", file=sys.stderr)
+            return 2
+        return 0
+
+    paths = args.paths or [os.path.join(project.root, "dlrover_tpu")]
+    active, suppressed, errors = lint_paths(paths, ALL_RULES, project)
+    for err in errors:
+        print(f"error: {err}", file=sys.stderr)
+    for finding in active:
+        print(finding.format(args.format))
+    if args.show_suppressed:
+        for finding in suppressed:
+            print(f"suppressed: {finding.format('text')}")
+    if active or errors:
+        print(
+            f"dtlint: {len(active)} finding(s), "
+            f"{len(suppressed)} suppressed, {len(errors)} error(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"dtlint: clean ({len(suppressed)} documented suppression(s))",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

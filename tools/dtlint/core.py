@@ -1,0 +1,241 @@
+"""Analyzer core: findings, suppression parsing, and the file walker.
+
+A rule is a callable object with ``id``/``title`` that yields
+:class:`Finding`s for one parsed file. Cross-file contracts (env
+registry, chaos sites, RPC handler map) come from the shared
+:class:`~tools.dtlint.project.Project`, which rules receive alongside
+the per-file context.
+
+Suppression contract (audited, reason mandatory):
+
+- ``# dtlint: disable=DT001 -- <reason>`` on the *reported line*
+  suppresses that rule for that line;
+- several ids: ``disable=DT001,DT002 -- <reason>``;
+- a disable with no reason, an empty reason, or an unknown rule id is
+  reported as **DT000** (suppression audit) and cannot itself be
+  suppressed.
+"""
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+_DISABLE_RE = re.compile(
+    r"#\s*dtlint:\s*disable=(?P<ids>[A-Za-z0-9_,\s]*?)"
+    r"(?:--(?P<reason>.*))?$"
+)
+
+_RULE_ID_RE = re.compile(r"^DT\d{3}$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self, style: str = "text") -> str:
+        if style == "github":
+            # GitHub Actions workflow-command annotation format.
+            return (
+                f"::error file={self.path},line={self.line},"
+                f"col={self.col},title={self.rule}::{self.message}"
+            )
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: List[str]
+    reason: str
+    raw: str
+
+
+class FileContext:
+    """One parsed source file plus the comment/suppression side-channel."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.suppressions: Dict[int, Suppression] = {}
+        self.audit_findings: List[Finding] = []
+        self._docstring_lines: Optional[Set[int]] = None
+        self._parse_comments()
+
+    # ---------------- suppression ----------------
+    def _parse_comments(self):
+        try:
+            tokens = tokenize.generate_tokens(StringIO(self.source).readline)
+            comments = [
+                (tok.start[0], tok.start[1], tok.string)
+                for tok in tokens
+                if tok.type == tokenize.COMMENT
+            ]
+        except tokenize.TokenError:
+            comments = []
+        for line, col, text in comments:
+            m = _DISABLE_RE.search(text)
+            if m is None:
+                if "dtlint" in text and "disable" in text:
+                    # A malformed directive silently suppressing nothing
+                    # is worse than a loud one.
+                    self.audit_findings.append(Finding(
+                        "DT000", self.path, line, col,
+                        f"unparseable dtlint directive: {text.strip()!r}",
+                    ))
+                continue
+            ids = [s.strip() for s in m.group("ids").split(",") if s.strip()]
+            reason = (m.group("reason") or "").strip()
+            bad_ids = [i for i in ids if not _RULE_ID_RE.match(i)]
+            if not ids or bad_ids:
+                self.audit_findings.append(Finding(
+                    "DT000", self.path, line, col,
+                    f"disable with unknown/missing rule id(s) {bad_ids or ids}",
+                ))
+                continue
+            if "DT000" in ids:
+                self.audit_findings.append(Finding(
+                    "DT000", self.path, line, col,
+                    "DT000 (suppression audit) cannot be suppressed",
+                ))
+                continue
+            if not reason:
+                self.audit_findings.append(Finding(
+                    "DT000", self.path, line, col,
+                    f"disable={','.join(ids)} carries no '-- <reason>'; "
+                    "every suppression must say why the invariant does "
+                    "not apply",
+                ))
+                continue
+            self.suppressions[line] = Suppression(line, ids, reason, text)
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        sup = self.suppressions.get(finding.line)
+        return sup is not None and finding.rule in sup.rules
+
+    # ---------------- AST helpers ----------------
+    def docstring_lines(self) -> Set[int]:
+        """Line numbers covered by module/class/function docstrings."""
+        if self._docstring_lines is None:
+            covered: Set[int] = set()
+            for node in ast.walk(self.tree):
+                if not isinstance(
+                    node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                           ast.AsyncFunctionDef)
+                ):
+                    continue
+                body = getattr(node, "body", [])
+                if body and isinstance(body[0], ast.Expr) and isinstance(
+                    body[0].value, ast.Constant
+                ) and isinstance(body[0].value.value, str):
+                    doc = body[0].value
+                    covered.update(range(doc.lineno, doc.end_lineno + 1))
+            self._docstring_lines = covered
+        return self._docstring_lines
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted best-effort name of a call target ('time.sleep', 'open')."""
+    return dotted_name(node.func)
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("<expr>")
+    return ".".join(reversed(parts))
+
+
+def walk_no_functions(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a statement body without descending into nested function
+    definitions or lambdas (their bodies run later, outside the lexical
+    context — a lock held *now* is not held *then*). The root itself
+    may be a function definition (e.g. a ``def`` as a direct statement
+    of a ``with`` body): its children are deferred too."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+# ---------------- running ----------------
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs if d not in ("__pycache__", ".git")
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def lint_source(
+    source: str, path: str, rules, project
+) -> Tuple[List[Finding], List[Finding]]:
+    """Lint one in-memory file; returns (active, suppressed) findings.
+
+    DT000 audit findings are always active — the point of the audit is
+    that a suppression cannot launder itself.
+    """
+    ctx = FileContext(path, source)
+    active: List[Finding] = list(ctx.audit_findings)
+    suppressed: List[Finding] = []
+    for rule in rules:
+        for finding in rule.check(ctx, project):
+            if ctx.is_suppressed(finding):
+                suppressed.append(finding)
+            else:
+                active.append(finding)
+    return active, suppressed
+
+
+def lint_paths(
+    paths: Iterable[str], rules, project
+) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """Lint files under `paths`; returns (active, suppressed, errors)."""
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    errors: List[str] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as exc:
+            errors.append(f"{path}: unreadable: {exc}")
+            continue
+        try:
+            got_active, got_sup = lint_source(source, path, rules, project)
+        except SyntaxError as exc:
+            errors.append(f"{path}: syntax error: {exc}")
+            continue
+        active.extend(got_active)
+        suppressed.extend(got_sup)
+    active.sort(key=lambda f: (f.path, f.line, f.rule))
+    suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
+    return active, suppressed, errors

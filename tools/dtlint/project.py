@@ -1,0 +1,228 @@
+"""Cross-file contracts the rules check against.
+
+Three contracts are parsed (AST-only, never imported — dtlint must run
+without jax or the package on sys.path):
+
+- the **env registry** (``common/env_utils.py``): every
+  ``DLROVER_TPU_*`` name declared via ``ENV.<kind>("NAME", ...)``;
+- the **chaos site registry** (``chaos/sites.py``): the injector's
+  legal site names (``ChaosSite.X = "..."`` class constants);
+- the **RPC contract** (``common/messages.py`` + ``master/servicer.py``):
+  request classes, their ``journaled`` markers, and the servicer's
+  ``_HANDLERS`` / ``_JOURNALED`` / ``_APPLY_THEN_LOG`` maps.
+
+All parsing is lazy and cached; a missing contract file yields an empty
+contract (rules then act conservatively — see each rule's docstring).
+"""
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+_ENV_DECL_KINDS = ("str", "int", "float", "bool", "path")
+
+
+def _parse_file(path: str) -> Optional[ast.Module]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+
+
+class Project:
+    #: Modules whose writes are durable state: direct non-atomic
+    #: write-mode opens here are DT005 findings. Entries are path
+    #: suffixes relative to the package root; a trailing "/" matches a
+    #: whole directory.
+    DEFAULT_DURABLE_MODULES = (
+        "master/state_store.py",
+        "master/main.py",
+        "common/storage.py",
+        "common/ckpt_persist.py",
+        "common/ckpt_meta.py",
+        "agent/ckpt_saver.py",
+        "agent/config_tuner.py",
+        "agent/run_device_check.py",
+        "observability/plane.py",
+        "observability/event_log.py",
+        "brain/service.py",
+        "utils/tracing.py",
+        "train/checkpoint/",
+    )
+
+    def __init__(
+        self,
+        root: str,
+        env_registry_path: Optional[str] = None,
+        chaos_sites_path: Optional[str] = None,
+        messages_path: Optional[str] = None,
+        servicer_path: Optional[str] = None,
+        durable_modules: Optional[Tuple[str, ...]] = None,
+    ):
+        self.root = os.path.abspath(root)
+
+        def _default(rel: str) -> str:
+            return os.path.join(self.root, rel)
+
+        self.env_registry_path = env_registry_path or _default(
+            "dlrover_tpu/common/env_utils.py"
+        )
+        self.chaos_sites_path = chaos_sites_path or _default(
+            "dlrover_tpu/chaos/sites.py"
+        )
+        self.messages_path = messages_path or _default(
+            "dlrover_tpu/common/messages.py"
+        )
+        self.servicer_path = servicer_path or _default(
+            "dlrover_tpu/master/servicer.py"
+        )
+        self.durable_modules = durable_modules or self.DEFAULT_DURABLE_MODULES
+        self._cache: Dict[str, object] = {}
+
+    @classmethod
+    def default(cls) -> "Project":
+        """Project rooted at the repo containing this tools/ package."""
+        here = os.path.dirname(os.path.abspath(__file__))
+        return cls(os.path.dirname(os.path.dirname(here)))
+
+    def is_path(self, path: str, contract_path: str) -> bool:
+        return os.path.abspath(path) == os.path.abspath(contract_path)
+
+    def is_durable_module(self, path: str) -> bool:
+        norm = os.path.abspath(path).replace(os.sep, "/")
+        for suffix in self.durable_modules:
+            if suffix.endswith("/"):
+                if ("/" + suffix) in norm + "/":
+                    return True
+            elif norm.endswith("/" + suffix):
+                return True
+        return False
+
+    # ---------------- env registry ----------------
+    def declared_env_vars(self) -> Dict[str, int]:
+        """name -> declaration line in the registry module."""
+        if "env" not in self._cache:
+            declared: Dict[str, int] = {}
+            tree = _parse_file(self.env_registry_path)
+            if tree is not None:
+                for node in ast.walk(tree):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _ENV_DECL_KINDS
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                    ):
+                        declared[node.args[0].value] = node.lineno
+            self._cache["env"] = declared
+        return self._cache["env"]  # type: ignore[return-value]
+
+    # ---------------- chaos sites ----------------
+    def chaos_sites(self) -> Set[str]:
+        if "sites" not in self._cache:
+            sites: Set[str] = set()
+            tree = _parse_file(self.chaos_sites_path)
+            if tree is not None:
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.ClassDef):
+                        for stmt in node.body:
+                            if (
+                                isinstance(stmt, ast.Assign)
+                                and isinstance(stmt.value, ast.Constant)
+                                and isinstance(stmt.value.value, str)
+                            ):
+                                sites.add(stmt.value.value)
+            self._cache["sites"] = sites
+        return self._cache["sites"]  # type: ignore[return-value]
+
+    # ---------------- RPC contract ----------------
+    def rpc_contract(self) -> Dict[str, object]:
+        """Parsed message classes and servicer dispatch tables.
+
+        Returns a dict with:
+          ``requests``: {class_name: lineno} for BaseRequest subclasses;
+          ``journaled_marks``: {class_name} carrying ``journaled = True``;
+          ``dispatch_marks``: {class_name} carrying ``journaled = "..."``
+          (apply-then-log);
+          ``handlers``: {class_name} keys of ``MasterServicer._HANDLERS``;
+          ``journaled_tuple`` / ``apply_then_log_tuple``: member names of
+          the servicer's ``_JOURNALED`` / ``_APPLY_THEN_LOG`` tuples.
+        """
+        if "rpc" not in self._cache:
+            requests: Dict[str, int] = {}
+            journaled_marks: Set[str] = set()
+            dispatch_marks: Set[str] = set()
+            tree = _parse_file(self.messages_path)
+            if tree is not None:
+                for node in tree.body:
+                    if not isinstance(node, ast.ClassDef):
+                        continue
+                    bases = {
+                        b.id for b in node.bases if isinstance(b, ast.Name)
+                    }
+                    if "BaseRequest" not in bases:
+                        continue
+                    requests[node.name] = node.lineno
+                    for stmt in node.body:
+                        if (
+                            isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.targets[0], ast.Name)
+                            and stmt.targets[0].id == "journaled"
+                            and isinstance(stmt.value, ast.Constant)
+                        ):
+                            if stmt.value.value is True:
+                                journaled_marks.add(node.name)
+                            elif stmt.value.value:
+                                dispatch_marks.add(node.name)
+
+            handlers: Dict[str, int] = {}
+            journaled_tuple: Dict[str, int] = {}
+            apply_then_log_tuple: Dict[str, int] = {}
+            tree = _parse_file(self.servicer_path)
+            if tree is not None:
+                for node in tree.body:
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    target = node.targets[0]
+                    tname = None
+                    if isinstance(target, ast.Name):
+                        tname = target.id
+                    elif isinstance(target, ast.Attribute):
+                        tname = target.attr
+                    if tname == "_HANDLERS" and isinstance(node.value, ast.Dict):
+                        for key in node.value.keys:
+                            name = _tail_name(key)
+                            if name:
+                                handlers[name] = key.lineno
+                    elif tname in ("_JOURNALED", "_APPLY_THEN_LOG") and isinstance(
+                        node.value, ast.Tuple
+                    ):
+                        out = (
+                            journaled_tuple
+                            if tname == "_JOURNALED"
+                            else apply_then_log_tuple
+                        )
+                        for elt in node.value.elts:
+                            name = _tail_name(elt)
+                            if name:
+                                out[name] = elt.lineno
+            self._cache["rpc"] = {
+                "requests": requests,
+                "journaled_marks": journaled_marks,
+                "dispatch_marks": dispatch_marks,
+                "handlers": handlers,
+                "journaled_tuple": journaled_tuple,
+                "apply_then_log_tuple": apply_then_log_tuple,
+            }
+        return self._cache["rpc"]  # type: ignore[return-value]
+
+
+def _tail_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None

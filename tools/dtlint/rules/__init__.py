@@ -1,0 +1,36 @@
+"""Rule registry. Each rule encodes one invariant; see the rule module
+docstrings (and docs/static_analysis.md) for the bug class and the PR
+that paid for it."""
+
+from tools.dtlint.rules.dt001_swallowed_exception import SwallowedException
+from tools.dtlint.rules.dt002_blocking_under_lock import BlockingUnderLock
+from tools.dtlint.rules.dt003_busy_poll import BusyPoll
+from tools.dtlint.rules.dt004_toctou import Toctou
+from tools.dtlint.rules.dt005_atomic_write import NonAtomicDurableWrite
+from tools.dtlint.rules.dt006_env_registry import EnvRegistryRule
+from tools.dtlint.rules.dt007_chaos_sites import ChaosSiteRegistry
+from tools.dtlint.rules.dt008_rpc_contract import RpcContract
+
+
+class Rule:
+    """Base: a rule yields Findings for one FileContext + Project."""
+
+    id = ""
+    title = ""
+
+    def check(self, ctx, project):
+        raise NotImplementedError
+
+
+ALL_RULES = (
+    SwallowedException(),
+    BlockingUnderLock(),
+    BusyPoll(),
+    Toctou(),
+    NonAtomicDurableWrite(),
+    EnvRegistryRule(),
+    ChaosSiteRegistry(),
+    RpcContract(),
+)
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

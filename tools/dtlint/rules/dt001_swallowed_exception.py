@@ -1,0 +1,76 @@
+"""DT001 — swallowed exception.
+
+The bug class: a broad ``except Exception:`` (or a bare ``except:``)
+whose body silently discards the error. PR 4 found a kwarg-shadowing
+``TypeError`` inside such a handler that *silently disabled chaos
+injection* — the drill reported green while injecting nothing. An error
+that is deliberately absorbed must either be narrowed to the expected
+exception types, logged, or carry a ``# dtlint: disable=DT001 -- <why>``
+documenting the never-raise contract (e.g. ``events.emit``).
+
+Fires on:
+
+- a bare ``except:`` with no bare ``raise`` in its body (it eats
+  ``KeyboardInterrupt``/``SystemExit`` too);
+- ``except Exception:`` / ``except BaseException:`` (alone or in a
+  tuple) whose body is pure control flow — only ``pass`` / ``...`` /
+  ``continue`` / ``break`` — i.e. nothing is logged, raised, returned,
+  or recorded.
+"""
+
+import ast
+
+from tools.dtlint.core import Finding
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(type_node) -> bool:
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(elt) for elt in type_node.elts)
+    return False
+
+
+def _body_is_silent(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def _has_bare_raise(body) -> bool:
+    for node in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+        if isinstance(node, ast.Raise) and node.exc is None:
+            return True
+    return False
+
+
+class SwallowedException:
+    id = "DT001"
+    title = "swallowed exception (broad catch, nothing logged or re-raised)"
+
+    def check(self, ctx, project):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                if not _has_bare_raise(node.body):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        "bare 'except:' swallows KeyboardInterrupt/"
+                        "SystemExit; catch a concrete exception type or "
+                        "re-raise",
+                    )
+                continue
+            if _is_broad(node.type) and _body_is_silent(node.body):
+                yield Finding(
+                    self.id, ctx.path, node.lineno, node.col_offset,
+                    "'except Exception: pass' silently swallows the "
+                    "error; narrow the type, log it, or document the "
+                    "never-raise contract with a disable+reason",
+                )

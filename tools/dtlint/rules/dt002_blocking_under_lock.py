@@ -1,0 +1,89 @@
+"""DT002 — blocking or emitting while holding a lock.
+
+The bug class: PR 4 had to move rendezvous event emission outside the
+rdzv lock — ``emit()`` can take the master's journal lock, so emitting
+under the rdzv lock couples two lock domains (deadlock risk) and makes
+every waiter pay for observability I/O. The same applies to sleeping,
+file I/O, and RPC round-trips: nothing that can block on the outside
+world belongs inside a ``with <lock>:`` body.
+
+Detection is lexical: a ``with`` statement whose context expression's
+last dotted component contains ``lock`` (``self._lock``,
+``store.mutation_lock``, ``cls._instance_lock``…), scanned without
+descending into nested function definitions (those bodies run later,
+when the lock is not held). Flagged calls:
+
+- ``time.sleep`` / any ``*.sleep(...)`` (incl. backoff sleeps);
+- ``open(...)`` / ``os.open`` (file I/O);
+- ``emit(...)`` / ``*.emit(...)`` (event-bus emission);
+- ``poll_until(...)`` (a whole poll loop under a lock);
+- ``<client|rpc|stub>.call(...)`` (RPC round-trip).
+
+Sites where holding the lock *is* the contract (e.g. the WAL append
+under the state store's mutation lock — write-ahead ordering requires
+it) carry a documented suppression.
+"""
+
+import ast
+
+from tools.dtlint.core import Finding, dotted_name, walk_no_functions
+
+_LOCKY = ("lock",)
+
+
+def _is_lock_expr(expr) -> bool:
+    name = dotted_name(expr)
+    if not name:
+        return False
+    tail = name.rsplit(".", 1)[-1].lower()
+    return any(marker in tail for marker in _LOCKY)
+
+
+def _blocking_reason(call: ast.Call) -> str:
+    name = dotted_name(call.func)
+    if not name:
+        return ""
+    tail = name.rsplit(".", 1)[-1]
+    if tail == "sleep":
+        return f"'{name}' sleeps"
+    if name in ("open", "os.open", "io.open"):
+        return f"'{name}' does file I/O"
+    if tail == "emit":
+        return f"'{name}' emits into the event bus (may take other locks)"
+    if tail == "poll_until":
+        return f"'{name}' runs a poll loop"
+    if tail == "call" and name != "call":
+        receiver = name.rsplit(".", 1)[0].lower()
+        if any(k in receiver for k in ("client", "rpc", "stub", "master")):
+            return f"'{name}' is an RPC round-trip"
+    return ""
+
+
+class BlockingUnderLock:
+    id = "DT002"
+    title = "blocking call or event emission inside a lock body"
+
+    def check(self, ctx, project):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(_is_lock_expr(i.context_expr) for i in node.items):
+                continue
+            lock_desc = next(
+                dotted_name(i.context_expr)
+                for i in node.items
+                if _is_lock_expr(i.context_expr)
+            )
+            for stmt in node.body:
+                for child in walk_no_functions(stmt):
+                    if not isinstance(child, ast.Call):
+                        continue
+                    reason = _blocking_reason(child)
+                    if reason:
+                        yield Finding(
+                            self.id, ctx.path, child.lineno, child.col_offset,
+                            f"{reason} while holding '{lock_desc}'; move it "
+                            "outside the lock body",
+                        )
+                # direct statements too, e.g. `with a: with b: ...` is
+                # covered because ast.walk visits the inner With itself

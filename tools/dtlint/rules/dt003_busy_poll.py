@@ -1,0 +1,55 @@
+"""DT003 — busy-poll loop.
+
+The bug class: fixed-interval ``while ...: time.sleep(k)`` polling. PR 2
+replaced these with jittered exponential backoff (``ExponentialBackoff``
+/ ``poll_until`` in ``common/backoff.py``) because N workers polling one
+slow master/storage at a fixed interval synchronize into a thundering
+herd. A loop that waits for a condition must either use the backoff
+helpers, wait on an ``Event``/``Condition`` (``stop.wait(t)`` is
+interruptible; ``time.sleep(t)`` is not), or document why a fixed
+cadence is the contract.
+
+Fires on any direct ``time.sleep(...)`` lexically inside a ``while``
+body (nested function bodies and nested loops are judged on their own).
+Backoff sleeps (``backoff.sleep(...)``) and event waits
+(``stop.wait(...)``) do not fire.
+"""
+
+import ast
+
+from tools.dtlint.core import Finding, dotted_name
+
+
+def _scan_body(body, *, findings, ctx, rule_id):
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+             ast.While, ast.For, ast.AsyncFor),
+        ):
+            continue  # nested scopes/loops are judged independently
+        if isinstance(node, ast.Call) and dotted_name(node.func) == "time.sleep":
+            findings.append(Finding(
+                rule_id, ctx.path, node.lineno, node.col_offset,
+                "'time.sleep' inside a while loop is a fixed-interval "
+                "busy-poll; use ExponentialBackoff/poll_until or an "
+                "interruptible Event.wait",
+            ))
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class BusyPoll:
+    id = "DT003"
+    title = "busy-poll: while + time.sleep instead of backoff/event wait"
+
+    def check(self, ctx, project):
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+                _scan_body(
+                    node.body + node.orelse,
+                    findings=findings, ctx=ctx, rule_id=self.id,
+                )
+        yield from findings

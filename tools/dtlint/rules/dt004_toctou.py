@@ -1,0 +1,73 @@
+"""DT004 — TOCTOU: exists-check followed by open on the same path.
+
+The bug class: ``os.path.exists(p)`` then ``open(p)``. Between the check
+and the open, checkpoint GC, quarantine, or a concurrent writer can
+remove/replace the file — exactly the race PR 5 removed from
+``PosixDiskStorage`` reads. The check also double-costs a stat on
+network filesystems. The fix is open-and-catch: attempt the open and
+handle ``FileNotFoundError``.
+
+Fires when, within one function scope (or module top level), a path
+expression is passed to ``os.path.exists``/``os.path.isfile`` and a
+*later* line passes the textually identical expression to ``open``.
+Existence checks that gate non-read decisions (mtime compares, cleanup,
+"has a previous run left state") don't involve an open and don't fire.
+"""
+
+import ast
+from typing import Dict, List
+
+from tools.dtlint.core import Finding, dotted_name
+
+_CHECKS = {"os.path.exists", "os.path.isfile", "op.exists", "op.isfile",
+           "path.exists", "path.isfile"}
+
+
+class Toctou:
+    id = "DT004"
+    title = "TOCTOU: os.path.exists/isfile then open on the same path"
+
+    def check(self, ctx, project):
+        scopes: List[ast.AST] = [ctx.tree]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node)
+        for scope in scopes:
+            yield from self._check_scope(scope, ctx)
+
+    def _iter_scope_calls(self, scope):
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # inner scopes checked separately
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_scope(self, scope, ctx):
+        checked: Dict[str, int] = {}  # path expr source -> check lineno
+        calls = sorted(
+            self._iter_scope_calls(scope), key=lambda c: (c.lineno, c.col_offset)
+        )
+        for call in calls:
+            name = dotted_name(call.func)
+            if name in _CHECKS and call.args:
+                try:
+                    src = ast.unparse(call.args[0])
+                except Exception:  # pragma: no cover - unparse is total on 3.9+
+                    continue
+                checked.setdefault(src, call.lineno)
+            elif name in ("open", "io.open") and call.args:
+                try:
+                    src = ast.unparse(call.args[0])
+                except Exception:  # pragma: no cover
+                    continue
+                check_line = checked.get(src)
+                if check_line is not None and check_line < call.lineno:
+                    yield Finding(
+                        self.id, ctx.path, call.lineno, call.col_offset,
+                        f"open({src}) raced against the exists/isfile "
+                        f"check on line {check_line}; open and catch "
+                        "FileNotFoundError instead",
+                    )

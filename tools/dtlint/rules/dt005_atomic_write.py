@@ -1,0 +1,84 @@
+"""DT005 — non-atomic write in a durable-state module.
+
+The bug class: ``open(path, "w")`` straight onto state/checkpoint
+paths. A crash mid-write leaves a torn file that a restarting process
+then trusts — PR 3/4 converted the master state store, trace export,
+and goodput artifact to the tmp + fsync + ``os.replace`` protocol, and
+PR 5 built the striped writer around the same commit step. Any new
+durable write must go through ``common/fsutil.atomic_write_*`` (or an
+equivalent tmp+replace sequence).
+
+Fires on write-mode ``open`` (``w``/``wb``/``x``/``xb``/``w+``…) inside
+the modules listed in ``Project.durable_modules``, unless:
+
+- the target expression mentions ``tmp`` (the tmp+replace pattern —
+  the subsequent ``os.replace`` is the commit point);
+- the enclosing function name contains ``atomic`` (it *is* a helper);
+- the mode is append (``a``/``ab``): journal/WAL appends are a
+  different protocol (framed records + torn-tail drop on read).
+"""
+
+import ast
+
+from tools.dtlint.core import Finding
+
+
+def _write_mode(call: ast.Call):
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if not isinstance(mode, str):
+        return None
+    if any(c in mode for c in ("w", "x", "+")):
+        return mode
+    return None
+
+
+class NonAtomicDurableWrite:
+    id = "DT005"
+    title = "non-atomic write-mode open in a durable-state module"
+
+    def check(self, ctx, project):
+        if not project.is_durable_module(ctx.path):
+            return
+        if ctx.path.replace("\\", "/").endswith("common/fsutil.py"):
+            return  # the atomic-write helpers themselves
+        func_for_line = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for line in range(node.lineno, node.end_lineno + 1):
+                    # innermost wins: later (nested) defs overwrite
+                    func_for_line[line] = node.name
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = node.func
+            is_open = (
+                isinstance(name, ast.Name) and name.id == "open"
+            ) or (
+                isinstance(name, ast.Attribute) and name.attr == "open"
+                and isinstance(name.value, ast.Name) and name.value.id == "io"
+            )
+            if not is_open or not node.args:
+                continue
+            mode = _write_mode(node)
+            if mode is None:
+                continue
+            try:
+                target_src = ast.unparse(node.args[0])
+            except Exception:  # pragma: no cover
+                target_src = ""
+            if "tmp" in target_src.lower():
+                continue
+            enclosing = func_for_line.get(node.lineno, "")
+            if "atomic" in enclosing.lower():
+                continue
+            yield Finding(
+                self.id, ctx.path, node.lineno, node.col_offset,
+                f"open({target_src}, {mode!r}) writes durable state "
+                "non-atomically; use common/fsutil.atomic_write_* "
+                "(tmp + fsync + os.replace)",
+            )

@@ -1,0 +1,56 @@
+"""DT006 — env-var registry: no DLROVER_TPU_* literals outside it.
+
+The bug class: 71 scattered ``DLROVER_TPU_*`` string reads across 24
+files, each hand-rolling its own default and type coercion. A typo'd
+name silently reads the default forever; two sites disagree on the
+default; nothing documents the knob. Every ``DLROVER_TPU_*`` variable
+is declared exactly once in the typed registry
+(``common/env_utils.py`` — name, type, default, doc), and every other
+module references the registry constant (``ENV.FOO`` /
+``ENV.FOO.name``), never the string.
+
+Fires on any ``DLROVER_TPU_*`` string literal outside the registry
+module: if the name is undeclared it is flagged as a likely typo; if
+declared, as a bypass of the registry constant. Docstrings are exempt
+(prose may name the variable).
+"""
+
+import ast
+import re
+
+from tools.dtlint.core import Finding
+
+_ENV_NAME_RE = re.compile(r"DLROVER_TPU_[A-Z0-9_]+")
+
+
+class EnvRegistryRule:
+    id = "DT006"
+    title = "DLROVER_TPU_* literal outside the typed env registry"
+
+    def check(self, ctx, project):
+        if project.is_path(ctx.path, project.env_registry_path):
+            return
+        declared = project.declared_env_vars()
+        doc_lines = ctx.docstring_lines()
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Constant) and isinstance(node.value, str)
+            ):
+                continue
+            if node.lineno in doc_lines:
+                continue
+            for name in _ENV_NAME_RE.findall(node.value):
+                if name in declared:
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        f"string literal for registered env var {name}; "
+                        "reference the registry constant from "
+                        "common/env_utils.py instead",
+                    )
+                else:
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        f"env var {name} is not declared in the registry "
+                        "(common/env_utils.py) — typo, or add a typed "
+                        "declaration with a doc string",
+                    )

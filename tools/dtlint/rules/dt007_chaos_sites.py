@@ -1,0 +1,62 @@
+"""DT007 — chaos-site registry: injection sites must be registered.
+
+The bug class: the injector matches fault-plan events to call sites by
+*string* name. A typo on either side — the instrumented call or the
+drill's plan — doesn't error, it silently never fires, and the drill
+reports green while injecting nothing (the exact failure mode PR 4
+fixed once already, via a swallowed TypeError). Site names live in one
+registry (``chaos/sites.py``); instrumented calls reference
+``ChaosSite.*`` constants, and the injector validates plan sites
+against the registry at arm time.
+
+Fires on a string-literal site argument to ``fault_hit(...)`` /
+``<injector>.hit(...)``: unknown names are flagged as typos, known
+names as bypasses of the ``ChaosSite`` constant.
+"""
+
+import ast
+
+from tools.dtlint.core import Finding, dotted_name
+
+
+class ChaosSiteRegistry:
+    id = "DT007"
+    title = "chaos site literal not from the ChaosSite registry"
+
+    def check(self, ctx, project):
+        if project.is_path(ctx.path, project.chaos_sites_path):
+            return
+        sites = project.chaos_sites()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            tail = name.rsplit(".", 1)[-1] if name else ""
+            if tail != "fault_hit" and not (
+                tail == "hit" and "inj" in name.lower()
+            ):
+                continue
+            site_arg = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "site":
+                    site_arg = kw.value
+            if not (
+                isinstance(site_arg, ast.Constant)
+                and isinstance(site_arg.value, str)
+            ):
+                continue  # a ChaosSite constant reference — the goal
+            site = site_arg.value
+            if site in sites:
+                yield Finding(
+                    self.id, ctx.path, site_arg.lineno, site_arg.col_offset,
+                    f"chaos site {site!r} passed as a string literal; "
+                    "use the ChaosSite constant so a rename cannot "
+                    "silently detach the drill",
+                )
+            else:
+                yield Finding(
+                    self.id, ctx.path, site_arg.lineno, site_arg.col_offset,
+                    f"chaos site {site!r} is not registered in "
+                    "chaos/sites.py — a typo here silently disables "
+                    "the drill",
+                )

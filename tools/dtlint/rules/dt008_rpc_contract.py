@@ -1,0 +1,84 @@
+"""DT008 — RPC contract: every request handled, every mutation journaled.
+
+The bug class: a message type without a servicer handler raises
+``unknown control message`` at the first real call (found in this PR:
+``ClusterVersionRequest`` shipped for five PRs with no handler), and a
+*mutating* RPC outside the journal path breaks the PR-3 exactly-once
+guarantee — a master failover would lose or double-apply it.
+
+The contract is declared on both sides and cross-checked statically:
+
+- ``common/messages.py``: every request subclasses ``BaseRequest``;
+  mutating requests carry ``journaled = True`` (write-ahead) or
+  ``journaled = "apply-then-log"`` (dispatch-style) as a plain class
+  attribute;
+- ``master/servicer.py``: ``_HANDLERS`` maps every request class;
+  ``_JOURNALED`` lists exactly the write-ahead classes and
+  ``_APPLY_THEN_LOG`` exactly the apply-then-log classes.
+
+Findings are anchored in whichever contract file is being linted, so
+one run over the package reports each mismatch exactly once.
+"""
+
+from tools.dtlint.core import Finding
+
+
+class RpcContract:
+    id = "DT008"
+    title = "RPC contract: handler coverage and journal/dedup path"
+
+    def check(self, ctx, project):
+        contract = project.rpc_contract()
+        requests = contract["requests"]
+        handlers = contract["handlers"]
+        journaled_marks = contract["journaled_marks"]
+        dispatch_marks = contract["dispatch_marks"]
+        journaled_tuple = contract["journaled_tuple"]
+        apply_then_log = contract["apply_then_log_tuple"]
+
+        if project.is_path(ctx.path, project.messages_path) and handlers:
+            for name, lineno in sorted(requests.items()):
+                if name not in handlers:
+                    yield Finding(
+                        self.id, ctx.path, lineno, 0,
+                        f"request {name} has no MasterServicer._HANDLERS "
+                        "entry; it raises 'unknown control message' at "
+                        "the first call",
+                    )
+            for name in sorted(journaled_marks - set(journaled_tuple)):
+                yield Finding(
+                    self.id, ctx.path, requests.get(name, 1), 0,
+                    f"{name} is declared journaled=True but missing from "
+                    "the servicer's _JOURNALED tuple; a master failover "
+                    "would lose or double-apply it",
+                )
+            for name in sorted(dispatch_marks - set(apply_then_log)):
+                yield Finding(
+                    self.id, ctx.path, requests.get(name, 1), 0,
+                    f"{name} is declared apply-then-log but missing from "
+                    "the servicer's _APPLY_THEN_LOG tuple",
+                )
+
+        if project.is_path(ctx.path, project.servicer_path) and requests:
+            for name, lineno in sorted(handlers.items()):
+                if name not in requests:
+                    yield Finding(
+                        self.id, ctx.path, lineno, 0,
+                        f"_HANDLERS key {name} is not a BaseRequest "
+                        "subclass in common/messages.py",
+                    )
+            for name, lineno in sorted(journaled_tuple.items()):
+                if name not in journaled_marks:
+                    yield Finding(
+                        self.id, ctx.path, lineno, 0,
+                        f"_JOURNALED member {name} is not declared "
+                        "journaled=True in common/messages.py; the "
+                        "journal contract must be visible on the message",
+                    )
+            for name, lineno in sorted(apply_then_log.items()):
+                if name not in dispatch_marks:
+                    yield Finding(
+                        self.id, ctx.path, lineno, 0,
+                        f"_APPLY_THEN_LOG member {name} is not declared "
+                        "journaled='apply-then-log' in common/messages.py",
+                    )
