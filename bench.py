@@ -935,6 +935,156 @@ def section_goodput():
     return out
 
 
+def section_rescale():
+    """In-place rescale vs full restart for the same 4->3 transition.
+
+    Single-process logical world (CPU-friendly): "world" is the accum
+    schedule's rank count, so a 4->3 shrink is exactly what the
+    RescaleEngine applies in place — retune the schedule, rebuild the
+    train step, transfer the live state. The restart arm pays the full
+    tax for the identical transition in a fresh subprocess: interpreter
+    + jax imports, model rebuild, restore from disk, recompile. Both
+    numbers are lower-is-better wall seconds; in-place must be strictly
+    cheaper or the plan RPC is pointless. The goodput ledger is fed the
+    same transition's events to show the downtime landing under the
+    dedicated ``rescale`` cause (not ``worker-failure``/restart)."""
+    import subprocess
+    import tempfile
+
+    import jax
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.accel import ParallelSpec
+    from dlrover_tpu.common import messages as msgs
+    from dlrover_tpu.common.batching import derive_accum_schedule
+    from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+    from dlrover_tpu.observability.events import EventKind, JobEvent
+    from dlrover_tpu.observability.goodput import GoodputLedger
+    from dlrover_tpu.train.checkpoint import FlashCheckpointer, StorageType
+    from dlrover_tpu.train.elastic_trainer import ElasticTrainer
+    from dlrover_tpu.train.rescale import RescaleEngine
+
+    gb, mb = 16, 4
+    cfg = GPTConfig.tiny()
+    model = GPT(cfg)
+    sample = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(0), (mb, cfg.max_seq_len), 0, cfg.vocab_size
+    ))
+
+    def token_loss(module, params, b):
+        return loss_fn(module.apply({"params": params}, b), b)
+
+    def batch_for(et):
+        return sample.repeat(
+            et.local_batch_size // sample.shape[0] or 1, axis=0
+        )[: et.local_batch_size]
+
+    out = {"transition": "4->3", "global_batch": gb, "micro_batch": mb}
+    td = tempfile.mkdtemp(prefix="bench_rescale_")
+    try:
+        et = ElasticTrainer(gb, mb, world_size=4, rank=0)
+        result = et.prepare(
+            model, optax.adamw(3e-4), sample, token_loss,
+            spec=ParallelSpec(data=1),
+        )
+        state = result.state
+        for _ in range(3):
+            state, metrics = result.train_step(state, batch_for(et))
+        float(metrics["loss"])
+        result.state = state
+        step0 = int(state["step"])
+        ck = FlashCheckpointer(td)
+        ck.save_checkpoint(step0, state, StorageType.DISK)
+        ck.wait_persisted(step0)
+        ck.close()
+
+        # ---- in-place arm: apply the shrink plan to the live loop ----
+        plan = msgs.RescalePlan(
+            plan_id=1, rdzv_name="elastic-training", old_round=0,
+            new_round=1, old_world={0: 4}, new_world={0: 3},
+            global_batch=gb, micro_batch=mb,
+            accum_counts=list(derive_accum_schedule(gb, mb, 3).counts),
+            snapshot_step=step0, status="issued",
+        )
+        engine = RescaleEngine(et)
+        t_plan = time.time()
+        tr = engine.apply(plan, state=state)
+        assert tr.ok, f"in-place rescale failed: {tr.error}"
+        out["rescale_in_place_s"] = round(tr.wall_s, 3)
+        # Prove the new world trains (and took the transition cheaply):
+        # same live state, new schedule, no disk restore.
+        state3, m3 = et.result.train_step(tr.state, batch_for(et))
+        float(m3["loss"])
+        assert int(state3["step"]) == step0 + 1
+        out["accum_counts_w3"] = list(plan.accum_counts)
+
+        # ---- restart arm: the identical transition, full tax ----
+        code = (
+            "import numpy as np, jax, optax\n"
+            "from dlrover_tpu.accel import ParallelSpec\n"
+            "from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn\n"
+            "from dlrover_tpu.train.elastic_trainer import ElasticTrainer\n"
+            "from dlrover_tpu.train.checkpoint import FlashCheckpointer\n"
+            "cfg = GPTConfig.tiny(); model = GPT(cfg)\n"
+            f"sample = np.zeros(({mb}, cfg.max_seq_len), dtype=np.int32)\n"
+            "def token_loss(module, params, b):\n"
+            "    return loss_fn(module.apply({'params': params}, b), b)\n"
+            f"et = ElasticTrainer({gb}, {mb}, world_size=3, rank=0)\n"
+            "res = et.prepare(model, optax.adamw(3e-4), sample,\n"
+            "                 token_loss, spec=ParallelSpec(data=1))\n"
+            f"ck = FlashCheckpointer({td!r})\n"
+            "step, state = ck.load_checkpoint(res.state)\n"
+            f"assert step == {step0}, step\n"
+            "b = np.zeros((et.local_batch_size, cfg.max_seq_len),\n"
+            "             dtype=np.int32)\n"
+            "state, metrics = res.train_step(state, b)\n"
+            "float(metrics['loss'])\n"
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("DLROVER_TPU_MASTER_ADDR", None)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env["PYTHONPATH"] = os.pathsep.join(
+            [repo] + [p for p in env.get("PYTHONPATH", "").split(
+                os.pathsep) if p and "axon" not in p]
+        )
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, timeout=600,
+        )
+        if r.returncode == 0:
+            out["restart_full_s"] = round(time.perf_counter() - t0, 3)
+            out["in_place_speedup_x"] = round(
+                out["restart_full_s"] / max(out["rescale_in_place_s"],
+                                            1e-6), 1
+            )
+        else:
+            log(f"bench[rescale]: restart arm rc={r.returncode} "
+                f"{r.stderr[-400:]}")
+
+        # ---- ledger attribution: the transition is its own cause ----
+        ledger = GoodputLedger(now=t_plan - 1.0)
+        ledger.note_step(step0, ts=t_plan - 0.5)
+        ledger.ingest(JobEvent(
+            kind=EventKind.RESCALE_PLAN, ts=t_plan,
+            args={"plan_id": 1, "new_world": 3},
+        ))
+        ledger.note_step(step0 + 1, ts=t_plan + tr.wall_s)
+        s = ledger.summary(now=t_plan + tr.wall_s)
+        out["goodput_rescale_downtime_s"] = round(
+            s["downtime_by_cause_s"].get("rescale", -1.0), 3
+        )
+        assert "rescale" in s["incidents_by_cause"], s
+    finally:
+        import shutil
+
+        shutil.rmtree(td, ignore_errors=True)
+    log(f"bench[rescale]: {out}")
+    return out
+
+
 def goodput_json_main(out_path=None) -> int:
     """``bench.py --goodput-json [PATH]`` — kill-injection drill whose
     artifact is the MASTER's own goodput ledger, not wall-clock ratios.
@@ -1041,8 +1191,9 @@ def main():
     # Most-load-bearing first: if the driver's time limit bites, the
     # budget guard sheds the tail sections, not the headline.
     default_sections = (
-        "small,large,llama,longctx,goodput,ckpt_io,opt_shard,medium"
-        if on_tpu else "small,goodput,ckpt_io,opt_shard"
+        "small,large,llama,longctx,goodput,ckpt_io,opt_shard,rescale,"
+        "medium"
+        if on_tpu else "small,goodput,ckpt_io,opt_shard,rescale"
     )
     sections = os.getenv(
         "DLROVER_TPU_BENCH_SECTIONS", default_sections
@@ -1080,6 +1231,8 @@ def main():
                 extra["ckpt_io"] = section_ckpt_io()
             elif name == "goodput":
                 extra["goodput"] = section_goodput()
+            elif name == "rescale":
+                extra["rescale"] = section_rescale()
         except Exception as e:
             import traceback
 

@@ -223,6 +223,23 @@ def make_train_step(module, optimizer, loss, mesh, rules,
     )
 
 
+def transfer_state(state, shardings):
+    """Move a LIVE train state onto new shardings (in-place rescale).
+
+    ``jax.device_put`` with a sharding destination is a layout move, not
+    a recompute: where the source and destination placements overlap the
+    runtime routes device-to-device copies directly, and only leaves
+    whose placement actually changed pay a transfer. Values are bitwise
+    preserved — resharding never changes the numbers, which is what lets
+    a rescale keep the loss trajectory exactly.
+    """
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda s, x: jax.device_put(x, s), shardings, state
+    )
+
+
 def auto_accelerate(
     module,
     optimizer,

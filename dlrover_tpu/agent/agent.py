@@ -80,6 +80,19 @@ class RendezvousOutcome:
         self.world_size = sum(world.values())
         self.rank_offset = sum(world[r] for r in ranks[: self.node_index])
 
+    def adopt(self, round_: int, world: Dict[int, int]):
+        """Re-derive this outcome for a new round/world without a
+        rendezvous (an in-place rescale transition)."""
+        self.round = round_
+        self.world = dict(world)
+        ranks = sorted(self.world)
+        self.node_index = ranks.index(self.node_rank)
+        self.num_nodes = len(ranks)
+        self.world_size = sum(self.world.values())
+        self.rank_offset = sum(
+            self.world[r] for r in ranks[: self.node_index]
+        )
+
 
 class MasterRendezvousHandler:
     """Rendezvous via master RPCs (parity: training.py:137)."""
@@ -477,8 +490,11 @@ class ElasticTrainingAgent:
                 logger.warning("master unreachable from monitor loop: %s", e)
                 continue
             if stale:
-                # A world member died (heartbeat/hang): flush the shm
-                # checkpoint and re-form without it.
+                if self._try_rescale_in_place(outcome):
+                    continue
+                # No in-place plan (rescale off, quorum lost, plan
+                # aborted...): flush the shm checkpoint and re-form
+                # without the dead member.
                 logger.info(
                     "round %s invalidated by a member death; re-forming",
                     outcome.round,
@@ -486,9 +502,109 @@ class ElasticTrainingAgent:
                 self._save_shm_to_storage()
                 return "membership_changed"
             if waiting > 0:
+                # A joiner is normally absorbed by a grow plan (which
+                # also stales our round); persistent waiters mean the
+                # coordinator declined — full restart.
+                if self._try_rescale_in_place(outcome):
+                    continue
                 self._save_shm_to_storage()
                 return "membership_changed"
         return "stopped"
+
+    def _try_rescale_in_place(self, outcome: RendezvousOutcome) -> bool:
+        """Stale round: wait for a rescale plan covering this node and
+        for it to settle. The workers apply the plan themselves (their
+        trainers poll the same RPC and re-shard live state); the agent
+        only keeps them alive and adopts the new round. Returns True
+        when the transition completed and monitoring should continue."""
+        if not env_utils.RESCALE.get():
+            return False
+        interval = max(0.05, env_utils.RESCALE_POLL_INTERVAL_S.get())
+        deadline = (
+            time.monotonic() + env_utils.RESCALE_APPLY_TIMEOUT_S.get()
+        )
+        # Short grace for the plan to appear: the coordinator issues it
+        # in the same call that staled the round, so "no plan" after a
+        # few polls means it declined (full-restart fallback).
+        grace = time.monotonic() + max(3.0, 5 * interval)
+        plan = None
+        while not self._stopped.is_set() and time.monotonic() < deadline:
+            try:
+                found = self._client.get_rescale_plan(
+                    RendezvousName.TRAINING, self._config.node_rank,
+                    outcome.round,
+                )
+            except Exception as e:
+                logger.warning("rescale plan poll failed: %s", e)
+                return False
+            if found.exists:
+                plan = found
+                break
+            if time.monotonic() >= grace:
+                return False
+            self._stopped.wait(interval)
+        if plan is None:
+            return False
+        logger.info(
+            "rescale plan %s covers this node: world %s -> %s (round "
+            "%s -> %s); holding workers for in-place transition",
+            plan.plan_id, sorted(plan.old_world), sorted(plan.new_world),
+            plan.old_round, plan.new_round,
+        )
+        while not self._stopped.is_set() and time.monotonic() < deadline:
+            if any(
+                p.poll() not in (None, 0) for p in self._workers
+            ):
+                # A worker died mid-transition; let the failure path
+                # handle it on the next monitor pass.
+                return False
+            try:
+                aborted = self._client.world_stale(
+                    RendezvousName.TRAINING, plan.new_round
+                )
+                still = self._client.get_rescale_plan(
+                    RendezvousName.TRAINING, self._config.node_rank,
+                    outcome.round,
+                )
+            except Exception as e:
+                logger.warning("rescale settle poll failed: %s", e)
+                return False
+            if aborted:
+                logger.info(
+                    "rescale plan %s aborted (round %s stale); falling "
+                    "back to full restart", plan.plan_id, plan.new_round,
+                )
+                return False
+            if still.exists and still.plan_id != plan.plan_id:
+                # Superseded by a newer transition mid-apply.
+                plan = still
+                continue
+            if not still.exists:
+                # The plan settled between the two reads above — but an
+                # ABORT also makes it disappear, and the stale check ran
+                # first, so re-read it before trusting "completed".
+                try:
+                    if self._client.world_stale(
+                        RendezvousName.TRAINING, plan.new_round
+                    ):
+                        logger.info(
+                            "rescale plan %s aborted as it settled; "
+                            "falling back to full restart", plan.plan_id,
+                        )
+                        return False
+                except Exception as e:
+                    logger.warning("rescale settle re-check failed: %s", e)
+                    return False
+                # Settled and the new round is live: transition done.
+                outcome.adopt(plan.new_round, plan.new_world)
+                logger.info(
+                    "in-place rescale complete: now round %s, %s nodes, "
+                    "world size %s", outcome.round, outcome.num_nodes,
+                    outcome.world_size,
+                )
+                return True
+            self._stopped.wait(interval)
+        return False
 
     def _stop_workers(self, timeout: float = 15.0):
         for p in self._workers:

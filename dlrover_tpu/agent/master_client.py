@@ -140,6 +140,25 @@ class MasterClient:
             m.WorldStatusRequest(rdzv_name=rdzv_name, round=round_)
         ))
 
+    # ---------------- live rescale ----------------
+    def get_rescale_plan(self, rdzv_name: str, node_rank: int,
+                         round_: int) -> m.RescalePlan:
+        """Poll for an active in-place rescale plan covering this node
+        (``plan.exists`` is False when there is none)."""
+        return self._call(
+            m.RescalePlanRequest(
+                rdzv_name=rdzv_name, node_rank=node_rank, round=round_,
+            )
+        )
+
+    def report_rescale_ack(self, plan_id: int, node_rank: int,
+                           ok: bool, error: str = ""):
+        return self._call(
+            m.RescaleAck(
+                plan_id=plan_id, node_rank=node_rank, ok=ok, error=error,
+            )
+        )
+
     def report_rdzv_params(self, min_nodes: int, max_nodes: int,
                            waiting_timeout: float, node_unit: int):
         return self._call(

@@ -38,6 +38,12 @@ class ChaosSite:
     MASTER_CRASH = "master.crash"
     #: Lockdep drill marker: named acquisitions in lock-order tests.
     LOCKDEP_ACQUIRE = "lockdep.acquire"
+    #: RescaleCoordinator.get_plan, before answering a survivor's poll
+    #: (drop/delay), detail = "plan{id}:rank{n}".
+    RESCALE_PLAN_DELIVER = "rescale.plan.deliver"
+    #: Worker transition engine, before re-sharding live state onto the
+    #: new mesh (abort/delay), detail = "plan{id}".
+    RESCALE_TRANSFER = "rescale.transfer"
     #: Reserved for unit drills of the injector mechanics themselves
     #: (schedules, journaling): never instrumented in product code.
     TEST_PROBE = "test.probe"

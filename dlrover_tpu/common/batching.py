@@ -1,0 +1,84 @@
+"""Global-batch → per-rank accumulation schedules for uneven worlds.
+
+The pre-rescale trainer demanded ``global_batch % (micro_batch * world) == 0``
+and derived one uniform ``accum_steps`` from it — which makes a 4→3 shrink
+impossible without changing the global batch (and therefore the training
+math). The live rescale plane instead derives a *schedule*:
+
+- ``micro_eff``: the largest divisor of ``global_batch`` that is ≤ the
+  configured micro batch and still leaves at least one microbatch per rank.
+  ``micro_eff == 1`` always qualifies when ``global_batch >= world``, so the
+  only truly unsatisfiable configs are ``global_batch < world`` (someone
+  would train on zero samples) and non-positive inputs.
+- ``total_micros = global_batch // micro_eff`` microbatches per step. This
+  count depends only on (global_batch, micro_batch) — **not** on the world —
+  which is what makes the optimizer math world-independent: every world
+  partitions the same fixed sequence of microbatches.
+- ``counts[rank]``: microbatches per rank; the ``total_micros % world``
+  remainder goes to the lowest ranks, deterministically, so a 4→3→4
+  transition lands back on the exact original schedule.
+"""
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass(frozen=True)
+class AccumSchedule:
+    """Per-rank microbatch schedule for one optimizer step."""
+
+    global_batch: int
+    #: effective per-microbatch size actually used (≤ configured micro batch)
+    micro_batch: int
+    world: int
+    #: microbatches per rank, ``len(counts) == world``; sums to total_micros
+    counts: List[int] = field(default_factory=list)
+
+    @property
+    def total_micros(self) -> int:
+        return self.global_batch // self.micro_batch
+
+    def count_for(self, rank: int) -> int:
+        return self.counts[rank]
+
+    def samples_for(self, rank: int) -> int:
+        return self.counts[rank] * self.micro_batch
+
+    @property
+    def max_count(self) -> int:
+        """The per-step critical path (ranks with fewer microbatches idle)."""
+        return max(self.counts)
+
+
+def derive_accum_schedule(
+    global_batch: int, micro_batch: int, world: int
+) -> AccumSchedule:
+    """Derive the deterministic per-rank accumulation schedule.
+
+    Raises ``ValueError`` only for truly unsatisfiable configs: non-positive
+    inputs or ``global_batch < world`` (a rank would get zero samples).
+    """
+    if global_batch <= 0 or micro_batch <= 0 or world <= 0:
+        raise ValueError(
+            "batch config must be positive, got global_batch=%s "
+            "micro_batch=%s world=%s" % (global_batch, micro_batch, world)
+        )
+    if global_batch < world:
+        raise ValueError(
+            "global_batch=%s cannot feed world=%s (a rank would train "
+            "on zero samples)" % (global_batch, world)
+        )
+    micro_eff = 1
+    for d in range(min(micro_batch, global_batch), 0, -1):
+        if global_batch % d == 0 and global_batch // d >= world:
+            micro_eff = d
+            break
+    total = global_batch // micro_eff
+    base, rem = divmod(total, world)
+    counts = [base + 1 if r < rem else base for r in range(world)]
+    return AccumSchedule(
+        global_batch=global_batch,
+        micro_batch=micro_eff,
+        world=world,
+        counts=counts,
+    )

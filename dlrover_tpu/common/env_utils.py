@@ -263,6 +263,30 @@ CHECK_EXERCISE_TIMEOUT = ENV.float(
     "Seconds one device-check exercise process may run before the node "
     "(or its partner) is called faulty.")
 
+# ---------------- live rescale plane ----------------
+RESCALE = ENV.bool(
+    "DLROVER_TPU_RESCALE", True,
+    "Enable the in-place rescale plane: on a membership change with a "
+    "surviving quorum the master issues a RescalePlan instead of letting "
+    "the fleet restart. 0/false/off forces the legacy full-restart path.")
+RESCALE_MIN_QUORUM = ENV.float(
+    "DLROVER_TPU_RESCALE_MIN_QUORUM", 0.5,
+    "Minimum surviving fraction of the old world required to rescale in "
+    "place; below it the transition falls back to a full restart.")
+RESCALE_MAX_SNAPSHOT_LAG = ENV.int(
+    "DLROVER_TPU_RESCALE_MAX_SNAPSHOT_LAG", 1,
+    "Maximum steps the newest shm snapshot may trail the live step for "
+    "grown/moved shards to hydrate from memory; staler aborts the plan.")
+RESCALE_APPLY_TIMEOUT_S = ENV.float(
+    "DLROVER_TPU_RESCALE_APPLY_TIMEOUT_S", 60.0,
+    "Seconds the master waits for every survivor's RescaleAck before "
+    "aborting the plan and invalidating the round (full-restart "
+    "fallback).")
+RESCALE_POLL_INTERVAL_S = ENV.float(
+    "DLROVER_TPU_RESCALE_POLL_INTERVAL_S", 0.2,
+    "Agent/worker poll interval for an active rescale plan after their "
+    "round goes stale.")
+
 # ---------------- fault injection / debug ----------------
 CHAOS = ENV.str(
     "DLROVER_TPU_CHAOS", "",

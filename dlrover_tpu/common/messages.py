@@ -284,6 +284,74 @@ class EventReport(BaseRequest):
     events: List = field(default_factory=list)
 
 
+# ---------------- live rescale plane ----------------
+
+
+@dataclass
+class RescalePlan:
+    """A master-issued in-place scale transition (old world → new world).
+
+    Issued by the RescaleCoordinator when a rendezvous round bump leaves a
+    surviving quorum, instead of killing the fleet: survivors re-shard live
+    state onto the new mesh and keep training. ``accum_counts`` is the
+    derived per-rank microbatch schedule preserving the exact global batch
+    across the transition (see ``common/batching.py``).
+    """
+
+    plan_id: int = -1
+    rdzv_name: str = ""
+    #: the round being superseded (the one the survivors were running)
+    old_round: int = -1
+    #: the round the plan installs; survivors adopt it without rejoining
+    new_round: int = -1
+    # node_rank -> local world size, before and after
+    old_world: Dict[int, int] = field(default_factory=dict)
+    new_world: Dict[int, int] = field(default_factory=dict)
+    global_batch: int = 0
+    #: effective micro batch of the derived schedule
+    micro_batch: int = 0
+    #: microbatches per new-world rank (dense, index = new rank order)
+    accum_counts: List[int] = field(default_factory=list)
+    #: newest global step known snapshotted to shm (freshness fence)
+    snapshot_step: int = -1
+    #: "issued" | "complete" | "aborted"
+    status: str = ""
+
+    @property
+    def exists(self) -> bool:
+        return self.plan_id >= 0
+
+
+@dataclass
+class RescalePlanRequest(BaseRequest):
+    """Poll for an active rescale plan covering this node's round.
+
+    Read-only: agents/workers poll it when their round goes stale to learn
+    whether to transition in place instead of tearing down.
+    """
+
+    rdzv_name: str = ""
+    node_rank: int = 0
+    round: int = 0
+
+
+@dataclass
+class RescaleAck(BaseRequest):
+    """A survivor's report that it applied (or failed to apply) a plan.
+
+    Journaled: the ack set decides whether the plan completes or aborts
+    (abort invalidates the round so survivors fall back to full restart),
+    and that decision must survive a master restart.
+    """
+
+    journaled = True
+
+    plan_id: int = -1
+    node_rank: int = 0
+    ok: bool = True
+    error: str = ""
+
+
 # ---------------- sync service ----------------
 
 

@@ -33,6 +33,7 @@ from dlrover_tpu.master.rendezvous import (
     DeviceCheckRendezvousManager,
     ElasticTrainingRendezvousManager,
 )
+from dlrover_tpu.master.rescale import RescaleCoordinator
 from dlrover_tpu.master.servicer import MasterServicer, create_master_service
 from dlrover_tpu.master.shard.task_manager import TaskManager
 from dlrover_tpu.master.state_store import MasterStateStore
@@ -105,6 +106,13 @@ class JobMaster:
             for mgr in self.rdzv_managers.values():
                 mgr.set_state_listener(self._journal_rdzv_state)
             self.observability.event_log.journal = self.state_store.append
+        # Live rescale plane: membership changes with a surviving quorum
+        # become in-place transitions (journaled RescalePlans) instead of
+        # full restarts.
+        self.rescale = RescaleCoordinator(
+            rdzv_managers=self.rdzv_managers,
+            state_store=self.state_store,
+        )
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             kv_store=self.kv_store,
@@ -115,6 +123,7 @@ class JobMaster:
             metric_collector=self.metric_collector,
             state_store=self.state_store,
             observability=self.observability,
+            rescale_coordinator=self.rescale,
         )
         self._server = create_master_service(port, self.servicer)
         self.port = self._server.port
@@ -181,6 +190,7 @@ class JobMaster:
             },
             "speed": self.speed_monitor.checkpoint(),
             "events": self.observability.event_log.export_state(),
+            "rescale": self.rescale.checkpoint(),
         }
 
     def _recover_state(self):
@@ -212,6 +222,7 @@ class JobMaster:
                     # Replays through the listeners, so the goodput
                     # ledger rebuilds its incident history too.
                     self.observability.event_log.restore_state(ev_state)
+                self.rescale.restore(state.get("rescale", {}))
             for rec in records:
                 try:
                     kind = rec[0]
@@ -244,6 +255,9 @@ class JobMaster:
                         self.observability.event_log.append(
                             ev, journal=False
                         )
+                    elif kind == "rescale":
+                        _, payload, ts = rec
+                        self.rescale.replay(payload)
                     else:
                         logger.warning("skipping unknown journal record %r",
                                        kind)
@@ -347,6 +361,7 @@ class JobMaster:
                     # stale report times re-arms detection instead of
                     # re-firing every pass.
                     self.speed_monitor.reset_worker_reports()
+                self.rescale.tick()
                 if self.state_store is not None:
                     self.state_store.maybe_snapshot(self._collect_state)
                 if not self.job_manager.all_nodes():
@@ -378,12 +393,19 @@ class JobMaster:
         self._apply_evict(node_id, reason)
 
     def _apply_evict(self, node_id: int, reason: str):
+        training = self.rdzv_managers.get(RendezvousName.TRAINING)
+        old_world = training.current_world() if training else {}
         self.job_manager.remove_node(node_id, reason)
         for mgr in self.rdzv_managers.values():
             mgr.remove_alive_node(node_id)
         self.task_manager.recover_worker_tasks(node_id)
         self.speed_monitor.remove_worker(node_id)
         self.metric_collector.remove_node(node_id)
+        if node_id in old_world:
+            # Survivors of the shrunken world may transition in place
+            # instead of restarting (no-op during journal replay and
+            # whenever the coordinator declines).
+            self.rescale.on_node_removed(node_id, old_world)
 
     def run(self, poll_interval: float = 1.0) -> int:
         """Block until the job finishes; returns an exit code."""

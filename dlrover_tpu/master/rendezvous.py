@@ -214,6 +214,49 @@ class RendezvousManager(ABC):
         with self._lock:
             return len(self._waiting_nodes)
 
+    def current_world(self) -> Dict[int, int]:
+        """The frozen world of the current round (empty while forming)."""
+        with self._lock:
+            return dict(self._rdzv_nodes)
+
+    def current_round(self) -> int:
+        """The newest round number (frozen or being formed). Lets the
+        rescale coordinator abort an obsolete plan without invalidating
+        a newer round that superseded it."""
+        with self._lock:
+            return self._rdzv_round
+
+    def absorb_world(self, world: Dict[int, int]) -> int:
+        """Install `world` as the next frozen round without a rendezvous.
+
+        The rescale coordinator's primitive: survivors of an in-place
+        transition adopt the returned round directly (via the plan RPC)
+        instead of rejoining, so the old stale round is superseded
+        without anyone tearing down. Members of `world` still sitting in
+        the waiting set (a grown node that joined normally) are absorbed
+        out of it. Every prior round is marked stale so survivors notice
+        the transition through the same world_stale() poll that detects
+        deaths — the plan RPC then tells them it is an in-place move.
+        """
+        with self._lock:
+            self._rdzv_nodes = dict(world)
+            for rank in world:
+                self._waiting_nodes.pop(rank, None)
+                self._alive_nodes.add(rank)
+            self._stale_round = max(self._stale_round, self._rdzv_round)
+            self._rdzv_round += 1
+            round_ = self._rdzv_round
+            logger.info(
+                "rdzv %s: absorbed world %s as round %s (in-place rescale)",
+                self.name, sorted(world), round_,
+            )
+        self._notify_state()
+        emit(
+            EventKind.RDZV_ROUND_COMPLETE, _role="master",
+            rdzv=self.name, round=round_, nodes=len(world), rescale=True,
+        )
+        return round_
+
     @abstractmethod
     def get_comm_world(
         self, node_rank: int

@@ -1,0 +1,507 @@
+"""Master-side live rescale plane: scale change without the restart tax.
+
+Before this coordinator every membership change paid the full
+kill → rendezvous → restore cycle even when most workers never failed
+(BENCH_r05's ``restart_breakdown``: spawn+init+restore+recompile is pure
+downtime). The rescale plane instead treats a round bump with a
+surviving quorum as a *transition*: the coordinator journals and issues
+a :class:`~dlrover_tpu.common.messages.RescalePlan` — old world → new
+world plus the derived per-rank accumulation schedule preserving the
+exact global batch — and installs the new world directly into the
+rendezvous manager (:meth:`absorb_world`). Survivors poll the plan when
+their round goes stale, re-shard live state in place (see
+``train/rescale.py``), and ack; the plan completes when every survivor
+acked, or aborts (round invalidated → legacy full restart) on the first
+failure or on timeout. Everything the decision depends on is journaled
+as ``("rescale", payload, ts)`` records so a relaunched master neither
+forgets an issued plan nor re-issues a completed one.
+"""
+
+import time
+from dataclasses import asdict
+from typing import Any, Callable, Dict, List, Optional
+
+from dlrover_tpu.chaos.injector import fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.batching import derive_accum_schedule
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.lockdep import instrumented_lock
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import EventKind, emit
+
+PLAN_ISSUED = "issued"
+PLAN_COMPLETE = "complete"
+PLAN_ABORTED = "aborted"
+
+
+def plan_survivors(plan: m.RescalePlan) -> List[int]:
+    """Ranks that live through the transition (must apply + ack)."""
+    return sorted(set(plan.old_world) & set(plan.new_world))
+
+
+class RescaleCoordinator:
+    """Decides, journals and tracks in-place scale transitions.
+
+    Wiring: the master calls :meth:`on_node_removed` from its eviction
+    path (shrink) and the servicer calls :meth:`on_node_joined` when a
+    new node joins an active training world (grow). Both fall back to
+    returning ``None`` — which leaves the legacy stale-round/full-restart
+    path in charge — whenever the transition is not safely expressible
+    in place: rescale disabled, quorum lost, batch config unknown, or
+    the schedule unsatisfiable.
+    """
+
+    def __init__(
+        self,
+        rdzv_managers: Optional[Dict[str, Any]] = None,
+        state_store=None,
+    ):
+        self._lock = instrumented_lock("master.rescale")
+        self._rdzv_managers = rdzv_managers or {}
+        self._store = state_store
+        self._plans: Dict[int, m.RescalePlan] = {}
+        # plan_id -> node_rank -> ok
+        self._acks: Dict[int, Dict[int, bool]] = {}
+        self._deadlines: Dict[int, float] = {}
+        self._next_plan_id = 1
+        self._global_batch = 0
+        self._micro_batch = 0
+        self._last_step = -1
+        # Node ranks that advertised a live RescaleEngine (wired into
+        # their training loop). A plan is only issued when EVERY
+        # survivor can actually apply it; otherwise the fleet would sit
+        # out the full apply timeout training on a stale world before
+        # falling back to the restart it could have taken immediately.
+        self._capable: set = set()
+
+    # ---------------- journal plumbing ----------------
+    @property
+    def _replaying(self) -> bool:
+        return self._store is not None and self._store.replaying
+
+    def _journal(self, payload: Dict[str, Any]):
+        if self._store is not None and not self._store.replaying:
+            self._store.append(("rescale", payload, time.time()))
+
+    # ---------------- live inputs ----------------
+    def set_batch_config(self, global_batch: int, micro_batch: int):
+        """Record the fleet's batch contract (journaled): without it no
+        accumulation schedule can be derived and every membership change
+        falls back to a full restart."""
+        with self._lock:
+            if (
+                self._global_batch == global_batch
+                and self._micro_batch == micro_batch
+            ):
+                return
+            self._global_batch = int(global_batch)
+            self._micro_batch = int(micro_batch)
+        self._journal({
+            "rec": "config",
+            "global_batch": int(global_batch),
+            "micro_batch": int(micro_batch),
+        })
+
+    def set_capable(self, node_rank: int):
+        """Record that a node's worker runs a live RescaleEngine
+        (journaled). The engine advertises on construction via
+        ``ModelInfo.extra["rescale_capable"]``; without the flag from
+        every survivor the coordinator declines to plan in place."""
+        with self._lock:
+            if node_rank in self._capable:
+                return
+            self._capable.add(node_rank)
+        self._journal({"rec": "capable", "node": int(node_rank)})
+
+    def note_step(self, step: int):
+        """Track the newest reported global step — the plan's
+        ``snapshot_step`` freshness fence (per-step shm snapshots mean
+        the newest snapshot is at most one step behind it)."""
+        with self._lock:
+            self._last_step = max(self._last_step, int(step))
+
+    # ---------------- transition triggers ----------------
+    def on_node_removed(
+        self,
+        node_rank: int,
+        old_world: Dict[int, int],
+        rdzv_name: str = RendezvousName.TRAINING,
+    ) -> Optional[m.RescalePlan]:
+        """Shrink path: a member of the active world died/was evicted.
+
+        Called after the rendezvous managers dropped the node (the old
+        round is already stale). Returns the issued plan, or ``None``
+        to leave the full-restart fallback in charge.
+        """
+        if self._replaying or not env_utils.RESCALE.get():
+            return None
+        if node_rank not in old_world:
+            return None
+        survivors = {
+            r: w for r, w in old_world.items() if r != node_rank
+        }
+        if not survivors:
+            return None
+        quorum = env_utils.RESCALE_MIN_QUORUM.get()
+        if len(survivors) / len(old_world) < quorum:
+            logger.info(
+                "rescale: %d/%d survivors below quorum %.2f; falling "
+                "back to full restart", len(survivors), len(old_world),
+                quorum,
+            )
+            return None
+        return self._issue_plan(
+            rdzv_name, old_world, survivors, transition="shrink"
+        )
+
+    def on_node_joined(
+        self, node_rank: int, local_world_size: int, rdzv_name: str
+    ) -> Optional[m.RescalePlan]:
+        """Grow path: a node joined while a frozen world is training.
+
+        The joiner is absorbed into the next round; it boots through the
+        normal worker path (it has no live state) and hydrates from the
+        shm snapshot, while survivors transition in place.
+        """
+        if self._replaying or not env_utils.RESCALE.get():
+            return None
+        if rdzv_name != RendezvousName.TRAINING:
+            return None
+        mgr = self._rdzv_managers.get(rdzv_name)
+        if mgr is None:
+            return None
+        old_world = mgr.current_world()
+        if not old_world or node_rank in old_world:
+            return None
+        with self._lock:
+            if any(
+                p.rdzv_name == rdzv_name and p.status == PLAN_ISSUED
+                for p in self._plans.values()
+            ):
+                # One transition at a time; the joiner waits in the
+                # rendezvous waiting set until the in-flight plan
+                # settles, then triggers again on its next join poll.
+                return None
+        new_world = dict(old_world)
+        new_world[node_rank] = local_world_size
+        return self._issue_plan(
+            rdzv_name, old_world, new_world, transition="grow"
+        )
+
+    def _issue_plan(
+        self,
+        rdzv_name: str,
+        old_world: Dict[int, int],
+        new_world: Dict[int, int],
+        transition: str,
+    ) -> Optional[m.RescalePlan]:
+        mgr = self._rdzv_managers.get(rdzv_name)
+        if mgr is None:
+            return None
+        with self._lock:
+            global_batch, micro_batch = self._global_batch, self._micro_batch
+            snapshot_step = self._last_step
+            incapable = sorted(
+                set(old_world) & set(new_world) - self._capable
+            )
+        if global_batch <= 0:
+            logger.info(
+                "rescale: no batch config reported; falling back to "
+                "full restart for the %s", transition,
+            )
+            return None
+        if incapable:
+            # Issuing a plan no survivor can apply would hold the fleet
+            # for the full apply timeout — training on a stale world —
+            # before the inevitable restart. Decline up front instead.
+            logger.info(
+                "rescale: survivors %s never advertised a live rescale "
+                "engine; falling back to full restart for the %s",
+                incapable, transition,
+            )
+            return None
+        total_procs = sum(new_world.values())
+        try:
+            sched = derive_accum_schedule(
+                global_batch, micro_batch, total_procs
+            )
+        except ValueError as e:
+            logger.info(
+                "rescale: schedule unsatisfiable (%s); falling back to "
+                "full restart", e,
+            )
+            return None
+        new_round = mgr.absorb_world(new_world)
+        superseded: List[m.RescalePlan] = []
+        with self._lock:
+            # A second membership change inside the apply window makes
+            # any in-flight plan obsolete: its round is already stale
+            # and survivors will pick up the newer plan instead. Abort
+            # it WITHOUT invalidating the round — that would fence the
+            # new plan's live round and force-restart a healthy world.
+            for old in self._plans.values():
+                if old.rdzv_name == rdzv_name and old.status == PLAN_ISSUED:
+                    old.status = PLAN_ABORTED
+                    self._deadlines.pop(old.plan_id, None)
+                    superseded.append(old)
+            plan = m.RescalePlan(
+                plan_id=self._next_plan_id,
+                rdzv_name=rdzv_name,
+                old_round=new_round - 1,
+                new_round=new_round,
+                old_world=dict(old_world),
+                new_world=dict(new_world),
+                global_batch=global_batch,
+                micro_batch=sched.micro_batch,
+                accum_counts=list(sched.counts),
+                snapshot_step=snapshot_step,
+                status=PLAN_ISSUED,
+            )
+            self._next_plan_id += 1
+            self._plans[plan.plan_id] = plan
+            self._acks[plan.plan_id] = {}
+            self._deadlines[plan.plan_id] = (
+                time.monotonic() + env_utils.RESCALE_APPLY_TIMEOUT_S.get()
+            )
+        for old in superseded:
+            self._journal({
+                "rec": "abort", "plan_id": old.plan_id,
+                "reason": "superseded",
+            })
+            logger.info(
+                "rescale plan %s superseded by plan %s before settling",
+                old.plan_id, plan.plan_id,
+            )
+            emit(
+                EventKind.RESCALE_ABORT, _role="master",
+                plan_id=old.plan_id, reason="superseded",
+            )
+        self._journal({"rec": "plan", "plan": asdict(plan)})
+        logger.info(
+            "rescale plan %s: %s %s -> %s (round %s -> %s, accum %s, "
+            "snapshot_step %s)", plan.plan_id, transition,
+            sorted(old_world), sorted(new_world), plan.old_round,
+            plan.new_round, plan.accum_counts, plan.snapshot_step,
+        )
+        emit(
+            EventKind.RESCALE_PLAN, _role="master",
+            plan_id=plan.plan_id, transition=transition,
+            old_world=sorted(old_world), new_world=sorted(new_world),
+            old_round=plan.old_round, new_round=plan.new_round,
+        )
+        return plan
+
+    # ---------------- delivery / acks ----------------
+    def get_plan(
+        self, rdzv_name: str, node_rank: int, round_: int
+    ) -> m.RescalePlan:
+        """Answer a survivor's poll: the newest issued plan that covers
+        it and supersedes the round it is running. A node that missed an
+        intermediate plan correctly applies only the newest one — the
+        transition engine re-shards from its *current* state, not from
+        ``plan.old_world``."""
+        best = m.RescalePlan()
+        with self._lock:
+            for plan in self._plans.values():
+                if (
+                    plan.rdzv_name == rdzv_name
+                    and plan.status == PLAN_ISSUED
+                    and node_rank in plan.new_world
+                    and plan.new_round > round_
+                    and plan.new_round > best.new_round
+                ):
+                    best = plan
+        if best.exists:
+            ev = fault_hit(
+                ChaosSite.RESCALE_PLAN_DELIVER,
+                detail=f"plan{best.plan_id}:rank{node_rank}",
+            )
+            if ev is not None:
+                if ev.kind == "delay":
+                    time.sleep(ev.delay_s)
+                elif ev.kind == "drop":
+                    return m.RescalePlan()
+        return best
+
+    def apply_ack(
+        self, plan_id: int, node_rank: int, ok: bool, error: str = ""
+    ) -> bool:
+        """Record one survivor's ack (reached via the journaled
+        ``RescaleAck`` RPC, so replay re-derives plan outcomes). All
+        survivors ok → complete; any failure → abort + invalidate the
+        round so survivors fall back to a full restart."""
+        aborted = completed = False
+        with self._lock:
+            plan = self._plans.get(plan_id)
+            if plan is None:
+                return False
+            if plan.status != PLAN_ISSUED:
+                # Late ack for a settled plan: acknowledged, no effect.
+                return True
+            self._acks[plan_id][node_rank] = ok
+            if not ok:
+                plan.status = PLAN_ABORTED
+                aborted = True
+            else:
+                acks = self._acks[plan_id]
+                if all(acks.get(r) for r in plan_survivors(plan)):
+                    plan.status = PLAN_COMPLETE
+                    completed = True
+            rdzv_name = plan.rdzv_name
+            new_round = plan.new_round
+        if self._replaying:
+            return True
+        if aborted:
+            logger.error(
+                "rescale plan %s aborted by node %s: %s; invalidating "
+                "round %s for full restart", plan_id, node_rank, error,
+                new_round,
+            )
+            emit(
+                EventKind.RESCALE_ABORT, _node_id=node_rank,
+                _role="master", plan_id=plan_id, reason=error or "nack",
+            )
+            self._invalidate_if_current(rdzv_name, new_round)
+        elif completed:
+            logger.info("rescale plan %s complete: every survivor "
+                        "transitioned in place", plan_id)
+            emit(
+                EventKind.RESCALE_COMPLETE, _role="master",
+                plan_id=plan_id, new_round=new_round,
+            )
+        return True
+
+    def tick(self):
+        """Periodic driver (master monitor loop): abort plans whose
+        survivors did not all ack within the apply timeout."""
+        if self._replaying:
+            return
+        now = time.monotonic()
+        expired: List[m.RescalePlan] = []
+        with self._lock:
+            for plan_id, deadline in list(self._deadlines.items()):
+                plan = self._plans.get(plan_id)
+                if plan is None or plan.status != PLAN_ISSUED:
+                    self._deadlines.pop(plan_id, None)
+                    continue
+                if now >= deadline:
+                    plan.status = PLAN_ABORTED
+                    self._deadlines.pop(plan_id, None)
+                    expired.append(plan)
+        for plan in expired:
+            self._journal({
+                "rec": "abort", "plan_id": plan.plan_id,
+                "reason": "apply-timeout",
+            })
+            logger.error(
+                "rescale plan %s timed out waiting for survivor acks; "
+                "invalidating round %s for full restart",
+                plan.plan_id, plan.new_round,
+            )
+            emit(
+                EventKind.RESCALE_ABORT, _role="master",
+                plan_id=plan.plan_id, reason="apply-timeout",
+            )
+            self._invalidate_if_current(plan.rdzv_name, plan.new_round)
+
+    def _invalidate_if_current(self, rdzv_name: str, new_round: int):
+        """Fence ``new_round`` for the full-restart fallback — but only
+        while it is still the rendezvous manager's newest round. A plan
+        that aborts after a newer plan already moved the world on must
+        not force-restart that healthy, already-transitioned round."""
+        mgr = self._rdzv_managers.get(rdzv_name)
+        if mgr is None:
+            return
+        current = getattr(mgr, "current_round", lambda: new_round)()
+        if current == new_round:
+            mgr.invalidate_round()
+        else:
+            logger.info(
+                "rescale: round %s already superseded by round %s; "
+                "skipping invalidation", new_round, current,
+            )
+
+    # ---------------- durability ----------------
+    def checkpoint(self) -> dict:
+        with self._lock:
+            return {
+                "plans": [asdict(p) for p in self._plans.values()],
+                "acks": {k: dict(v) for k, v in self._acks.items()},
+                "next_plan_id": self._next_plan_id,
+                "global_batch": self._global_batch,
+                "micro_batch": self._micro_batch,
+                "last_step": self._last_step,
+                "capable": sorted(self._capable),
+            }
+
+    def restore(self, state: dict):
+        if not state:
+            return
+        with self._lock:
+            for d in state.get("plans", []):
+                plan = m.RescalePlan(**d)
+                self._plans[plan.plan_id] = plan
+                # A plan in flight across a master relaunch gets a fresh
+                # apply window rather than an instant timeout-abort.
+                if plan.status == PLAN_ISSUED:
+                    self._deadlines[plan.plan_id] = (
+                        time.monotonic()
+                        + env_utils.RESCALE_APPLY_TIMEOUT_S.get()
+                    )
+            for pid, acks in state.get("acks", {}).items():
+                self._acks[int(pid)] = {
+                    int(r): bool(ok) for r, ok in acks.items()
+                }
+            self._next_plan_id = max(
+                self._next_plan_id, int(state.get("next_plan_id", 1))
+            )
+            self._global_batch = int(
+                state.get("global_batch", self._global_batch)
+            )
+            self._micro_batch = int(
+                state.get("micro_batch", self._micro_batch)
+            )
+            self._last_step = max(
+                self._last_step, int(state.get("last_step", -1))
+            )
+            self._capable.update(
+                int(r) for r in state.get("capable", [])
+            )
+
+    def replay(self, payload: Dict[str, Any]):
+        """Re-apply one journaled ``("rescale", payload, ts)`` record.
+
+        Pure bookkeeping — no emits, no rendezvous side effects: the
+        rendezvous round counters replay through their own ``rdzv``
+        records and events through ``event`` records.
+        """
+        rec = payload.get("rec")
+        if rec == "config":
+            with self._lock:
+                self._global_batch = int(payload.get("global_batch", 0))
+                self._micro_batch = int(payload.get("micro_batch", 0))
+        elif rec == "plan":
+            with self._lock:
+                plan = m.RescalePlan(**payload["plan"])
+                self._plans[plan.plan_id] = plan
+                self._acks.setdefault(plan.plan_id, {})
+                self._next_plan_id = max(
+                    self._next_plan_id, plan.plan_id + 1
+                )
+                if plan.status == PLAN_ISSUED:
+                    self._deadlines[plan.plan_id] = (
+                        time.monotonic()
+                        + env_utils.RESCALE_APPLY_TIMEOUT_S.get()
+                    )
+        elif rec == "capable":
+            with self._lock:
+                self._capable.add(int(payload.get("node", -1)))
+        elif rec == "abort":
+            with self._lock:
+                plan = self._plans.get(int(payload.get("plan_id", -1)))
+                if plan is not None:
+                    plan.status = PLAN_ABORTED
+        else:
+            logger.warning("skipping unknown rescale record %r", rec)

@@ -35,6 +35,9 @@ _JOURNALED = (
     # Forwarded event batches are state: the timeline must survive a
     # master failover, and a retried batch must land exactly once.
     m.EventReport,
+    # Rescale acks decide plan completion vs abort; the outcome must
+    # survive a master failover (replay re-derives it).
+    m.RescaleAck,
 )
 
 #: Mutating messages journaled AFTER their handler runs: the record must
@@ -58,6 +61,7 @@ class MasterServicer:
         metric_collector=None,
         state_store=None,
         observability=None,
+        rescale_coordinator=None,
     ):
         self._rdzv_managers = rdzv_managers
         self._kv_store = kv_store
@@ -68,6 +72,7 @@ class MasterServicer:
         self._metric_collector = metric_collector
         self._state_store = state_store
         self._observability = observability
+        self._rescale = rescale_coordinator
         self._paral_config = m.ParallelConfig()
         self._job_exit = None
         self._start_time = time.time()
@@ -117,9 +122,17 @@ class MasterServicer:
     # ---------------- rendezvous ----------------
     def _join_rendezvous(self, req: m.JoinRendezvous):
         mgr = self._rdzv_managers[req.rdzv_name]
+        active = mgr.current_world()
         round_ = mgr.join_rendezvous(req.node_rank, req.local_world_size)
         if req.rdzv_name == RendezvousName.TRAINING and self._job_manager:
             self._job_manager.report_heartbeat(req.node_id, time.time())
+        if self._rescale is not None and active and req.node_rank not in active:
+            # A node joining an actively-training world: grow in place
+            # instead of making survivors restart (no-op fallback when
+            # the coordinator declines).
+            self._rescale.on_node_joined(
+                req.node_rank, req.local_world_size, req.rdzv_name
+            )
         return round_
 
     def _get_comm_world(self, req: m.CommWorldRequest):
@@ -134,6 +147,20 @@ class MasterServicer:
 
     def _world_status(self, req: m.WorldStatusRequest):
         return self._rdzv_managers[req.rdzv_name].world_stale(req.round)
+
+    # ---------------- live rescale ----------------
+    def _get_rescale_plan(self, req: m.RescalePlanRequest):
+        if self._rescale is None:
+            return m.RescalePlan()
+        return self._rescale.get_plan(req.rdzv_name, req.node_rank, req.round)
+
+    def _rescale_ack(self, req: m.RescaleAck):
+        if self._rescale is None:
+            return m.Response(success=False, reason="rescale disabled")
+        ok = self._rescale.apply_ack(
+            req.plan_id, req.node_rank, req.ok, req.error
+        )
+        return m.Response(success=ok)
 
     def _update_rdzv_params(self, req: m.RendezvousParams):
         for mgr in self._rdzv_managers.values():
@@ -232,6 +259,10 @@ class MasterServicer:
             self._observability.note_step(
                 req.step, req.timestamp or time.time()
             )
+        if self._rescale is not None:
+            # Freshness fence for plan snapshots: per-step shm snapshots
+            # mean the newest one trails this by at most one step.
+            self._rescale.note_step(req.step)
         if self._metric_collector:
             # Training-speed history feeds the Brain's completion-time
             # prediction (brain/algorithms.py::completion_time).
@@ -261,6 +292,20 @@ class MasterServicer:
     def _report_model_info(self, req: m.ModelInfo):
         if self._metric_collector:
             self._metric_collector.collect_model_info(req)
+        if self._rescale is not None and req.extra.get("rescale_capable"):
+            # A live RescaleEngine advertises itself on construction;
+            # the coordinator only plans in place when every survivor
+            # has one (a plan nobody can apply just burns the apply
+            # timeout before the same restart).
+            self._rescale.set_capable(req.node_id)
+        if self._rescale is not None and req.extra.get("global_batch"):
+            # The trainer advertises its batch contract here; the
+            # coordinator journals it (its own "rescale" record — this
+            # RPC is not journaled) so plans survive a master relaunch.
+            self._rescale.set_batch_config(
+                req.extra["global_batch"],
+                req.extra.get("micro_batch", 1),
+            )
         return m.Response()
 
     def _report_failure(self, req: m.NodeFailure):
@@ -275,10 +320,17 @@ class MasterServicer:
             self._job_manager.process_error(
                 req.node_id, req.restart_count, req.error_data, req.level
             )
+        training = self._rdzv_managers.get(RendezvousName.TRAINING)
+        old_world = training.current_world() if training else {}
         for mgr in self._rdzv_managers.values():
             mgr.remove_alive_node(req.node_id)
         if self._task_manager:
             self._task_manager.recover_worker_tasks(req.node_id)
+        if self._rescale is not None and req.node_id in old_world:
+            # This path bypasses the master's _evict_node (the agent
+            # reported the failure directly): give the coordinator the
+            # same shot at an in-place shrink for the survivors.
+            self._rescale.on_node_removed(req.node_id, old_world)
         return m.Response()
 
     def _report_events(self, req: m.EventReport):
@@ -346,6 +398,8 @@ MasterServicer._HANDLERS = {
     m.CommWorldRequest: MasterServicer._get_comm_world,
     m.WaitingNodeNumRequest: MasterServicer._num_nodes_waiting,
     m.WorldStatusRequest: MasterServicer._world_status,
+    m.RescalePlanRequest: MasterServicer._get_rescale_plan,
+    m.RescaleAck: MasterServicer._rescale_ack,
     m.RendezvousParams: MasterServicer._update_rdzv_params,
     m.DeviceCheckResult: MasterServicer._report_check_result,
     m.FaultNodesRequest: MasterServicer._get_fault_nodes,

@@ -53,6 +53,12 @@ class EventKind:
     CKPT_IO = "ckpt.io"
     CHAOS_INJECT = "chaos.inject"
     STEP_PROGRESS = "step.progress"
+    # Live rescale plane: plan issued (master), survivor applying /
+    # applied in place (worker), plan aborted → fall back to restart.
+    RESCALE_PLAN = "rescale.plan"
+    RESCALE_APPLY = "rescale.apply"
+    RESCALE_COMPLETE = "rescale.complete"
+    RESCALE_ABORT = "rescale.abort"
 
 
 @dataclass

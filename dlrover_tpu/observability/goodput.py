@@ -41,12 +41,14 @@ _OPENING = {
     EventKind.NODE_EVICT: "node-evict",
     EventKind.NODE_HANG: "hang",
     EventKind.RDZV_INVALIDATED: "round-invalidated",
+    EventKind.RESCALE_PLAN: "rescale",
 }
 #: Master-visible detection events (stamp detect_ts).
 _DETECT = (
     EventKind.WORKER_FAIL,
     EventKind.NODE_EVICT,
     EventKind.NODE_HANG,
+    EventKind.RESCALE_PLAN,
 )
 #: Context events worth attaching to an open incident's trail.
 _CONTEXT = (
@@ -54,6 +56,9 @@ _CONTEXT = (
     EventKind.CKPT_FALLBACK,
     EventKind.WORKER_RESTART,
     EventKind.RDZV_ROUND_COMPLETE,
+    EventKind.RESCALE_APPLY,
+    EventKind.RESCALE_COMPLETE,
+    EventKind.RESCALE_ABORT,
 )
 
 
@@ -157,6 +162,11 @@ class GoodputLedger:
                 # The injection is the ROOT cause no matter which event
                 # reached the master first.
                 inc.injected = True
+                inc.cause = cause
+            elif ev.kind == EventKind.RESCALE_PLAN and not inc.injected:
+                # An in-place plan re-causes the incident: the window
+                # that follows is the transition, not a restart — so
+                # summary() separates rescale cost from restart cost.
                 inc.cause = cause
             if ev.kind in _DETECT and inc.detect_ts is None:
                 inc.detect_ts = ev.ts

@@ -148,6 +148,35 @@ class ShardingClient:
         with self._lock:
             return len(self._pending)
 
+    def requeue_pending(self) -> int:
+        """Rescale hook: hand every fetched-but-unacked shard back to
+        the master for re-dispatch (reported ``success=False``).
+
+        An in-place rescale discards batches buffered for the old
+        schedule (the prefetch swap), so records this worker read ahead
+        but never trained must go back into the todo queue — otherwise
+        they would be acked later against batches that were thrown
+        away. Returns the number of shards handed back."""
+        with self._lock:
+            pending = list(self._pending)
+            self._pending.clear()
+        for tid in pending:
+            try:
+                self._client.report_task(self.dataset_name, tid, False)
+            except Exception as e:
+                # The master's doing-timeout re-dispatches it anyway;
+                # this just makes the handback prompt.
+                logger.warning(
+                    "requeue of shard task %s/%s failed: %s",
+                    self.dataset_name, tid, e,
+                )
+        if pending:
+            logger.info(
+                "rescale: handed %s unacked shard(s) of %s back for "
+                "re-dispatch", len(pending), self.dataset_name,
+            )
+        return len(pending)
+
     def get_current_epoch(self) -> int:
         return self._client.get_dataset_epoch(self.dataset_name)
 
@@ -213,6 +242,17 @@ class IndexShardingClient(ShardingClient):
                 self._task_counts.append((task.task_id, len(indices)))
         self._indices.extend(indices)
         return True
+
+    def requeue_pending(self) -> int:
+        """Index-stream variant: also drop buffered indices and the
+        manual-ack bookkeeping — they describe shards that just went
+        back to the master."""
+        with self._lock:
+            self._indices.clear()
+            self._task_counts.clear()
+            self._unreported = 0
+            self._current_task = None
+        return super().requeue_pending()
 
     def report_records(self, n: int):
         """Report n records consumed by the trainer (manual-ack mode);

@@ -32,6 +32,8 @@ import os
 import time
 from typing import Any, Callable, Iterable, Optional
 
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.batching import derive_accum_schedule
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.events import EventKind, emit
@@ -73,42 +75,89 @@ class StepProgressReporter:
 
 
 class ElasticTrainer:
+    """Holds the global batch fixed across ANY world size.
+
+    The old contract demanded ``global_batch % (micro_batch * world)
+    == 0`` and rejected everything else — which made a 4→3 shrink
+    impossible without changing the training math. Now the trainer
+    derives a deterministic per-rank accumulation *schedule*
+    (:func:`~dlrover_tpu.common.batching.derive_accum_schedule`):
+    the effective micro batch is the largest divisor of the global
+    batch ≤ the configured one, the fixed total microbatch count is
+    partitioned across ranks with the remainder on the lowest ranks,
+    and only truly unsatisfiable configs (``global_batch < world``)
+    are rejected. :meth:`retune` re-derives the schedule for a new
+    world in place — the rescale plane's entry point.
+    """
+
     def __init__(self, global_batch_size: int,
                  micro_batch_size: int,
-                 world_size: Optional[int] = None):
-        if global_batch_size % micro_batch_size:
-            raise ValueError(
-                f"global batch {global_batch_size} not divisible by "
-                f"micro batch {micro_batch_size}"
-            )
+                 world_size: Optional[int] = None,
+                 rank: Optional[int] = None):
         self.global_batch_size = global_batch_size
-        self.micro_batch_size = micro_batch_size
+        #: the configured (maximum) micro batch; the schedule may use a
+        #: smaller effective one to divide the global batch exactly.
+        self.configured_micro_batch = micro_batch_size
         self.world_size = world_size or int(
             os.getenv(NodeEnv.NUM_PROCESSES, "1")
         )
-        # The class exists to HOLD the global batch fixed; any remainder
-        # would silently change it, so reject instead of rounding.
-        if global_batch_size % (micro_batch_size * self.world_size):
-            raise ValueError(
-                f"global batch {global_batch_size} is not micro batch "
-                f"{micro_batch_size} x world {self.world_size} x an "
-                "integer accumulation count — adjust micro batch or "
-                "global batch for this world size"
-            )
-        self.accum_steps = global_batch_size // (
-            micro_batch_size * self.world_size
+        self.rank = rank if rank is not None else int(
+            os.getenv(NodeEnv.PROCESS_ID, "0")
         )
         self.result = None  # set by prepare()
+        self._prepare_args = None
+        self._apply_schedule(derive_accum_schedule(
+            global_batch_size, micro_batch_size, self.world_size
+        ))
+
+    def _apply_schedule(self, schedule):
+        if not 0 <= self.rank < schedule.world:
+            raise ValueError(
+                f"rank {self.rank} outside world {schedule.world}"
+            )
+        self.schedule = schedule
+        self.micro_batch_size = schedule.micro_batch
+        self.accum_steps = schedule.counts[self.rank]
+        if schedule.micro_batch != self.configured_micro_batch:
+            logger.info(
+                "elastic trainer: effective micro batch %s (configured "
+                "%s does not divide global %s for world %s)",
+                schedule.micro_batch, self.configured_micro_batch,
+                self.global_batch_size, schedule.world,
+            )
         logger.info(
-            "elastic trainer: global batch %s = micro %s x world %s x "
-            "accum %s", global_batch_size, micro_batch_size,
-            self.world_size, self.accum_steps,
+            "elastic trainer: global batch %s = micro %s x %s "
+            "microbatches %s (rank %s runs %s)",
+            self.global_batch_size, self.micro_batch_size,
+            schedule.total_micros, schedule.counts, self.rank,
+            self.accum_steps,
         )
 
     @property
     def local_batch_size(self) -> int:
         """Samples this process feeds per train-step call."""
         return self.micro_batch_size * self.accum_steps
+
+    def retune(self, world_size: int, rank: Optional[int] = None):
+        """Re-derive the schedule for a new world (in-place rescale).
+
+        The global batch is preserved exactly: the total microbatch
+        count is world-independent, only its partition over ranks
+        changes (remainder to the lowest ranks, deterministically).
+        When :meth:`prepare` already ran, the train step is rebuilt for
+        the new accumulation count. Returns the new schedule.
+        """
+        schedule = derive_accum_schedule(
+            self.global_batch_size, self.configured_micro_batch,
+            world_size,
+        )
+        self.world_size = world_size
+        if rank is not None:
+            self.rank = rank
+        self._apply_schedule(schedule)
+        if self._prepare_args is not None:
+            self._build()
+        return schedule
 
     def prepare(self, module, optimizer, sample_micro_batch,
                 loss: Callable, spec: Any = "auto", **accel_kwargs):
@@ -117,14 +166,46 @@ class ElasticTrainer:
         ``sample_micro_batch`` is ONE microbatch; the returned
         ``result.train_step`` takes ``local_batch_size`` samples.
         """
+        self._prepare_args = (
+            module, optimizer, sample_micro_batch, loss, spec,
+            accel_kwargs,
+        )
+        result = self._build()
+        self._report_batch_config()
+        return result
+
+    def _report_batch_config(self):
+        """Tell the master the batch contract (ModelInfo.extra) so its
+        RescaleCoordinator can plan in-place transitions; without it the
+        coordinator declines plans and membership changes take the
+        legacy full-restart path."""
+        if not env_utils.MASTER_ADDR.get():
+            return
+        try:
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            MasterClient.singleton_instance().report_model_info(
+                params_count=0, flops_per_step=0.0,
+                batch_size=self.global_batch_size,
+                extra={
+                    "global_batch": self.global_batch_size,
+                    "micro_batch": self.configured_micro_batch,
+                },
+            )
+        except Exception as e:
+            logger.debug("batch config report failed: %s", e)
+
+    def _build(self):
         import numpy as np
 
         from dlrover_tpu.accel import auto_accelerate
 
+        (module, optimizer, sample_micro_batch, loss, spec,
+         accel_kwargs) = self._prepare_args
+        sample = np.asarray(sample_micro_batch)[: self.micro_batch_size]
         sample_local = np.repeat(
-            np.asarray(sample_micro_batch),
-            self.accum_steps, axis=0,
-        ) if self.accum_steps > 1 else sample_micro_batch
+            sample, self.accum_steps, axis=0,
+        ) if self.accum_steps > 1 else sample
         self.result = auto_accelerate(
             module, optimizer, sample_local, loss, spec=spec,
             grad_accum=self.accum_steps, **accel_kwargs,

@@ -1,0 +1,359 @@
+"""Worker-side in-place rescale: apply a RescalePlan without restarting.
+
+The master's :class:`~dlrover_tpu.master.rescale.RescaleCoordinator`
+answers a membership change (node death with surviving quorum, or a
+joiner) with a :class:`~dlrover_tpu.common.messages.RescalePlan` instead
+of invalidating the round and letting the fleet restart. This module is
+the receiving end: :class:`RescaleEngine` polls for a plan covering this
+node and applies it to a LIVE training loop —
+
+1. **retune** — the host trainer re-derives its accumulation schedule
+   for the new world (``host.retune(world, rank)``; see
+   :func:`dlrover_tpu.common.batching.derive_accum_schedule`) and
+   rebuilds the jitted train step (the recompile is the dominant cost
+   and is what ``bench.py --section rescale`` measures against a full
+   restart).
+2. **transfer** — the live train state moves onto the new result's
+   shardings via :func:`dlrover_tpu.accel.accelerate.transfer_state`
+   (device-to-device where placements overlap; bitwise-preserving).
+   When there is no live state to move (the caller lost it), the
+   engine *hydrates* from the newest per-step shm snapshot through the
+   flash-checkpoint block catalog (cross-degree re-slice,
+   ``engine.load(template)``) — gated on the snapshot being no more
+   than ``DLROVER_TPU_RESCALE_MAX_SNAPSHOT_LAG`` steps behind the
+   plan's step.
+3. **swap** — the :class:`DevicePrefetchIterator` source is replaced so
+   buffered batches sized for the old schedule are discarded, and any
+   fetched-but-unacked data shards are handed back to the master for
+   re-dispatch (``ShardingClient.requeue_pending``). When the local
+   batch size changes and there is no ``data_factory`` to rebuild the
+   stream, the plan nacks up front instead of acking a transition the
+   very next step would crash.
+4. **ack** — success/failure goes back via ``RescaleAck``; any failure
+   nacks, which aborts the plan master-side and falls back to the
+   legacy full-restart path. In-place rescale is an optimization with a
+   safety net, never a new failure mode.
+
+``host`` is anything with ``.retune(world_size, rank)`` and ``.result``
+(an :class:`~dlrover_tpu.accel.accelerate.AccelerateResult`) —
+:class:`~dlrover_tpu.train.elastic_trainer.ElasticTrainer` is the
+canonical one.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+from dlrover_tpu.chaos.injector import fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import EventKind, emit
+
+
+class RescaleInfeasible(RuntimeError):
+    """The runtime cannot express this transition in place (e.g. the
+    process set changed under a multi-process runtime, or the snapshot
+    is too stale to hydrate from). Nacked to the master, which aborts
+    the plan and lets the legacy restart path take over."""
+
+
+@dataclass
+class RescaleTransition:
+    """What :meth:`RescaleEngine.apply` hands back to the training loop."""
+
+    plan_id: int
+    ok: bool
+    state: Any = None            # transferred/hydrated train state
+    result: Any = None           # the rebuilt AccelerateResult
+    batches: Any = None          # fresh host iterable (data_factory), or None
+    wall_s: float = 0.0
+    source: str = ""             # "live" | "memory" | "storage"
+    requeued_shards: int = 0
+    error: str = ""
+    world_size: int = 0
+    accum_counts: tuple = field(default_factory=tuple)
+
+
+class RescaleEngine:
+    def __init__(
+        self,
+        host,
+        client=None,
+        node_rank: int = 0,
+        rdzv_name: str = RendezvousName.TRAINING,
+        checkpointer=None,
+        data_factory: Optional[Callable[[Any], Iterable]] = None,
+        sharding_client=None,
+    ):
+        self.host = host
+        self.client = client
+        self.node_rank = node_rank
+        self.rdzv_name = rdzv_name
+        self.checkpointer = checkpointer
+        self.data_factory = data_factory
+        self.sharding_client = sharding_client
+        #: last rendezvous round this engine settled into; the poll asks
+        #: for plans newer than it (workers never learn rounds any other
+        #: way — the master's plan carries the authoritative number).
+        self.round = 0
+        self.applied_plans = 0
+        self._last_poll = 0.0
+        self._advertise()
+
+    def _advertise(self):
+        """Tell the master this node can apply plans in place. The
+        coordinator only issues a plan when every survivor advertised —
+        a deployment that never wires an engine keeps the sub-second
+        full-restart path instead of stalling on an unappliable plan."""
+        if self.client is None or not env_utils.RESCALE.get():
+            return
+        try:
+            self.client.report_model_info(
+                0, 0.0, extra={"rescale_capable": True}
+            )
+        except Exception as e:
+            # Best-effort: without the advertisement the master simply
+            # keeps using the restart path for this node's transitions.
+            logger.debug("rescale capability advertisement failed: %s", e)
+
+    # ---------------- polling ----------------
+    def due(self) -> bool:
+        """Rate-limit the per-step poll to RESCALE_POLL_INTERVAL_S."""
+        if not env_utils.RESCALE.get():
+            return False
+        now = time.monotonic()
+        if now - self._last_poll < env_utils.RESCALE_POLL_INTERVAL_S.get():
+            return False
+        self._last_poll = now
+        return True
+
+    def poll(self) -> Optional[m.RescalePlan]:
+        """One RPC: the newest issued plan covering this node, or None."""
+        if self.client is None:
+            return None
+        try:
+            plan = self.client.get_rescale_plan(
+                self.rdzv_name, self.node_rank, self.round
+            )
+        except Exception as e:
+            logger.debug("rescale plan poll failed: %s", e)
+            return None
+        if plan is None or not plan.exists:
+            return None
+        return plan
+
+    def maybe_rescale(self, state=None,
+                      prefetch=None) -> Optional[RescaleTransition]:
+        """Poll-and-apply at the configured cadence; the training loop
+        calls this once per step. Returns None when there is nothing to
+        do, else the applied (or failed) transition."""
+        if not self.due():
+            return None
+        plan = self.poll()
+        if plan is None:
+            return None
+        # The caller is a live loop being fed by an iterator sized for
+        # the old schedule; apply() must nack rather than let it keep
+        # yielding wrong-sized batches into the rebuilt step.
+        return self.apply(plan, state=state, prefetch=prefetch,
+                          has_stream=True)
+
+    # ---------------- applying ----------------
+    def _world_size(self, world) -> int:
+        return sum(world.values()) or len(world)
+
+    def _rank_in(self, plan: m.RescalePlan) -> int:
+        """This node's first process rank under the new world (node
+        ranks sorted, local world sizes summed below us)."""
+        ranks = sorted(plan.new_world)
+        if self.node_rank not in plan.new_world:
+            raise RescaleInfeasible(
+                f"node {self.node_rank} is not in the new world {ranks}"
+            )
+        below = ranks[: ranks.index(self.node_rank)]
+        return sum(plan.new_world[r] for r in below)
+
+    def _check_feasible(self, plan: m.RescalePlan):
+        import jax
+
+        if jax.process_count() > 1 and (
+            set(plan.new_world) != set(plan.old_world)
+        ):
+            # A multi-process JAX runtime is pinned to its coordination
+            # service membership; changing the process set needs the
+            # restart path. Same-membership retunes (pure schedule
+            # changes) are still fine in place.
+            raise RescaleInfeasible(
+                "process membership changed under a multi-process "
+                "runtime; in-place rescale needs a single-process "
+                "(logical-world) runtime — falling back to restart"
+            )
+
+    def _check_stream(self, plan: m.RescalePlan, streaming: bool):
+        """A live input stream keeps yielding old-schedule-sized batches
+        after the transition; when the effective local batch size
+        changes it MUST be rebuilt (``data_factory``) or the plan must
+        nack — acking and then failing on the very next step would turn
+        a clean restart fallback into a committed transition followed by
+        a crash. Hosts that do not expose ``local_batch_size`` manage
+        their own data and are exempt, as are callers that drive
+        ``apply`` directly without a stream."""
+        if not streaming or self.data_factory is not None:
+            return
+        old_local = getattr(self.host, "local_batch_size", None)
+        if old_local is None or not plan.accum_counts or plan.micro_batch <= 0:
+            return
+        rank = self._rank_in(plan)
+        if rank >= len(plan.accum_counts):
+            raise RescaleInfeasible(
+                f"plan schedule has {len(plan.accum_counts)} ranks but "
+                f"this node computes rank {rank}"
+            )
+        new_local = plan.accum_counts[rank] * plan.micro_batch
+        if new_local != old_local:
+            raise RescaleInfeasible(
+                f"local batch size changes {old_local} -> {new_local} "
+                "but no data_factory was provided to rebuild the input "
+                "stream"
+            )
+
+    def _verify_schedule(self, plan: m.RescalePlan):
+        """Master and worker derive the schedule independently; a
+        mismatch means version drift and MUST nack (silently training a
+        different partition would skew the global batch)."""
+        sched = getattr(self.host, "schedule", None)
+        if sched is not None and plan.accum_counts and (
+            list(sched.counts) != list(plan.accum_counts)
+        ):
+            raise RescaleInfeasible(
+                f"schedule drift: master planned {list(plan.accum_counts)}"
+                f" but worker derived {list(sched.counts)}"
+            )
+
+    def _hydrate(self, plan: m.RescalePlan, template) -> tuple:
+        """No live state: rebuild it from the newest shm snapshot via
+        the block catalog (cross-degree re-slice). Returns
+        (state, source)."""
+        if self.checkpointer is None:
+            raise RescaleInfeasible(
+                "no live train state and no checkpointer to hydrate from"
+            )
+        step, state = self.checkpointer.load(template)
+        if step < 0:
+            raise RescaleInfeasible("no restorable snapshot to hydrate from")
+        stats = getattr(self.checkpointer, "last_restore_stats", {}) or {}
+        source = stats.get("source", "memory")
+        max_lag = env_utils.RESCALE_MAX_SNAPSHOT_LAG.get()
+        if plan.snapshot_step >= 0 and plan.snapshot_step - step > max_lag:
+            raise RescaleInfeasible(
+                f"snapshot step {step} is {plan.snapshot_step - step} "
+                f"behind the plan's step {plan.snapshot_step} "
+                f"(max lag {max_lag}); restart must re-train the gap"
+            )
+        return state, source
+
+    def apply(self, plan: m.RescalePlan, state=None, prefetch=None,
+              has_stream: bool = False) -> RescaleTransition:
+        """Apply one plan to the live loop. Never raises: failures are
+        nacked (master aborts → legacy restart) and reported in the
+        returned transition. ``has_stream`` marks callers whose input
+        iterator is sized for the old schedule (the ``fit`` loop via
+        :meth:`maybe_rescale`; passing ``prefetch`` implies it): such a
+        stream must be rebuildable (``data_factory``) whenever the
+        local batch size changes, else the plan nacks up front."""
+        t0 = time.perf_counter()
+        new_world = self._world_size(plan.new_world)
+        emit(
+            EventKind.RESCALE_APPLY, plan_id=plan.plan_id,
+            old_world=self._world_size(plan.old_world),
+            new_world=new_world, round=plan.new_round,
+        )
+        try:
+            chaos = fault_hit(
+                ChaosSite.RESCALE_TRANSFER, detail=f"plan{plan.plan_id}"
+            )
+            if chaos is not None:
+                if chaos.kind in ("delay", "straggle"):
+                    time.sleep(chaos.delay_s)  # dtlint: disable=DT003 -- scripted chaos delay, not a poll
+                elif chaos.kind in ("abort", "fail"):
+                    raise RescaleInfeasible("chaos: scripted transfer abort")
+            self._check_feasible(plan)
+            self._check_stream(plan, has_stream or prefetch is not None)
+            from dlrover_tpu.accel.accelerate import transfer_state
+
+            old_result = getattr(self.host, "result", None)
+            if state is None and old_result is not None:
+                state = old_result.state
+            # Rebuild mesh/shardings/train step for the new world. The
+            # host re-inits a throwaway state (part of the recompile we
+            # are timing); the live state replaces it right after.
+            self.host.retune(new_world, rank=self._rank_in(plan))
+            self._verify_schedule(plan)
+            result = self.host.result
+            if result is None:
+                raise RescaleInfeasible(
+                    "host has no prepared train step to rebuild"
+                )
+            if state is not None:
+                state = transfer_state(state, result.shardings)
+                source = "live"
+            else:
+                state, source = self._hydrate(plan, result.state)
+            result.state = state
+            batches = None
+            requeued = 0
+            if self.sharding_client is not None:
+                requeued = self.sharding_client.requeue_pending()
+            if self.data_factory is not None:
+                batches = self.data_factory(self.host)
+                if prefetch is not None:
+                    prefetch.swap(batches, result.batch_sharding)
+            self.round = plan.new_round
+            self.applied_plans += 1
+            wall = time.perf_counter() - t0
+            self._ack(plan, True)
+            emit(
+                EventKind.RESCALE_COMPLETE, plan_id=plan.plan_id,
+                world=new_world, wall_s=round(wall, 3), source=source,
+                requeued=requeued,
+            )
+            logger.info(
+                "in-place rescale applied: plan %s -> world %s "
+                "(accum %s) in %.3fs, state via %s",
+                plan.plan_id, new_world,
+                list(plan.accum_counts), wall, source,
+            )
+            return RescaleTransition(
+                plan_id=plan.plan_id, ok=True, state=state, result=result,
+                batches=batches, wall_s=wall, source=source,
+                requeued_shards=requeued, world_size=new_world,
+                accum_counts=tuple(plan.accum_counts),
+            )
+        except Exception as e:
+            wall = time.perf_counter() - t0
+            logger.warning(
+                "in-place rescale of plan %s failed (%s); nacking so the "
+                "master falls back to a full restart", plan.plan_id, e,
+            )
+            self._ack(plan, False, error=str(e))
+            return RescaleTransition(
+                plan_id=plan.plan_id, ok=False, wall_s=wall,
+                error=str(e), world_size=new_world,
+            )
+
+    def _ack(self, plan: m.RescalePlan, ok: bool, error: str = ""):
+        if self.client is None:
+            return
+        try:
+            self.client.report_rescale_ack(
+                plan.plan_id, self.node_rank, ok, error=error
+            )
+        except Exception as e:
+            # The master's apply-timeout aborts the plan if this never
+            # lands; the worker keeps training on its new schedule only
+            # after a successful settle, so a lost ack is safe.
+            logger.warning("rescale ack for plan %s failed: %s",
+                           plan.plan_id, e)

@@ -245,7 +245,8 @@ class Trainer:
             eval_every: int = 0,
             eval_max_batches: int = 0,
             pipeline: bool = True,
-            prefetch_depth: int = 2) -> dict:
+            prefetch_depth: int = 2,
+            rescale_engine=None) -> dict:
         """Run the loop; returns {'step': last, 'loss': last[, 'eval_loss']}.
 
         ``batches`` yields device-puttable batches; the loop consumes one
@@ -268,6 +269,16 @@ class Trainer:
         ``pipeline=False`` is the reference synchronous loop:
         ``device_put`` inside the step context and a full device sync
         per step — the A/B baseline (bench.py measures both).
+
+        ``rescale_engine`` (a
+        :class:`~dlrover_tpu.train.rescale.RescaleEngine` whose host
+        built this trainer's train step) lets the loop absorb an
+        in-place rescale plan mid-fit: at the engine's poll cadence the
+        loop checks for a plan, and on a successful transition adopts
+        the transferred state, rebuilt step and (when the engine has a
+        ``data_factory``) the re-batched data stream without leaving
+        ``fit``. Without an engine — or when a transition nacks — the
+        legacy restart path applies.
         """
         import contextlib
 
@@ -303,6 +314,18 @@ class Trainer:
         self._fire("on_train_begin", start)
         t_mark = time.perf_counter()
         for step in range(start, steps):
+            if rescale_engine is not None:
+                transition = rescale_engine.maybe_rescale(
+                    self.state, prefetch=it if pipeline else None
+                )
+                if transition is not None and transition.ok:
+                    # Adopt the new world: transferred state, rebuilt
+                    # step/shardings; the eval step is lazily rebuilt.
+                    self.state = transition.state
+                    self._result = transition.result
+                    self._eval_step = None
+                    if not pipeline and transition.batches is not None:
+                        it = iter(transition.batches)
             try:
                 batch = next(it)
             except StopIteration:

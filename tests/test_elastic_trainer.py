@@ -76,12 +76,45 @@ class TestElasticTrainer:
         t = ElasticTrainer(global_batch_size=32, micro_batch_size=4)
         assert t.world_size == 2 and t.accum_steps == 4
 
-    def test_invalid_configs_raise(self):
-        with pytest.raises(ValueError):
-            ElasticTrainer(global_batch_size=10, micro_batch_size=3)
-        with pytest.raises(ValueError):
-            ElasticTrainer(global_batch_size=16, micro_batch_size=3,
+    def test_awkward_configs_now_tune(self):
+        """Configs the old contract rejected derive an effective micro
+        batch instead: the global batch is preserved exactly."""
+        t = ElasticTrainer(global_batch_size=10, micro_batch_size=3)
+        assert t.micro_batch_size == 2 and t.schedule.counts == [5]
+        t = ElasticTrainer(global_batch_size=16, micro_batch_size=3,
                            world_size=2)
+        assert t.micro_batch_size == 2 and t.schedule.counts == [4, 4]
+        assert sum(t.schedule.counts) * t.micro_batch_size == 16
+
+    def test_invalid_configs_raise(self):
+        """Only truly unsatisfiable configs reject: a rank would get
+        zero samples, or non-positive inputs."""
+        with pytest.raises(ValueError):
+            ElasticTrainer(global_batch_size=2, micro_batch_size=1,
+                           world_size=3)
+        with pytest.raises(ValueError):
+            ElasticTrainer(global_batch_size=0, micro_batch_size=1)
+        with pytest.raises(ValueError):
+            ElasticTrainer(global_batch_size=8, micro_batch_size=0)
+        with pytest.raises(ValueError):
+            ElasticTrainer(global_batch_size=8, micro_batch_size=2,
+                           world_size=4, rank=7)
+
+    def test_retune_preserves_global_batch(self):
+        """4 -> 3 -> 4: the total microbatch count is world-independent
+        and the remainder lands deterministically on the lowest ranks."""
+        t = ElasticTrainer(global_batch_size=64, micro_batch_size=8,
+                           world_size=4, rank=0)
+        assert t.schedule.counts == [2, 2, 2, 2]
+        sched3 = t.retune(3)
+        assert sched3.counts == [3, 3, 2]
+        assert sum(sched3.counts) * sched3.micro_batch == 64
+        assert t.accum_steps == 3 and t.local_batch_size == 24
+        sched4 = t.retune(4)
+        assert sched4.counts == [2, 2, 2, 2]
+        assert sum(sched4.counts) * sched4.micro_batch == 64
+        # Deterministic remainder placement: re-deriving is identical.
+        assert t.retune(3).counts == [3, 3, 2]
 
     def test_prepare_trains(self):
         cfg = tiny_cfg()

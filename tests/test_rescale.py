@@ -1,0 +1,1017 @@
+"""Live rescale plane (ISSUE 8): scale change without the restart tax.
+
+Covers the full path: accumulation-schedule math (the bit-identity
+lever), the master-side :class:`RescaleCoordinator` plan lifecycle
+(issue / deliver / ack / abort / journal replay), the RPC surface, the
+worker-side :class:`RescaleEngine` in-place transition (live d2d
+transfer, snapshot hydration, nack fallbacks), the agent's settle
+protocol, and — slow-marked — the SIGKILL 4→3→4 drill from the issue's
+acceptance criteria.
+"""
+
+import dataclasses
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import asdict
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.agent.agent import (
+    ElasticLaunchConfig,
+    ElasticTrainingAgent,
+    RendezvousOutcome,
+    WorkerSpec,
+)
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.batching import derive_accum_schedule
+from dlrover_tpu.common.constants import NodeStatus, RendezvousName
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.rendezvous import ElasticTrainingRendezvousManager
+from dlrover_tpu.master.rescale import (
+    PLAN_ABORTED,
+    PLAN_COMPLETE,
+    PLAN_ISSUED,
+    RescaleCoordinator,
+    plan_survivors,
+)
+from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+from dlrover_tpu.train.elastic_trainer import ElasticTrainer
+from dlrover_tpu.train.rescale import RescaleEngine
+
+from tests.conftest import cpu_subprocess_env
+
+TRAIN = RendezvousName.TRAINING
+
+
+def tiny_cfg():
+    return dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)
+
+
+def token_loss(module, params, batch):
+    return loss_fn(module.apply({"params": params}, batch), batch)
+
+
+def assert_leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def formed_world(n=4):
+    mgr = ElasticTrainingRendezvousManager(TRAIN)
+    mgr.update_rdzv_params(n, n, waiting_timeout=10)
+    for r in range(n):
+        mgr.join_rendezvous(r, 1)
+    round_, _, world = mgr.get_comm_world(0)
+    assert len(world) == n
+    return mgr, round_, world
+
+
+def make_coordinator(mgr, global_batch=16, micro_batch=4, step=5,
+                     capable=range(6)):
+    coord = RescaleCoordinator(rdzv_managers={TRAIN: mgr})
+    coord.set_batch_config(global_batch, micro_batch)
+    coord.note_step(step)
+    # Workers advertise a live RescaleEngine; without it the
+    # coordinator declines and the restart path stays in charge.
+    for r in capable:
+        coord.set_capable(r)
+    return coord
+
+
+def make_plan(plan_id=1, old_world=None, new_world=None, old_round=1,
+              new_round=2, global_batch=16, micro_batch=4,
+              accum_counts=None, snapshot_step=2):
+    old_world = old_world if old_world is not None else {0: 1, 1: 1, 2: 1, 3: 1}
+    new_world = new_world if new_world is not None else {0: 1, 1: 1, 2: 1}
+    if accum_counts is None:
+        sched = derive_accum_schedule(
+            global_batch, micro_batch, sum(new_world.values())
+        )
+        micro_batch, accum_counts = sched.micro_batch, list(sched.counts)
+    return m.RescalePlan(
+        plan_id=plan_id, rdzv_name=TRAIN, old_round=old_round,
+        new_round=new_round, old_world=old_world, new_world=new_world,
+        global_batch=global_batch, micro_batch=micro_batch,
+        accum_counts=accum_counts, snapshot_step=snapshot_step,
+        status=PLAN_ISSUED,
+    )
+
+
+class TestAccumSchedule:
+    def test_total_micros_world_independent(self):
+        """The bit-identity lever: every world partitions the same fixed
+        microbatch sequence."""
+        for world in range(1, 9):
+            s = derive_accum_schedule(64, 8, world)
+            assert s.total_micros == 8
+            assert sum(s.counts) * s.micro_batch == 64
+            assert len(s.counts) == world
+
+    def test_shrink_regrow_partition_deterministic(self):
+        assert derive_accum_schedule(64, 8, 4).counts == [2, 2, 2, 2]
+        assert derive_accum_schedule(64, 8, 3).counts == [3, 3, 2]
+        # Remainder lands on the lowest ranks, identically every time.
+        assert derive_accum_schedule(64, 8, 3).counts == [3, 3, 2]
+        assert derive_accum_schedule(16, 4, 3).counts == [2, 1, 1]
+        assert derive_accum_schedule(64, 8, 4).counts == [2, 2, 2, 2]
+
+    def test_awkward_config_derives_smaller_micro(self):
+        s = derive_accum_schedule(10, 3, 1)
+        assert s.micro_batch == 2 and s.counts == [5]
+
+    def test_rejects_only_unsatisfiable(self):
+        with pytest.raises(ValueError):
+            derive_accum_schedule(2, 1, 3)  # a rank would get 0 samples
+        with pytest.raises(ValueError):
+            derive_accum_schedule(0, 1, 1)
+        with pytest.raises(ValueError):
+            derive_accum_schedule(8, 0, 1)
+
+
+class TestRescaleCoordinator:
+    def test_shrink_issues_plan_and_installs_world(self):
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        mgr.remove_alive_node(3)
+        plan = coord.on_node_removed(3, dict(world))
+        assert plan is not None and plan.exists
+        assert plan.status == PLAN_ISSUED
+        assert sorted(plan.new_world) == [0, 1, 2]
+        assert plan.micro_batch == 4 and plan.accum_counts == [2, 1, 1]
+        assert plan.snapshot_step == 5
+        assert plan.old_round == plan.new_round - 1
+        assert plan_survivors(plan) == [0, 1, 2]
+        # The new world is INSTALLED: old round stale, new round live.
+        assert mgr.current_world() == plan.new_world
+        assert mgr.world_stale(round_)
+        assert not mgr.world_stale(plan.new_round)
+
+    def test_quorum_decline(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_RESCALE_MIN_QUORUM", "0.9")
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        assert coord.on_node_removed(3, dict(world)) is None
+
+    def test_disabled_decline(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_RESCALE", "0")
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        assert coord.on_node_removed(3, dict(world)) is None
+
+    def test_no_batch_config_declines(self):
+        mgr, round_, world = formed_world(4)
+        coord = RescaleCoordinator(rdzv_managers={TRAIN: mgr})
+        assert coord.on_node_removed(3, dict(world)) is None
+
+    def test_survivors_without_engine_decline(self):
+        """No plan unless EVERY survivor advertised a live engine —
+        else an unappliable plan would hold the fleet for the apply
+        timeout (training on the stale world) before the same restart
+        the master could have taken immediately."""
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr, capable=())
+        assert coord.on_node_removed(3, dict(world)) is None
+        # Some-but-not-all survivors capable is still a decline.
+        coord.set_capable(0)
+        coord.set_capable(1)
+        assert coord.on_node_removed(3, dict(world)) is None
+        # All three survivors capable: plan issued. The dead node never
+        # advertised and does not need to — it is not a survivor.
+        coord.set_capable(2)
+        plan = coord.on_node_removed(3, dict(world))
+        assert plan is not None and sorted(plan.new_world) == [0, 1, 2]
+
+    def test_unsatisfiable_schedule_declines(self):
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr, global_batch=2, micro_batch=1)
+        # global_batch=2 cannot feed the 3 survivors -> full restart.
+        assert coord.on_node_removed(3, dict(world)) is None
+
+    def test_get_plan_visibility(self):
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        plan = coord.on_node_removed(3, dict(world))
+        # A covered survivor running the stale round sees the plan.
+        got = coord.get_plan(TRAIN, 0, round_)
+        assert got.exists and got.plan_id == plan.plan_id
+        # The evicted node is not covered.
+        assert not coord.get_plan(TRAIN, 3, round_).exists
+        # A node already on the new round has nothing to apply.
+        assert not coord.get_plan(TRAIN, 0, plan.new_round).exists
+
+    def test_all_acks_complete_plan(self):
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        plan = coord.on_node_removed(3, dict(world))
+        for rank in (0, 1):
+            assert coord.apply_ack(plan.plan_id, rank, True)
+            assert plan.status == PLAN_ISSUED
+        assert coord.apply_ack(plan.plan_id, 2, True)
+        assert plan.status == PLAN_COMPLETE
+        # Settled: no longer delivered; the new round stays live.
+        assert not coord.get_plan(TRAIN, 0, round_).exists
+        assert not mgr.world_stale(plan.new_round)
+
+    def test_nack_aborts_and_invalidates_round(self):
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        plan = coord.on_node_removed(3, dict(world))
+        assert coord.apply_ack(plan.plan_id, 1, False, error="transfer oom")
+        assert plan.status == PLAN_ABORTED
+        # The round is invalidated -> survivors fall back to restart.
+        assert mgr.world_stale(plan.new_round)
+
+    def test_tick_aborts_on_apply_timeout(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_RESCALE_APPLY_TIMEOUT_S", "0")
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        plan = coord.on_node_removed(3, dict(world))
+        time.sleep(0.01)
+        coord.tick()
+        assert plan.status == PLAN_ABORTED
+        assert mgr.world_stale(plan.new_round)
+
+    def test_second_shrink_supersedes_in_flight_plan(self):
+        """A membership change inside the apply window obsoletes the
+        in-flight plan. It must abort as *superseded* — without fencing
+        the newer plan's live round, which would force-restart a world
+        that can still (or already did) transition in place."""
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        plan1 = coord.on_node_removed(3, dict(world))
+        assert plan1 is not None
+        # A second death before plan1 collected all acks.
+        mgr.remove_alive_node(2)
+        plan2 = coord.on_node_removed(2, dict(plan1.new_world))
+        assert plan2 is not None and sorted(plan2.new_world) == [0, 1]
+        assert plan1.status == PLAN_ABORTED  # superseded at issue time
+        assert not mgr.world_stale(plan2.new_round)
+        # Survivors polling from any stale round see only the new plan.
+        got = coord.get_plan(TRAIN, 0, round_)
+        assert got.plan_id == plan2.plan_id
+        # plan1 can never time out into an invalidation anymore.
+        coord.tick()
+        assert not mgr.world_stale(plan2.new_round)
+        # A real failure of the LIVE plan still fences its round.
+        coord.apply_ack(plan2.plan_id, 0, False, error="boom")
+        assert mgr.world_stale(plan2.new_round)
+
+    def test_obsolete_plan_timeout_keeps_live_round(self, monkeypatch):
+        """An ISSUED plan targeting an older round (e.g. restored
+        across a master relaunch after the world moved on) may abort on
+        timeout, but must not invalidate the manager's current round."""
+        monkeypatch.setenv("DLROVER_TPU_RESCALE_APPLY_TIMEOUT_S", "0")
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        obsolete = make_plan(
+            plan_id=5, old_round=round_ - 2, new_round=round_ - 1
+        )
+        coord.replay({"rec": "plan", "plan": asdict(obsolete)})
+        time.sleep(0.01)
+        coord.tick()
+        assert coord.checkpoint()["plans"][0]["status"] == PLAN_ABORTED
+        # The live round was untouched by the stale plan's abort.
+        assert not mgr.world_stale(round_)
+
+    def test_grow_on_join_one_transition_at_a_time(self):
+        mgr, round_, world = formed_world(3)
+        coord = make_coordinator(mgr)
+        plan = coord.on_node_joined(3, 1, TRAIN)
+        assert plan is not None and sorted(plan.new_world) == [0, 1, 2, 3]
+        assert plan.accum_counts == [1, 1, 1, 1]
+        # An existing member joining again is not a grow.
+        assert coord.on_node_joined(0, 1, TRAIN) is None
+        # One in-flight transition at a time.
+        assert coord.on_node_joined(4, 1, TRAIN) is None
+        for rank in plan_survivors(plan):
+            coord.apply_ack(plan.plan_id, rank, True)
+        assert plan.status == PLAN_COMPLETE
+        assert coord.on_node_joined(4, 1, TRAIN) is not None
+
+    def test_checkpoint_restore_roundtrip(self):
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        plan = coord.on_node_removed(3, dict(world))
+        coord.apply_ack(plan.plan_id, 0, True)
+        snap = coord.checkpoint()
+
+        coord2 = RescaleCoordinator(rdzv_managers={TRAIN: mgr})
+        coord2.restore(snap)
+        got = coord2.get_plan(TRAIN, 1, round_)
+        assert got.exists and got.plan_id == plan.plan_id
+        # The ack set survived: the two remaining acks complete it.
+        coord2.apply_ack(plan.plan_id, 1, True)
+        assert coord2.apply_ack(plan.plan_id, 2, True)
+        assert coord2.get_plan(TRAIN, 1, round_).exists is False
+        assert coord2.checkpoint()["next_plan_id"] == snap["next_plan_id"]
+        # Capability advertisements survive the relaunch too.
+        assert coord2.checkpoint()["capable"] == snap["capable"]
+
+    def test_journal_replay_rebuilds_plans(self):
+        mgr, round_, world = formed_world(4)
+        plan = make_plan(plan_id=7, old_round=round_, new_round=round_ + 1)
+        coord = RescaleCoordinator(rdzv_managers={TRAIN: mgr})
+        coord.replay({"rec": "config", "global_batch": 16, "micro_batch": 4})
+        coord.replay({"rec": "plan", "plan": asdict(plan)})
+        got = coord.get_plan(TRAIN, 0, round_)
+        assert got.exists and got.plan_id == 7
+        # Replayed ids advance the counter past the journaled plan.
+        assert coord.checkpoint()["next_plan_id"] == 8
+        coord.replay({"rec": "abort", "plan_id": 7})
+        assert not coord.get_plan(TRAIN, 0, round_).exists
+        # Capability advertisements replay into the capable set.
+        coord.replay({"rec": "capable", "node": 2})
+        assert coord.checkpoint()["capable"] == [2]
+        # Unknown records are skipped, not fatal.
+        coord.replay({"rec": "???"})
+
+
+class TestRescaleRpc:
+    """The plan lifecycle through the real servicer + MasterClient."""
+
+    @pytest.fixture
+    def master(self):
+        master = JobMaster(port=0, node_num=4, job_name="rescale-rpc")
+        master.prepare()
+        yield master
+        master.stop()
+
+    def test_plan_issue_deliver_ack_over_rpc(self, master):
+        clients = [MasterClient(master.addr, node_id=r) for r in range(4)]
+        try:
+            for r, c in enumerate(clients):
+                c.join_rendezvous(TRAIN, r, 1)
+            round_, _, world = clients[0].get_comm_world(TRAIN, 0)
+            assert len(world) == 4
+            # The batch contract arrives the way ElasticTrainer.prepare
+            # reports it; the step the way the trainer reports progress.
+            clients[0].report_model_info(
+                0, 0.0, batch_size=16,
+                extra={"global_batch": 16, "micro_batch": 4},
+            )
+            # Each survivor's engine advertises that it can apply plans.
+            for r in (0, 1, 2):
+                clients[r].report_model_info(
+                    0, 0.0, extra={"rescale_capable": True}
+                )
+            clients[0].report_global_step(7, time.time())
+            plan = master.rescale.on_node_removed(3, dict(world))
+            assert plan is not None and plan.snapshot_step == 7
+            got = clients[0].get_rescale_plan(TRAIN, 0, round_)
+            assert got.exists and got.accum_counts == [2, 1, 1]
+            assert got.new_world == {0: 1, 1: 1, 2: 1}
+            for r in (0, 1, 2):
+                clients[r].report_rescale_ack(got.plan_id, r, True)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and plan.status != PLAN_COMPLETE:
+                time.sleep(0.05)
+            assert plan.status == PLAN_COMPLETE
+            assert not clients[0].world_stale(TRAIN, plan.new_round)
+        finally:
+            for c in clients:
+                c.close()
+
+    def test_nack_over_rpc_aborts(self, master):
+        clients = [MasterClient(master.addr, node_id=r) for r in range(4)]
+        try:
+            for r, c in enumerate(clients):
+                c.join_rendezvous(TRAIN, r, 1)
+            round_, _, world = clients[0].get_comm_world(TRAIN, 0)
+            clients[0].report_model_info(
+                0, 0.0, batch_size=16,
+                extra={"global_batch": 16, "micro_batch": 4},
+            )
+            for r in (0, 1, 2):
+                clients[r].report_model_info(
+                    0, 0.0, extra={"rescale_capable": True}
+                )
+            plan = master.rescale.on_node_removed(3, dict(world))
+            assert plan is not None
+            clients[1].report_rescale_ack(
+                plan.plan_id, 1, False, error="shm gone"
+            )
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and plan.status != PLAN_ABORTED:
+                time.sleep(0.05)
+            assert plan.status == PLAN_ABORTED
+            # Abort fences the new round: survivors fall back to restart.
+            assert clients[0].world_stale(TRAIN, plan.new_round)
+        finally:
+            for c in clients:
+                c.close()
+
+
+# ---------------- worker-side engine ----------------
+
+
+def replicated_shardings(state):
+    dev = jax.devices()[0]
+    sharding = jax.sharding.SingleDeviceSharding(dev)
+    return jax.tree_util.tree_map(lambda _: sharding, state)
+
+
+class FakeHost:
+    """Minimal host contract: .retune(world, rank) + .result (+ .schedule)."""
+
+    def __init__(self, state=None, counts=None):
+        self.schedule = (
+            SimpleNamespace(counts=list(counts)) if counts else None
+        )
+        self.result = SimpleNamespace(
+            state=state,
+            shardings=replicated_shardings(state) if state is not None else None,
+            batch_sharding=None,
+        )
+        self.retuned = None
+
+    def retune(self, world_size, rank=None):
+        self.retuned = (world_size, rank)
+        return self.schedule
+
+
+class FakeClient:
+    def __init__(self, plan=None):
+        self.plan = plan
+        self.acks = []
+        self.polls = 0
+
+    def get_rescale_plan(self, rdzv_name, node_rank, round_):
+        self.polls += 1
+        return self.plan if self.plan is not None else m.RescalePlan()
+
+    def report_rescale_ack(self, plan_id, node_rank, ok, error=""):
+        self.acks.append((plan_id, node_rank, ok, error))
+
+
+class StubCheckpointer:
+    def __init__(self, step, state, source="memory"):
+        self.step, self.state = step, state
+        self.last_restore_stats = {"source": source}
+
+    def load(self, template):
+        self.template = template
+        return self.step, self.state
+
+
+class TestRescaleEngine:
+    def _state(self):
+        return {"w": np.arange(6, dtype=np.float32), "step": np.int32(2)}
+
+    def test_drift_nacks(self):
+        host = FakeHost(state=self._state(), counts=(1, 1, 1))
+        client = FakeClient()
+        eng = RescaleEngine(host, client=client, node_rank=0)
+        plan = make_plan(accum_counts=[2, 1, 1], micro_batch=4)
+        tr = eng.apply(plan, state=self._state())
+        assert not tr.ok and "drift" in tr.error
+        assert client.acks == [(plan.plan_id, 0, False, tr.error)]
+        assert eng.round == 0 and eng.applied_plans == 0
+
+    def test_node_outside_new_world_nacks(self):
+        host = FakeHost(state=self._state())
+        client = FakeClient()
+        eng = RescaleEngine(host, client=client, node_rank=9)
+        tr = eng.apply(make_plan())
+        assert not tr.ok and "not in the new world" in tr.error
+        assert client.acks[-1][2] is False
+
+    def test_live_transfer_preserves_bits_and_acks(self):
+        state = self._state()
+        host = FakeHost(state=state, counts=(2, 1, 1))
+        client = FakeClient()
+        eng = RescaleEngine(host, client=client, node_rank=0)
+        plan = make_plan()
+        tr = eng.apply(plan)  # no explicit state: falls back to live result
+        assert tr.ok and tr.source == "live"
+        assert host.retuned == (3, 0)
+        assert_leaves_equal(tr.state, state)
+        assert eng.round == plan.new_round and eng.applied_plans == 1
+        assert client.acks == [(plan.plan_id, 0, True, "")]
+        assert tr.world_size == 3 and tuple(tr.accum_counts) == (2, 1, 1)
+
+    def test_rank_offset_from_node_local_sizes(self):
+        host = FakeHost(state=self._state())
+        eng = RescaleEngine(host, node_rank=2)
+        plan = make_plan(
+            old_world={0: 2, 1: 2, 2: 2, 3: 2},
+            new_world={0: 2, 2: 2, 3: 2},
+            global_batch=24, micro_batch=4,
+        )
+        tr = eng.apply(plan)
+        assert tr.ok
+        # Node 2 sits after node 0's two procs under the new world.
+        assert host.retuned == (6, 2)
+
+    def test_hydrate_from_snapshot(self):
+        host = FakeHost(state=None)
+        ck = StubCheckpointer(2, self._state(), source="memory")
+        eng = RescaleEngine(host, node_rank=0, checkpointer=ck)
+        tr = eng.apply(make_plan(snapshot_step=2))
+        assert tr.ok and tr.source == "memory"
+        assert_leaves_equal(tr.state, self._state())
+        assert host.result.state is tr.state
+
+    def test_hydrate_lag_gate_nacks(self):
+        host = FakeHost(state=None)
+        ck = StubCheckpointer(2, self._state())
+        eng = RescaleEngine(host, node_rank=0, checkpointer=ck)
+        tr = eng.apply(make_plan(snapshot_step=10))
+        assert not tr.ok and "behind" in tr.error
+
+    def test_no_state_no_checkpointer_nacks(self):
+        host = FakeHost(state=None)
+        eng = RescaleEngine(host, node_rank=0)
+        tr = eng.apply(make_plan())
+        assert not tr.ok and "no checkpointer" in tr.error
+
+    def test_requeue_and_prefetch_swap(self):
+        host = FakeHost(state=self._state())
+        shards = SimpleNamespace(requeue_pending=lambda: 3)
+        batches = [object()]
+        swaps = []
+        prefetch = SimpleNamespace(
+            swap=lambda b, s=None: swaps.append((b, s)) or 0
+        )
+        eng = RescaleEngine(
+            host, node_rank=0, sharding_client=shards,
+            data_factory=lambda h: batches,
+        )
+        tr = eng.apply(make_plan(), prefetch=prefetch)
+        assert tr.ok and tr.requeued_shards == 3
+        assert tr.batches is batches
+        assert swaps == [(batches, host.result.batch_sharding)]
+
+    def test_stream_without_factory_nacks(self, monkeypatch):
+        """A live loop's input stream is sized for the old schedule; if
+        the local batch size changes and there is no data_factory to
+        rebuild it, the plan must nack up front — not ack a transition
+        the very next step would crash on."""
+        monkeypatch.setenv("DLROVER_TPU_RESCALE_POLL_INTERVAL_S", "0")
+        host = FakeHost(state=self._state(), counts=(2, 1, 1))
+        host.local_batch_size = 4  # old world-4 schedule: one micro of 4
+        client = FakeClient(plan=make_plan())  # world 3: rank 0 runs 8
+        eng = RescaleEngine(host, client=client, node_rank=0)
+        tr = eng.maybe_rescale()
+        assert tr is not None and not tr.ok
+        assert "data_factory" in tr.error
+        assert client.acks[-1][2] is False
+        assert host.retuned is None  # nacked before mutating the host
+
+    def test_stream_with_factory_applies(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_RESCALE_POLL_INTERVAL_S", "0")
+        host = FakeHost(state=self._state(), counts=(2, 1, 1))
+        host.local_batch_size = 4
+        client = FakeClient(plan=make_plan())
+        batches = [object()]
+        eng = RescaleEngine(host, client=client, node_rank=0,
+                            data_factory=lambda h: batches)
+        tr = eng.maybe_rescale()
+        assert tr is not None and tr.ok
+        assert tr.batches is batches
+
+    def test_manual_apply_without_stream_still_allowed(self):
+        """Callers that drive apply() directly (bench, the drill) feed
+        batches themselves; a batch-size change without a data_factory
+        is their business, not a nack."""
+        host = FakeHost(state=self._state(), counts=(2, 1, 1))
+        host.local_batch_size = 4
+        eng = RescaleEngine(host, node_rank=0)
+        tr = eng.apply(make_plan())
+        assert tr.ok
+
+    def test_engine_advertises_capability(self, monkeypatch):
+        class AdvClient(FakeClient):
+            def __init__(self):
+                super().__init__()
+                self.infos = []
+
+            def report_model_info(self, params_count, flops_per_step,
+                                  batch_size=0, seq_len=0, extra=None):
+                self.infos.append(extra or {})
+
+        client = AdvClient()
+        RescaleEngine(FakeHost(state=None), client=client, node_rank=1)
+        assert any(i.get("rescale_capable") for i in client.infos)
+        # Killswitch: RESCALE off -> nothing advertised.
+        monkeypatch.setenv("DLROVER_TPU_RESCALE", "0")
+        client2 = AdvClient()
+        RescaleEngine(FakeHost(state=None), client=client2, node_rank=1)
+        assert client2.infos == []
+
+    def test_maybe_rescale_poll_cycle(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_RESCALE_POLL_INTERVAL_S", "0")
+        host = FakeHost(state=self._state())
+        client = FakeClient(plan=make_plan())
+        eng = RescaleEngine(host, client=client, node_rank=0)
+        tr = eng.maybe_rescale()
+        assert tr is not None and tr.ok
+        # Plan consumed: an empty poll answer means nothing to do.
+        client.plan = None
+        assert eng.maybe_rescale() is None
+        # Killswitch: RESCALE off -> no polling at all.
+        monkeypatch.setenv("DLROVER_TPU_RESCALE", "0")
+        polls = client.polls
+        assert eng.maybe_rescale() is None
+        assert client.polls == polls
+
+
+class TestRescaleEngineLiveModel:
+    """In-place 4→3→4 on a real prepared trainer: the jitted step is
+    rebuilt per world, the live state moves bitwise, and the in-place
+    path lands on the exact same math as the restart path."""
+
+    def test_shrink_regrow_live_state(self):
+        from dlrover_tpu.accel import ParallelSpec
+
+        cfg = tiny_cfg()
+        micro = jax.random.randint(
+            jax.random.PRNGKey(2), (4, 16), 0, cfg.vocab_size
+        )
+        et = ElasticTrainer(global_batch_size=16, micro_batch_size=4,
+                            world_size=4, rank=0)
+        et.prepare(GPT(cfg), optax.adamw(1e-3), micro, token_loss,
+                   spec=ParallelSpec(data=1))
+        assert et.schedule.counts == [1, 1, 1, 1]
+        state = et.result.state
+        batch4 = jax.random.randint(
+            jax.random.PRNGKey(3), (4, 16), 0, cfg.vocab_size
+        )
+        for _ in range(2):
+            state, met = et.result.train_step(
+                state, jax.device_put(batch4, et.result.batch_sharding)
+            )
+        et.result.state = state
+        step0 = int(state["step"])
+        pre = [np.asarray(x).copy()
+               for x in jax.tree_util.tree_leaves(state)]
+        saved = jax.tree_util.tree_map(lambda x: np.asarray(x).copy(), state)
+
+        eng = RescaleEngine(et, node_rank=0)
+        eng.round = 1
+        plan3 = make_plan(plan_id=1, old_round=1, new_round=2)
+        tr = eng.apply(plan3, state=state)
+        assert tr.ok and tr.source == "live"
+        assert et.schedule.counts == [2, 1, 1]
+        assert et.accum_steps == 2 and et.local_batch_size == 8
+        assert int(tr.state["step"]) == step0
+        # The transfer is layout-only: every leaf bitwise preserved.
+        post = jax.tree_util.tree_leaves(tr.state)
+        for x, y in zip(pre, post):
+            np.testing.assert_array_equal(x, np.asarray(y))
+
+        # Restart-path oracle: a fresh world-3 trainer hydrated from the
+        # pre-shrink state must step to the exact same loss and weights.
+        from dlrover_tpu.accel.accelerate import transfer_state
+
+        et_r = ElasticTrainer(global_batch_size=16, micro_batch_size=4,
+                              world_size=3, rank=0)
+        et_r.prepare(GPT(cfg), optax.adamw(1e-3), micro, token_loss,
+                     spec=ParallelSpec(data=1))
+        rstate = transfer_state(saved, et_r.result.shardings)
+        batch8 = jax.random.randint(
+            jax.random.PRNGKey(4), (8, 16), 0, cfg.vocab_size
+        )
+        s_ip, m_ip = et.result.train_step(
+            tr.state, jax.device_put(batch8, et.result.batch_sharding)
+        )
+        s_rs, m_rs = et_r.result.train_step(
+            rstate, jax.device_put(batch8, et_r.result.batch_sharding)
+        )
+        assert float(m_ip["loss"]) == float(m_rs["loss"]), (
+            "in-place rescale diverged from the restart path"
+        )
+        assert_leaves_equal(s_ip, s_rs)
+
+        # Regrow back to 4: the original schedule returns exactly.
+        plan4 = make_plan(
+            plan_id=2, old_world={0: 1, 1: 1, 2: 1},
+            new_world={0: 1, 1: 1, 2: 1, 3: 1}, old_round=2, new_round=3,
+        )
+        tr2 = eng.apply(plan4, state=s_ip)
+        assert tr2.ok and tr2.source == "live"
+        assert et.schedule.counts == [1, 1, 1, 1]
+        assert et.local_batch_size == 4
+        assert eng.applied_plans == 2 and eng.round == 3
+        s_f, m_f = et.result.train_step(
+            tr2.state, jax.device_put(batch4, et.result.batch_sharding)
+        )
+        assert int(s_f["step"]) == step0 + 2
+        assert np.isfinite(float(m_f["loss"]))
+
+
+class TestAgentSettle:
+    """The agent's plan-settle protocol around _try_rescale_in_place."""
+
+    def _agent(self, client, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_RESCALE_POLL_INTERVAL_S", "0.01")
+        return ElasticTrainingAgent(
+            ElasticLaunchConfig(node_rank=0), WorkerSpec("true", []), client
+        )
+
+    def _outcome(self):
+        return RendezvousOutcome(
+            1, {0: 1, 1: 1, 2: 1, 3: 1}, 0, "127.0.0.1:0"
+        )
+
+    def test_completed_plan_adopted(self, monkeypatch):
+        plan = make_plan()
+
+        class SettleClient(FakeClient):
+            def __init__(self):
+                super().__init__(plan)
+
+            def get_rescale_plan(self, rdzv_name, node_rank, round_):
+                self.polls += 1
+                if self.polls >= 3:
+                    return m.RescalePlan()  # settled: plan gone
+                return plan
+
+            def world_stale(self, rdzv_name, round_):
+                return False  # new round stays live -> completed
+
+        agent = self._agent(SettleClient(), monkeypatch)
+        outcome = self._outcome()
+        assert agent._try_rescale_in_place(outcome) is True
+        assert outcome.round == plan.new_round
+        assert outcome.world == plan.new_world
+        assert outcome.world_size == 3 and outcome.num_nodes == 3
+
+    def test_aborted_plan_falls_back(self, monkeypatch):
+        plan = make_plan()
+
+        class AbortClient(FakeClient):
+            def __init__(self):
+                super().__init__(plan)
+
+            def world_stale(self, rdzv_name, round_):
+                return True  # new round fenced -> plan aborted
+
+        agent = self._agent(AbortClient(), monkeypatch)
+        outcome = self._outcome()
+        assert agent._try_rescale_in_place(outcome) is False
+        assert outcome.round == 1  # nothing adopted
+
+    def test_abort_landing_between_settle_reads_not_adopted(
+        self, monkeypatch
+    ):
+        """The settle loop reads world_stale BEFORE get_rescale_plan;
+        an abort landing between the two makes the plan vanish while
+        the stale answer still says live. The agent must re-check
+        before adopting, not treat "plan gone" as "completed"."""
+        plan = make_plan()
+
+        class RacyClient(FakeClient):
+            def __init__(self):
+                super().__init__(plan)
+                self.stale_calls = 0
+
+            def get_rescale_plan(self, rdzv_name, node_rank, round_):
+                self.polls += 1
+                if self.polls >= 2:
+                    return m.RescalePlan()  # abort landed: plan gone
+                return plan
+
+            def world_stale(self, rdzv_name, round_):
+                self.stale_calls += 1
+                # First read races ahead of the abort; every later read
+                # sees the invalidated round.
+                return self.stale_calls >= 2
+
+        agent = self._agent(RacyClient(), monkeypatch)
+        outcome = self._outcome()
+        assert agent._try_rescale_in_place(outcome) is False
+        assert outcome.round == 1  # the aborted round was not adopted
+
+    def test_unreachable_master_falls_back(self, monkeypatch):
+        class DeadClient:
+            def get_rescale_plan(self, *a, **k):
+                raise ConnectionError("master gone")
+
+        agent = self._agent(DeadClient(), monkeypatch)
+        assert agent._try_rescale_in_place(self._outcome()) is False
+
+    def test_disabled_falls_back(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_RESCALE", "0")
+        agent = self._agent(FakeClient(make_plan()), monkeypatch)
+        assert agent._try_rescale_in_place(self._outcome()) is False
+
+
+# ---------------- the acceptance drill ----------------
+
+_HEARTBEAT_SRC = """
+import sys, time
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import NodeStatus, RendezvousName
+
+addr, rank = sys.argv[1], int(sys.argv[2])
+c = MasterClient(addr, node_id=rank)
+c.join_rendezvous(RendezvousName.TRAINING, rank, 1)
+c.report_node_status(NodeStatus.RUNNING)
+# Stand-in for this worker's RescaleEngine advertising itself.
+c.report_model_info(0, 0.0, extra={"rescale_capable": True})
+while True:
+    c.report_heartbeat()
+    time.sleep(0.1)
+"""
+
+
+def _spawn_heartbeater(addr, rank):
+    return subprocess.Popen(
+        [sys.executable, "-c", _HEARTBEAT_SRC, addr, str(rank)],
+        env=cpu_subprocess_env(),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+@pytest.mark.chaos
+@pytest.mark.e2e
+@pytest.mark.slow
+class TestShrinkRegrowDrill:
+    """ISSUE 8 acceptance: SIGKILL 1 of 4 workers -> in-place shrink to
+    3 -> regrow to 4, loss identical to the restart path, with no disk
+    restore on the survivors."""
+
+    def test_sigkill_shrink_then_regrow(self, tmp_path):
+        from dlrover_tpu.accel import ParallelSpec
+        from dlrover_tpu.common.global_context import get_context
+        from dlrover_tpu.train.checkpoint import (
+            FlashCheckpointer,
+            StorageType,
+        )
+
+        ctx = get_context()
+        old_ctx = (ctx.heartbeat_timeout, ctx.node_monitor_interval)
+        ctx.heartbeat_timeout = 1.2
+        ctx.node_monitor_interval = 0.1
+        master = JobMaster(port=0, node_num=4, job_name="rescale-drill")
+        master.prepare()
+        procs = {}
+        c0 = MasterClient(master.addr, node_id=0)
+        stop_hb = threading.Event()
+
+        def heartbeat():
+            while not stop_hb.is_set():
+                try:
+                    c0.report_heartbeat()
+                except Exception:
+                    pass
+                stop_hb.wait(0.2)
+
+        hb = threading.Thread(target=heartbeat, daemon=True)
+        try:
+            # Node 0 is this process (the survivor whose trainer we
+            # host); nodes 1-3 are real child processes.
+            c0.join_rendezvous(TRAIN, 0, 1)
+            c0.report_node_status(NodeStatus.RUNNING)
+            for r in (1, 2, 3):
+                procs[r] = _spawn_heartbeater(master.addr, r)
+            deadline = time.monotonic() + 30
+            world = {}
+            while time.monotonic() < deadline and len(world) < 4:
+                round_, _, world = c0.get_comm_world(TRAIN, 0)
+                time.sleep(0.1)
+            assert len(world) == 4, "fleet never formed"
+            hb.start()
+
+            # Batch contract + progress reach the coordinator the same
+            # way a real trainer reports them.
+            c0.report_model_info(
+                0, 0.0, batch_size=16,
+                extra={"global_batch": 16, "micro_batch": 4},
+            )
+            cfg = tiny_cfg()
+            micro = jax.random.randint(
+                jax.random.PRNGKey(2), (4, 16), 0, cfg.vocab_size
+            )
+            et = ElasticTrainer(global_batch_size=16, micro_batch_size=4,
+                                world_size=4, rank=0)
+            et.prepare(GPT(cfg), optax.adamw(1e-3), micro, token_loss,
+                       spec=ParallelSpec(data=1))
+            eng = RescaleEngine(et, client=c0, node_rank=0)
+            eng.round = round_
+            state = et.result.state
+            batch4 = jax.random.randint(
+                jax.random.PRNGKey(3), (4, 16), 0, cfg.vocab_size
+            )
+            for _ in range(2):
+                state, met = et.result.train_step(
+                    state, jax.device_put(batch4, et.result.batch_sharding)
+                )
+                c0.report_global_step(int(state["step"]), time.time())
+            et.result.state = state
+            step0 = int(state["step"])
+            saved = jax.tree_util.tree_map(
+                lambda x: np.asarray(x).copy(), state
+            )
+            # Persist the step-2 snapshot: the restart path's source.
+            ck = FlashCheckpointer(str(tmp_path / "ckpts"))
+            ck.save_checkpoint(step0, state, StorageType.DISK)
+            assert ck.wait_persisted(step0, timeout=60)
+            ck.close()
+
+            # The fault: SIGKILL one of the four workers.
+            procs[3].kill()
+            procs[3].wait()
+
+            # Heartbeat timeout -> eviction -> shrink plan. The survivor
+            # polls it over the real RPC.
+            plan = None
+            deadline = time.monotonic() + 30
+            while plan is None and time.monotonic() < deadline:
+                plan = eng.poll()
+                time.sleep(0.1)
+            assert plan is not None, "no shrink plan issued"
+            assert sorted(plan.new_world) == [0, 1, 2]
+            assert plan.accum_counts == [2, 1, 1]
+
+            tr = eng.apply(plan, state=state)
+            assert tr.ok
+            # No disk restore on the survivor: live d2d transfer only.
+            assert tr.source == "live"
+            # Stand-in acks for the other two survivors' trainers.
+            for r in (1, 2):
+                c = MasterClient(master.addr, node_id=r)
+                c.report_rescale_ack(plan.plan_id, r, True)
+                c.close()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if not c0.get_rescale_plan(TRAIN, 0, round_).exists:
+                    break
+                time.sleep(0.1)
+            assert not c0.world_stale(TRAIN, plan.new_round), (
+                "plan aborted instead of completing"
+            )
+
+            # Bit-identity, part 1: the transfer preserved every leaf.
+            assert_leaves_equal(tr.state, saved)
+            # Part 2: the in-place step equals the restart-path step — a
+            # fresh world-3 trainer restored from disk, same batch.
+            et_r = ElasticTrainer(global_batch_size=16, micro_batch_size=4,
+                                  world_size=3, rank=0)
+            et_r.prepare(GPT(cfg), optax.adamw(1e-3), micro, token_loss,
+                         spec=ParallelSpec(data=1))
+            ck2 = FlashCheckpointer(str(tmp_path / "ckpts"))
+            rstep, rstate = ck2.load_checkpoint(et_r.result.state)
+            ck2.close()
+            assert rstep == step0
+            batch8 = jax.random.randint(
+                jax.random.PRNGKey(4), (8, 16), 0, cfg.vocab_size
+            )
+            s_ip, m_ip = et.result.train_step(
+                tr.state, jax.device_put(batch8, et.result.batch_sharding)
+            )
+            s_rs, m_rs = et_r.result.train_step(
+                rstate, jax.device_put(batch8, et_r.result.batch_sharding)
+            )
+            assert float(m_ip["loss"]) == float(m_rs["loss"]), (
+                "in-place shrink diverged from the restart path"
+            )
+            assert_leaves_equal(s_ip, s_rs)
+            c0.report_global_step(int(s_ip["step"]), time.time())
+
+            # Regrow: the dead node comes back and is absorbed in place.
+            procs[3] = _spawn_heartbeater(master.addr, 3)
+            plan2 = None
+            deadline = time.monotonic() + 30
+            while plan2 is None and time.monotonic() < deadline:
+                plan2 = eng.poll()
+                time.sleep(0.1)
+            assert plan2 is not None, "no grow plan issued"
+            assert sorted(plan2.new_world) == [0, 1, 2, 3]
+            assert plan2.accum_counts == [1, 1, 1, 1]
+            tr2 = eng.apply(plan2, state=s_ip)
+            assert tr2.ok and tr2.source == "live"
+            for r in (1, 2):
+                c = MasterClient(master.addr, node_id=r)
+                c.report_rescale_ack(plan2.plan_id, r, True)
+                c.close()
+            # Back on the exact original schedule; training continues.
+            assert et.schedule.counts == [1, 1, 1, 1]
+            s_f, m_f = et.result.train_step(
+                tr2.state, jax.device_put(batch4, et.result.batch_sharding)
+            )
+            assert int(s_f["step"]) == step0 + 2
+            assert np.isfinite(float(m_f["loss"]))
+        finally:
+            stop_hb.set()
+            if hb.is_alive():
+                hb.join(timeout=2)
+            for p in procs.values():
+                try:
+                    p.kill()
+                    p.wait(timeout=5)
+                except Exception:
+                    pass
+            c0.close()
+            master.stop()
+            (ctx.heartbeat_timeout, ctx.node_monitor_interval) = old_ctx

@@ -35,7 +35,7 @@ REGRESSION_PCT = 5.0
 _INTERESTING = re.compile(
     r"(tokens_per_s|goodput_.*_pct|mbps|speedup|mfu_pct|step_time_ms"
     r"|_save_s|restore_ms|overhead|wall_.*_s|blocking_save"
-    r"|_gb$|_bytes|_cut_x)", re.I,
+    r"|_gb$|_bytes|_cut_x|rescale)", re.I,
 )
 
 #: Lower-is-better keys: latencies, wall clocks, overheads — and memory
