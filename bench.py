@@ -818,6 +818,144 @@ def section_ckpt_io():
     return out
 
 
+def section_ckpt_dedup():
+    """Replica-deduplicated persist: full-fleet vs single-writer A/B.
+
+    A {data:4} virtual mesh of real ``CheckpointEngine`` instances over
+    the same 256 MB replicated payload. The full-fleet arm is the
+    pre-dedup world: every replica persists its full copy. The dedup arm
+    runs the writer election (replica-0 fallback — no master in the
+    bench) so one replica writes and three skip; per-replica traffic is
+    measured at the storage boundary with ``CountingStorage``, restore
+    output is byte-compared between the arms, and a second step that
+    touches a few bytes measures the content-hash incremental-stripe
+    cut."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from dlrover_tpu.common.storage import CountingStorage, PosixDiskStorage
+    from dlrover_tpu.train.checkpoint.engine import CheckpointEngine
+
+    mb = int(os.getenv("DLROVER_TPU_BENCH_CKPT_DEDUP_MB", "256"))
+    ndp = 4
+    total = mb << 20
+    # 8 MB stripes: fine enough that a few-byte mutation rewrites <10%
+    # of the stripes, the incremental acceptance case.
+    prev_stripe = os.environ.get("DLROVER_TPU_CKPT_STRIPE_MB")
+    os.environ["DLROVER_TPU_CKPT_STRIPE_MB"] = "8"
+    rng = np.random.default_rng(7)
+    n_leaves = 8
+    leaf = total // n_leaves
+    state = {
+        f"w{i}": np.frombuffer(rng.bytes(leaf), dtype=np.uint8).copy()
+        for i in range(n_leaves)
+    }
+
+    def flat_bytes(tree):
+        return b"".join(bytes(tree[k]) for k in sorted(tree))
+
+    out = {"payload_mb": mb, "replicas": ndp}
+    td = tempfile.mkdtemp(prefix="bench_dedup_")
+    engines = []
+    try:
+        # --- full-fleet arm: every replica persists its own full copy ---
+        full_counts = []
+        t0 = time.perf_counter()
+        for r in range(ndp):
+            st = CountingStorage(PosixDiskStorage())
+            eng = CheckpointEngine(
+                os.path.join(td, f"full_r{r}"), storage=st,
+                job=f"bench-dedup-full-{r}",
+            )
+            engines.append(eng)
+            assert eng.save_to_storage(1, state)
+            full_counts.append(st.write_bytes_total)
+        out["persist_wall_full_s"] = round(time.perf_counter() - t0, 3)
+        full_total = sum(full_counts)
+
+        # --- dedup arm: one shared dir, elected single writer ---
+        dedup_counts = []
+        dedup_engines = []
+        t0 = time.perf_counter()
+        for r in range(ndp):
+            st = CountingStorage(PosixDiskStorage())
+            eng = CheckpointEngine(
+                os.path.join(td, "dedup"), storage=st,
+                job=f"bench-dedup-sw-{r}",
+                replica_rank=r, replica_count=ndp,
+            )
+            engines.append(eng)
+            dedup_engines.append((eng, st))
+            assert eng.save_to_storage(1, state)
+            dedup_counts.append(st.write_bytes_total)
+        out["persist_wall_dedup_s"] = round(time.perf_counter() - t0, 3)
+        dedup_total = sum(dedup_counts)
+        out["persist_bytes_per_replica"] = dedup_total // ndp
+        out["full_bytes_per_replica"] = full_total // ndp
+        out["dedup_cut_x"] = round(full_total / max(dedup_total, 1), 2)
+        out["skipped_replicas_wrote"] = sum(dedup_counts[1:])
+
+        # --- restore: dedup arm must be byte-identical to full fleet ---
+        r_st = CountingStorage(PosixDiskStorage())
+        restorer = CheckpointEngine(
+            os.path.join(td, "dedup"), storage=r_st,
+            job="bench-dedup-restore",
+        )
+        engines.append(restorer)
+        template = {k: np.zeros_like(v) for k, v in state.items()}
+        step, got = restorer.load(template)
+        assert step == 1
+        full_restorer = CheckpointEngine(
+            os.path.join(td, "full_r0"), storage=PosixDiskStorage(),
+            job="bench-dedup-restore-full",
+        )
+        engines.append(full_restorer)
+        _, got_full = full_restorer.load(
+            {k: np.zeros_like(v) for k, v in state.items()}
+        )
+        out["restore_identical"] = flat_bytes(got) == flat_bytes(got_full)
+        out["restore_read_bytes"] = restorer.last_restore_stats.get(
+            "storage_read_bytes", 0
+        )
+
+        # --- incremental second step: touch a few bytes, persist refs ---
+        state["w0"][: 64 << 10] ^= 0xFF  # one 64 KB slice → 1 dirty stripe
+        owner, owner_st = dedup_engines[0]
+        before = owner_st.write_bytes_total
+        assert owner.save_to_storage(2, state)
+        inc = owner_st.write_bytes_total - before
+        out["incremental_bytes"] = inc
+        out["incremental_pct"] = round(inc / total * 100, 2)
+
+        # Incremental restore must still reproduce the mutated payload.
+        r2 = CheckpointEngine(
+            os.path.join(td, "dedup"), storage=PosixDiskStorage(),
+            job="bench-dedup-restore2",
+        )
+        engines.append(r2)
+        step2, got2 = r2.load(
+            {k: np.zeros_like(v) for k, v in state.items()}
+        )
+        out["incremental_restore_ok"] = (
+            step2 == 2 and flat_bytes(got2) == flat_bytes(state)
+        )
+    finally:
+        if prev_stripe is None:
+            os.environ.pop("DLROVER_TPU_CKPT_STRIPE_MB", None)
+        else:
+            os.environ["DLROVER_TPU_CKPT_STRIPE_MB"] = prev_stripe
+        for eng in engines:
+            try:
+                eng.close()
+            except Exception:
+                pass
+        shutil.rmtree(td, ignore_errors=True)
+    log(f"bench[ckpt_dedup]: {out}")
+    return out
+
+
 def section_goodput():
     """Elastic-stack goodput under injected failures (CPU backend,
     real master/agent/worker processes — the machinery is what's being
@@ -1191,9 +1329,9 @@ def main():
     # Most-load-bearing first: if the driver's time limit bites, the
     # budget guard sheds the tail sections, not the headline.
     default_sections = (
-        "small,large,llama,longctx,goodput,ckpt_io,opt_shard,rescale,"
-        "medium"
-        if on_tpu else "small,goodput,ckpt_io,opt_shard,rescale"
+        "small,large,llama,longctx,goodput,ckpt_io,ckpt_dedup,"
+        "opt_shard,rescale,medium"
+        if on_tpu else "small,goodput,ckpt_io,ckpt_dedup,opt_shard,rescale"
     )
     sections = os.getenv(
         "DLROVER_TPU_BENCH_SECTIONS", default_sections
@@ -1229,6 +1367,8 @@ def main():
                 extra["opt_shard"] = section_opt_shard(peak)
             elif name == "ckpt_io":
                 extra["ckpt_io"] = section_ckpt_io()
+            elif name == "ckpt_dedup":
+                extra["ckpt_dedup"] = section_ckpt_dedup()
             elif name == "goodput":
                 extra["goodput"] = section_goodput()
             elif name == "rescale":

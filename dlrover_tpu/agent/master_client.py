@@ -159,6 +159,17 @@ class MasterClient:
             )
         )
 
+    def elect_ckpt_writer(self, group: str, epoch: int,
+                          rank: int) -> m.CkptWriterLease:
+        """Propose this replica as the checkpoint writer for `group`.
+
+        First claimant wins; the returned lease names the elected owner
+        (``lease.owner_rank``), which every proposer of the same
+        (group, epoch) observes identically."""
+        return self._call(
+            m.CkptWriterElect(group=group, epoch=epoch, rank=rank)
+        )
+
     def report_rdzv_params(self, min_nodes: int, max_nodes: int,
                            waiting_timeout: float, node_unit: int):
         return self._call(

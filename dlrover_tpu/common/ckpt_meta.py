@@ -83,11 +83,21 @@ class StripeMeta:
     may span many small leaves, one huge leaf may span many stripes.
     Per-stripe checksums let restore verify in parallel and localize
     corruption to a stripe instead of failing the whole shard opaquely.
+
+    The crc doubles as a content hash for incremental persist: when a
+    stripe's bytes are unchanged since the previous committed step (same
+    offset, length and crc), the writer records ``ref_step`` — the step
+    whose ``.bin`` physically holds the bytes, at the *same offset* —
+    instead of rewriting them. ``-1`` means the bytes live in this
+    step's own bin. Refs always point at the original owner (never at
+    another referencing step), so resolution is one hop. Read via
+    getattr — stripes pickled before this field existed resolve to -1.
     """
 
     offset: int = 0
     nbytes: int = 0
     crc: int = 0
+    ref_step: int = -1
 
 
 @dataclass
@@ -125,6 +135,12 @@ class ShardMeta:
     # to name both degrees when a cross-degree re-slice can't cover the
     # requested template. Read via getattr — old pickles lack the field.
     zero_degree: int = 0
+    # Mesh axes the shard was saved under (e.g. {"data": 4}). Purely
+    # diagnostic: cross-topology restore re-slices through the block
+    # catalog regardless, but when the saved blocks cannot cover the
+    # requested template this names both topologies in the error.
+    # Read via getattr — old pickles lack the field.
+    mesh_axes: Optional[Dict[str, int]] = None
 
 
 @dataclass

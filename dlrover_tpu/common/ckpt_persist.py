@@ -91,6 +91,38 @@ class ZeroDegreeMismatchError(Exception):
         self.restore_degree = restore_degree
 
 
+class TopologyMismatchError(Exception):
+    """A checkpoint can't be re-sliced for the restoring mesh topology.
+
+    The whole-tree generalization of :class:`ZeroDegreeMismatchError`:
+    the step on disk is intact, but the persisted blocks of some leaf do
+    not tile the requested template and the ZeRO degrees agree — the
+    mesh shape itself changed beyond what the saved shards can rebuild
+    (e.g. a shard file lost to partial copy between topologies). Like
+    the degree mismatch, this is deliberately *not* a
+    :class:`StepCorruptionError`: falling back to an older step would
+    silently load wrong slices, so it propagates, naming both
+    topologies."""
+
+    def __init__(self, step: int, saved_axes, restore_axes, detail: str = ""):
+        msg = (
+            f"checkpoint step {step} was saved under mesh axes "
+            f"{saved_axes or 'unknown'} but is being restored under "
+            f"{restore_axes or 'unknown'}, and the persisted blocks do "
+            "not cover the requested template"
+        )
+        if detail:
+            msg += f" ({detail})"
+        msg += (
+            "; restore with a coverable topology or re-save under the "
+            "new mesh"
+        )
+        super().__init__(msg)
+        self.step = step
+        self.saved_axes = saved_axes
+        self.restore_axes = restore_axes
+
+
 def step_dir(ckpt_dir: str, step: int) -> str:
     return os.path.join(ckpt_dir, f"{CheckpointConstant.STEP_DIR_PREFIX}{step}")
 
@@ -120,6 +152,11 @@ def stripe_bytes_config() -> int:
     if mb <= 0:
         return 0
     return max(1 << 20, int(mb * (1 << 20)))
+
+
+def incremental_enabled() -> bool:
+    """Content-hash incremental stripes on/off (needs striping too)."""
+    return env_utils.CKPT_INCREMENTAL.get()
 
 
 def _plan_stripes(chunks: List[memoryview],
@@ -163,37 +200,105 @@ def _stripe_crc(views: List[memoryview], algo: str) -> Tuple[int, float]:
     return inc.digest(), time.perf_counter() - t0
 
 
-def _write_striped(storage: CheckpointStorage, path: str,
-                   chunks: List[memoryview], total: int,
-                   stripe_bytes: int) -> Tuple[List[StripeMeta], float]:
+def _write_striped(
+    storage: CheckpointStorage, path: str,
+    chunks: List[memoryview], total: int, stripe_bytes: int,
+    prev: Optional[Dict[int, Tuple[int, int, int]]] = None,
+) -> Tuple[List[StripeMeta], float, int]:
     """The pipelined persist: for each stripe, submit its checksum to the
-    pool, then write it positionally while the pool works — checksum and
-    I/O overlap instead of alternating. One fsync + atomic rename at
-    commit (the writer handle owns the protocol). Returns the stripe
-    metas (in file order) and total checksum CPU-seconds."""
+    pool; once the crc is reaped the stripe is written positionally —
+    checksum and I/O still overlap (the write trails the hash by up to
+    the pipeline depth), but now the hash gates the write: with ``prev``
+    (the previous committed step's stripe table,
+    ``{offset: (nbytes, crc, owner_step)}``), a stripe whose offset,
+    length and crc all match is recorded as a *reference* to the owner
+    step's bin instead of rewritten — only changed bytes hit storage.
+    One fsync + atomic rename at commit (the writer handle owns the
+    protocol; unwritten referenced ranges stay holes in the preallocated
+    file and are never read from it). Returns the stripe metas (in file
+    order), total checksum CPU-seconds, and the bytes actually written.
+    """
     plan = _plan_stripes(chunks, stripe_bytes)
     algo = checksum.DEFAULT_ALGO
     stripes: List[StripeMeta] = []
     checksum_s = 0.0
-    pending = deque()  # (offset, nbytes, future)
-
-    def _reap():
-        nonlocal checksum_s
-        off, nbytes, fut = pending.popleft()
-        crc, cpu_s = fut.result()
-        checksum_s += cpu_s
-        stripes.append(StripeMeta(offset=off, nbytes=nbytes, crc=crc))
+    written = 0
+    pending = deque()  # (offset, nbytes, views, future)
 
     with storage.open_writer(path, total) as w:
+        def _reap():
+            nonlocal checksum_s, written
+            off, nbytes, views, fut = pending.popleft()
+            crc, cpu_s = fut.result()
+            checksum_s += cpu_s
+            hit = prev.get(off) if prev else None
+            if hit is not None and hit[0] == nbytes and hit[1] == crc:
+                stripes.append(StripeMeta(
+                    offset=off, nbytes=nbytes, crc=crc, ref_step=hit[2]
+                ))
+                return
+            w.writev_at(off, views)
+            written += nbytes
+            stripes.append(StripeMeta(offset=off, nbytes=nbytes, crc=crc))
+
         for off, views in plan:
             nbytes = sum(v.nbytes for v in views)
-            pending.append((off, nbytes, fastcopy.submit(_stripe_crc, views, algo)))
-            w.writev_at(off, views)
+            pending.append(
+                (off, nbytes, views, fastcopy.submit(_stripe_crc, views, algo))
+            )
             while len(pending) >= _PIPELINE_DEPTH:
                 _reap()
         while pending:
             _reap()
-    return stripes, checksum_s
+    return stripes, checksum_s, written
+
+
+def _prev_stripe_map(
+    storage: CheckpointStorage, ckpt_dir: str, step: int, gid: int,
+    stripe_bytes: int,
+) -> Optional[Dict[int, Tuple[int, int, int]]]:
+    """Stripe table of the newest committed step below `step` for shard
+    `gid`: ``{offset: (nbytes, crc, owner_step)}``, for the incremental
+    persist to diff against. ``owner_step`` follows one existing ref hop
+    so new references always point at the bin that physically holds the
+    bytes — chains never deepen. None when there is nothing safe to
+    reference (no committed prior step, quarantined, different stripe
+    size or checksum algorithm — offsets/crcs would not be comparable).
+    """
+    tracker = read_tracker(storage, ckpt_dir)
+    if tracker is None or tracker >= step:
+        return None
+    if is_quarantined(storage, ckpt_dir, tracker):
+        return None
+    d = step_dir(ckpt_dir, tracker)
+    prefix = os.path.join(d, f"{CheckpointConstant.SHARD_FILE_PREFIX}{gid}")
+    raw = storage.read_bytes(prefix + ".meta")
+    if raw is None:
+        return None
+    try:
+        meta = pickle.loads(raw)
+    except Exception:
+        return None
+    stripes = getattr(meta, "stripes", None)
+    if not stripes or getattr(meta, "stripe_bytes", 0) != stripe_bytes:
+        return None
+    if getattr(meta, "crc_algo", "") != checksum.DEFAULT_ALGO:
+        return None
+    out: Dict[int, Tuple[int, int, int]] = {}
+    for s in stripes:
+        ref = getattr(s, "ref_step", -1)
+        owner = ref if ref >= 0 else tracker
+        out[s.offset] = (s.nbytes, s.crc, owner)
+    return out
+
+
+def step_refs(meta: ShardMeta) -> set:
+    """Steps whose bins a shard meta's stripes reference (excluding its
+    own) — the GC liveness inputs."""
+    return {
+        ref for s in (getattr(meta, "stripes", None) or [])
+        if (ref := getattr(s, "ref_step", -1)) >= 0
+    }
 
 
 def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
@@ -236,6 +341,7 @@ def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
 
     stripe_bytes = stripe_bytes_config()
     t0 = time.perf_counter()
+    written = offset
     if stripe_bytes:
         file_off = 0
         disk_tensors = []
@@ -243,9 +349,13 @@ def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
             disk_tensors.append(
                 dataclasses.replace(t, offset=file_off, crc=None))
             file_off += t.nbytes
-        stripes, checksum_s = _write_striped(
+        prev = (
+            _prev_stripe_map(storage, ckpt_dir, meta.step, gid, stripe_bytes)
+            if incremental_enabled() else None
+        )
+        stripes, checksum_s, written = _write_striped(
             storage, prefix + ".bin", [b for _, b in pairs], offset,
-            stripe_bytes,
+            stripe_bytes, prev=prev,
         )
     else:
         # Legacy format: one CRC per block, serial checksum-then-write.
@@ -272,6 +382,9 @@ def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
     storage.write(
         "", os.path.join(d, f"{CheckpointConstant.DONE_FILE_PREFIX}{gid}")
     )
+    ref_stripes = sum(
+        1 for s in (stripes or []) if getattr(s, "ref_step", -1) >= 0
+    )
     stats = {
         "bytes": float(offset),
         "opt_bytes": float(opt_bytes),
@@ -279,6 +392,12 @@ def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
         "persist_mbps": (offset / persist_s / 1e6) if persist_s > 0 else 0.0,
         "checksum_s": checksum_s,
         "striped": 1.0 if stripe_bytes else 0.0,
+        # Incremental accounting: bytes physically written this step
+        # (== payload when nothing could be referenced) and how many
+        # stripes rode as references to an earlier step's bin.
+        "written_bytes": float(written),
+        "ref_stripes": float(ref_stripes),
+        "total_stripes": float(len(stripes or [])),
     }
     try:
         from dlrover_tpu.observability.events import EventKind, emit
@@ -288,6 +407,7 @@ def persist_shard(storage: CheckpointStorage, ckpt_dir: str,
             bytes=offset, mbps=round(stats["persist_mbps"], 1),
             checksum_s=round(checksum_s, 4), striped=bool(stripe_bytes),
             opt_bytes=opt_bytes,
+            written_bytes=written, ref_stripes=ref_stripes,
             zero_degree=getattr(meta, "zero_degree", 0),
         )
     except Exception:  # dtlint: disable=DT001 -- observability must never fail a persist
@@ -430,6 +550,111 @@ def open_shard_reader(storage: CheckpointStorage, ckpt_dir: str, step: int,
     return storage.open_reader(shard_bin_path(ckpt_dir, step, gid))
 
 
+class _RoutedShardReader(RangeReader):
+    """A RangeReader over a shard whose stripes may reference earlier
+    steps' bins (incremental persist): byte ranges inside a referenced
+    stripe are served from the owner step's bin *at the same offset*
+    (references only happen when content at that offset is unchanged, so
+    the layouts coincide); everything else reads the step's own bin.
+    Owner-step readers open lazily under a lock (stripe verification
+    reads through this from the fastcopy pool)."""
+
+    def __init__(self, storage: CheckpointStorage, ckpt_dir: str,
+                 step: int, gid: int, meta: ShardMeta):
+        import bisect
+        import threading
+
+        self._bisect = bisect
+        self._storage = storage
+        self._ckpt_dir = ckpt_dir
+        self._step = step
+        self._gid = gid
+        # Sorted (start, end, owner_step) spans; -1 owner = own bin.
+        self._spans = sorted(
+            (s.offset, s.offset + s.nbytes, getattr(s, "ref_step", -1))
+            for s in (getattr(meta, "stripes", None) or [])
+        )
+        self._starts = [sp[0] for sp in self._spans]
+        self._readers: Dict[int, Optional[RangeReader]] = {}
+        self._open_lock = threading.Lock()
+
+    def _reader_for(self, owner: int) -> Optional[RangeReader]:
+        with self._open_lock:
+            if owner not in self._readers:
+                target = self._step if owner < 0 else owner
+                self._readers[owner] = self._storage.open_reader(
+                    shard_bin_path(self._ckpt_dir, target, self._gid)
+                )
+            return self._readers[owner]
+
+    def _route(self, offset: int, nbytes: int):
+        """Split [offset, offset+nbytes) into (offset, nbytes, owner)
+        pieces along the stripe spans; gaps outside the table read own."""
+        end = offset + nbytes
+        while offset < end:
+            i = self._bisect.bisect_right(self._starts, offset) - 1
+            owner = -1
+            stop = end
+            if 0 <= i < len(self._spans) and offset < self._spans[i][1]:
+                owner = self._spans[i][2]
+                stop = min(end, self._spans[i][1])
+            elif i + 1 < len(self._spans):
+                stop = min(end, self._spans[i + 1][0])
+            yield offset, stop - offset, owner
+            offset = stop
+
+    def read_into(self, offset: int, view) -> int:
+        mv = memoryview(view)
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        total = 0
+        for off, n, owner in self._route(offset, mv.nbytes):
+            r = self._reader_for(owner)
+            if r is None:
+                break
+            got = r.read_into(off, mv[total:total + n])
+            total += got
+            if got != n:
+                break
+        return total
+
+    def read(self, offset: int, nbytes: int) -> bytes:
+        buf = bytearray(nbytes)
+        n = self.read_into(offset, memoryview(buf))
+        return bytes(buf[:n])
+
+    def size(self) -> Optional[int]:
+        own = self._reader_for(-1)
+        return None if own is None else own.size()
+
+    def close(self) -> None:
+        with self._open_lock:
+            for r in self._readers.values():
+                if r is not None:
+                    try:
+                        r.close()
+                    except OSError:
+                        pass
+            self._readers.clear()
+
+
+def open_routed_reader(storage: CheckpointStorage, ckpt_dir: str, step: int,
+                       gid: int, meta: ShardMeta) -> Optional[RangeReader]:
+    """The reader restore/verify should use: a plain shard reader when
+    every stripe's bytes live in the step's own bin, a routing reader
+    when incremental persist referenced earlier steps. Returns None when
+    the step's own bin is missing (a fully-referenced bin still exists —
+    the writer creates it, holes and all)."""
+    if any(
+        getattr(s, "ref_step", -1) >= 0
+        for s in (getattr(meta, "stripes", None) or [])
+    ):
+        if not storage.exists(shard_bin_path(ckpt_dir, step, gid)):
+            return None
+        return _RoutedShardReader(storage, ckpt_dir, step, gid, meta)
+    return open_shard_reader(storage, ckpt_dir, step, gid)
+
+
 #: Scratch granularity for stripe verification — bounds per-task memory
 #: while keeping reads large enough to stream.
 _VERIFY_CHUNK = 4 << 20
@@ -555,8 +780,11 @@ def verify_step(storage: CheckpointStorage, ckpt_dir: str,
         if getattr(meta, "stripes", None):
             # Striped format: parallel per-stripe verification over one
             # shared reader covers every persisted byte, including a
-            # length check (a short stripe read is truncation).
-            reader = open_shard_reader(storage, ckpt_dir, step, gid)
+            # length check (a short stripe read is truncation). The
+            # routed reader resolves referenced stripes through their
+            # owner step's bin, so a step built incrementally only
+            # verifies if every bin it references is intact too.
+            reader = open_routed_reader(storage, ckpt_dir, step, gid, meta)
             if reader is None:
                 return False, f"shard {gid} bin missing"
             try:
@@ -614,7 +842,14 @@ def gc_steps(storage: CheckpointStorage, ckpt_dir: str, keep_latest: int):
     cached and restore skips them too) and deleted like any other
     non-keeper. Verification walks newest-first and stops once
     `keep_latest` keepers are found, so old already-doomed dirs are not
-    re-read before removal."""
+    re-read before removal.
+
+    Incremental-stripe liveness rule: a stripe is live while any kept
+    step references it, so a step dir whose bin a keeper's stripes point
+    into is *pinned* — it survives GC even when it falls outside the
+    keep window (and even if independently quarantined: its bytes are
+    still what makes the keeper restorable — the keeper's own routed
+    verification already proved the referenced ranges intact)."""
     tracker = read_tracker(storage, ckpt_dir)
     if tracker is None or keep_latest <= 0:
         return
@@ -631,6 +866,17 @@ def gc_steps(storage: CheckpointStorage, ckpt_dir: str, keep_latest: int):
             keep.add(s)
         else:
             quarantine_step(storage, ckpt_dir, s, f"gc verify: {reason}")
+    # Pin every step a keeper references (closure-walked defensively,
+    # though the writer flattens ref chains to the owner at persist).
+    frontier = set(keep)
+    pinned = set(keep)
+    while frontier:
+        refs = set()
+        for s in frontier:
+            for meta in load_step_metas(storage, ckpt_dir, s).values():
+                refs |= step_refs(meta)
+        frontier = refs - pinned
+        pinned |= refs
     for s in candidates:
-        if s not in keep:
+        if s not in pinned:
             storage.safe_remove(step_dir(ckpt_dir, s))

@@ -236,6 +236,12 @@ CKPT_STRIPE_MB = ENV.float(
     "DLROVER_TPU_CKPT_STRIPE_MB", 32.0,
     "Stripe size for parallel checkpoint I/O; 0 = legacy per-block "
     "format; clamped to >= 1 MB otherwise.")
+CKPT_INCREMENTAL = ENV.bool(
+    "DLROVER_TPU_CKPT_INCREMENTAL", True,
+    "Content-hash incremental stripes: a stripe whose crc is unchanged "
+    "since the previous committed step is recorded as a reference to "
+    "that step's bin instead of rewritten; 0/false/off rewrites every "
+    "byte each step.")
 COPY_THREADS = ENV.int(
     "DLROVER_TPU_COPY_THREADS", 8,
     "Worker threads in the fastcopy pool (checksum + memcpy pipeline).")

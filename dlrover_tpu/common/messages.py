@@ -352,6 +352,43 @@ class RescaleAck(BaseRequest):
     error: str = ""
 
 
+# ---------------- checkpoint writer election ----------------
+
+
+@dataclass
+class CkptWriterElect(BaseRequest):
+    """Propose this replica as the disk writer for a checkpoint group.
+
+    First claimant wins: the master answers every proposer for the same
+    (group, epoch) with the one elected owner rank. Journaled — replay
+    re-runs the same first-claimant race in journal order, so the winner
+    is identical after a master failover and no second writer is ever
+    elected for a committed epoch.
+    """
+
+    journaled = True
+
+    #: checkpoint group identity, e.g. "<ckpt_dir>:shard<gid>"
+    group: str = ""
+    #: election epoch (restart incarnation); a new epoch re-elects
+    epoch: int = 0
+    #: the proposing replica's rank along the data axis
+    rank: int = -1
+
+
+@dataclass
+class CkptWriterLease:
+    """The election answer: which replica persists this group this epoch."""
+
+    group: str = ""
+    epoch: int = 0
+    owner_rank: int = -1
+
+    @property
+    def exists(self) -> bool:
+        return self.owner_rank >= 0
+
+
 # ---------------- sync service ----------------
 
 

@@ -23,6 +23,7 @@ storage through two capability handles:
 
 import os
 import shutil
+import threading
 from abc import ABC, abstractmethod
 from typing import List, Optional
 
@@ -360,6 +361,149 @@ class PosixDiskStorage(CheckpointStorage):
         if not os.path.isdir(path):
             return []
         return sorted(os.listdir(path))
+
+
+class CountingStorage(CheckpointStorage):
+    """Delegating wrapper that accounts bytes crossing the storage boundary.
+
+    ``read_bytes_total`` / ``write_bytes_total`` sum every read and write
+    issued through the wrapper, including positional reader/writer traffic.
+    Used by tests and the dedup bench to prove the replica-dedup contracts
+    at the only layer that can't lie about them: non-elected replicas write
+    zero bytes per checkpoint, and broadcast restore reads each persisted
+    byte once instead of once per replica.
+    """
+
+    def __init__(self, base: CheckpointStorage):
+        self.base = base
+        self._lock = threading.Lock()
+        self.read_bytes_total = 0
+        self.write_bytes_total = 0
+
+    def reset_counts(self):
+        with self._lock:
+            self.read_bytes_total = 0
+            self.write_bytes_total = 0
+
+    def _add_read(self, n: int):
+        with self._lock:
+            self.read_bytes_total += int(n)
+
+    def _add_write(self, n: int):
+        with self._lock:
+            self.write_bytes_total += int(n)
+
+    # -- writes --
+    def write(self, content, path: str):
+        if isinstance(content, (bytes, bytearray, memoryview)):
+            self._add_write(len(content))
+        else:
+            self._add_write(len(str(content)))
+        self.base.write(content, path)
+
+    def write_bytes(self, data: bytes, path: str):
+        self._add_write(len(data))
+        self.base.write_bytes(data, path)
+
+    def open_writer(self, path: str, size: Optional[int] = None) -> StripeWriter:
+        outer = self
+
+        base_writer = self.base.open_writer(path, size)
+
+        class _W:
+            def __enter__(self):
+                base_writer.__enter__()
+                return self
+
+            def __exit__(self, *exc):
+                return base_writer.__exit__(*exc)
+
+            def write_at(self, offset, data):
+                outer._add_write(_as_u8(data).nbytes)
+                return base_writer.write_at(offset, data)
+
+            def writev_at(self, offset, views):
+                views = [_as_u8(v) for v in views]
+                outer._add_write(sum(v.nbytes for v in views))
+                return base_writer.writev_at(offset, views)
+
+            def commit(self):
+                base_writer.commit()
+
+            def abort(self):
+                base_writer.abort()
+
+        return _W()
+
+    # -- reads --
+    def read(self, path: str, mode: str = "r"):
+        data = self.base.read(path, mode)
+        if data is not None:
+            self._add_read(len(data))
+        return data
+
+    def read_bytes(self, path: str) -> bytes:
+        data = self.base.read_bytes(path)
+        if data is not None:
+            self._add_read(len(data))
+        return data
+
+    def read_range(self, path: str, offset: int, nbytes: int):
+        data = self.base.read_range(path, offset, nbytes)
+        if data is not None:
+            self._add_read(len(data))
+        return data
+
+    def open_reader(self, path: str) -> Optional[RangeReader]:
+        base_reader = self.base.open_reader(path)
+        if base_reader is None:
+            return None
+        outer = self
+
+        class _R:
+            def read(self, offset, nbytes):
+                data = base_reader.read(offset, nbytes)
+                outer._add_read(len(data))
+                return data
+
+            def read_into(self, offset, view):
+                got = base_reader.read_into(offset, view)
+                outer._add_read(got)
+                return got
+
+            def size(self):
+                return base_reader.size()
+
+            def close(self):
+                base_reader.close()
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                self.close()
+                return False
+
+        return _R()
+
+    # -- passthrough --
+    def safe_rename(self, src: str, dst: str):
+        self.base.safe_rename(src, dst)
+
+    def safe_makedirs(self, path: str):
+        self.base.safe_makedirs(path)
+
+    def safe_remove(self, path: str):
+        self.base.safe_remove(path)
+
+    def exists(self, path: str) -> bool:
+        return self.base.exists(path)
+
+    def listdir(self, path: str):
+        return self.base.listdir(path)
+
+    def commit(self, step: int, success: bool):
+        self.base.commit(step, success)
 
 
 def get_checkpoint_storage(storage: Optional[CheckpointStorage] = None):

@@ -35,6 +35,19 @@ class KVStoreService:
         with self._lock:
             return {k: self._store.get(k) for k in keys}
 
+    def setnx(self, key: str, value: bytes) -> bytes:
+        """Set `key` to `value` only if absent; return the winning value.
+
+        The atomic first-claimant-wins primitive behind the checkpoint
+        writer election: every replica proposes itself and all of them
+        observe the same winner, including under concurrent proposals."""
+        with self._lock:
+            current = self._store.get(key)
+            if current is None:
+                self._store[key] = value
+                return value
+            return current
+
     def delete(self, key: str):
         with self._lock:
             self._store.pop(key, None)

@@ -38,6 +38,10 @@ _JOURNALED = (
     # Rescale acks decide plan completion vs abort; the outcome must
     # survive a master failover (replay re-derives it).
     m.RescaleAck,
+    # Writer elections are first-claimant races over kv state; journaling
+    # them replays the race in the original order, so a recovered master
+    # answers with the same owner it already promised.
+    m.CkptWriterElect,
 )
 
 #: Mutating messages journaled AFTER their handler runs: the record must
@@ -209,6 +213,17 @@ class MasterServicer:
     def _kv_delete(self, req: m.KVStoreDelete):
         self._kv_store.delete(req.key)
         return m.Response()
+
+    # ---------------- checkpoint writer election ----------------
+    def _ckpt_writer_elect(self, req: m.CkptWriterElect):
+        # First claimant wins; the decision lives in the kv store, so it
+        # rides in state snapshots for free and a late proposer (or a
+        # client retry) reads back the recorded owner.
+        key = f"ckpt_writer/{req.epoch}/{req.group}"
+        won = self._kv_store.setnx(key, str(req.rank).encode())
+        return m.CkptWriterLease(
+            group=req.group, epoch=req.epoch, owner_rank=int(won.decode())
+        )
 
     # ---------------- data sharding ----------------
     def _new_dataset(self, req: m.DatasetShardParams):
@@ -409,6 +424,7 @@ MasterServicer._HANDLERS = {
     m.KVStoreAdd: MasterServicer._kv_add,
     m.KVStoreMultiGet: MasterServicer._kv_multi_get,
     m.KVStoreDelete: MasterServicer._kv_delete,
+    m.CkptWriterElect: MasterServicer._ckpt_writer_elect,
     m.DatasetShardParams: MasterServicer._new_dataset,
     m.TaskRequest: MasterServicer._get_task,
     m.TaskReport: MasterServicer._report_task,

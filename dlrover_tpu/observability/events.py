@@ -48,8 +48,11 @@ class EventKind:
     CKPT_COMMIT = "ckpt.commit"
     CKPT_RESTORE = "ckpt.restore"
     CKPT_FALLBACK = "ckpt.fallback"
-    # Striped checkpoint I/O throughput (op="persist"|"read": bytes,
-    # mbps, checksum_s) — the perf counters behind the goodput story.
+    # Striped checkpoint I/O throughput (op="persist"|"read"|"staging"|
+    # "persist-skip": bytes, mbps, checksum_s; persist also carries
+    # written_bytes/ref_stripes for the incremental-stripe cut and
+    # persist-skip marks an election-skipped replica with bytes=0) —
+    # the perf counters behind the goodput story.
     CKPT_IO = "ckpt.io"
     CHAOS_INJECT = "chaos.inject"
     STEP_PROGRESS = "step.progress"

@@ -94,6 +94,15 @@ class ObservabilityPlane:
                 wall = float(by) / (float(mb) * 1e6) if mb else 0.0
                 if wall > 0:
                     sample["checksum_overhead"] = float(cs) / wall
+            # Replica-dedup accounting: persist events carry the bytes
+            # physically written (0 for election-skipped replicas and for
+            # stripes referenced from a previous step), so the per-replica
+            # persist-bytes gauge shows the dedup + incremental cut.
+            if by is not None:
+                sample["bytes"] = float(by)
+            wb = ev.args.get("written_bytes")
+            if wb is not None:
+                sample["written_bytes"] = float(wb)
             if sample:
                 self._ckpt_io[op] = sample
             return
@@ -183,6 +192,27 @@ class ObservabilityPlane:
                     "dlrover_tpu_ckpt_io_checksum_overhead_ratio", "gauge",
                     "Checksum CPU-seconds over persist wall seconds.",
                     overhead,
+                ))
+            byte_samples = [({"op": op}, s["bytes"])
+                            for op, s in sorted(self._ckpt_io.items())
+                            if "bytes" in s]
+            if byte_samples:
+                metrics.append((
+                    "dlrover_tpu_ckpt_io_bytes", "gauge",
+                    "Last checkpoint I/O payload bytes per op (persist-skip"
+                    " reports 0 — the replica-dedup cut is visible per"
+                    " replica).",
+                    byte_samples,
+                ))
+            written = [({"op": op}, s["written_bytes"])
+                       for op, s in sorted(self._ckpt_io.items())
+                       if "written_bytes" in s]
+            if written:
+                metrics.append((
+                    "dlrover_tpu_ckpt_io_written_bytes", "gauge",
+                    "Bytes physically written per op after incremental"
+                    " stripe dedup (referenced stripes cost 0).",
+                    written,
                 ))
         if self._task_manager is not None and hasattr(
             self._task_manager, "queue_depths"

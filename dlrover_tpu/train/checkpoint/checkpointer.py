@@ -67,25 +67,37 @@ class FlashCheckpointer(Checkpointer):
     """Checkpointer for a state dict every process holds in full (pure DP).
 
     Every process stages to its own shm (memory restore is node-local), but
-    only rank 0's copy is persisted as the single global disk shard
-    (parity: DdpCheckpointer, reference ``flash_checkpoint/ddp.py``). For
-    GSPMD-sharded states use ``ShardedCheckpointer`` (one shard per process).
+    only ONE replica's copy is persisted as the single global disk shard —
+    the master elects the writer per restart epoch (journaled first-claimant
+    election; deterministic replica-0 fallback without a master), so the
+    fleet writes each replicated byte once instead of world-size times
+    (parity: DdpCheckpointer, reference ``flash_checkpoint/ddp.py``;
+    replica dedup per arxiv 2605.23066). For GSPMD-sharded states use
+    ``ShardedCheckpointer`` (one shard per process).
     """
 
     def __init__(self, checkpoint_dir: str,
                  storage: Optional[CheckpointStorage] = None,
                  keep_latest: int = 3,
-                 zero_degree: int = 0):
+                 zero_degree: int = 0,
+                 mesh_axes=None):
         rank = int(os.getenv(NodeEnv.PROCESS_ID, "0"))
+        world = int(os.getenv(NodeEnv.NUM_PROCESSES, "1"))
         super().__init__(
             CheckpointEngine(
                 checkpoint_dir,
                 global_shard_id=0,
                 global_shard_num=1,
-                persist_shard=rank == 0,
+                # Everyone is persist-eligible; the election (or the
+                # replica-0 fallback, which reproduces the old hardwired
+                # rank==0 behavior) picks exactly one actual writer.
+                persist_shard=True,
                 storage=storage,
                 keep_latest=keep_latest,
                 zero_degree=zero_degree,
+                replica_rank=rank,
+                replica_count=world,
+                mesh_axes=mesh_axes,
             )
         )
 
@@ -105,7 +117,8 @@ class ShardedCheckpointer(Checkpointer):
     def __init__(self, checkpoint_dir: str,
                  storage: Optional[CheckpointStorage] = None,
                  keep_latest: int = 3,
-                 zero_degree: int = 0):
+                 zero_degree: int = 0,
+                 mesh_axes=None):
         rank = int(os.getenv(NodeEnv.PROCESS_ID, "0"))
         world = int(os.getenv(NodeEnv.NUM_PROCESSES, "1"))
         super().__init__(
@@ -117,5 +130,6 @@ class ShardedCheckpointer(Checkpointer):
                 storage=storage,
                 keep_latest=keep_latest,
                 zero_degree=zero_degree,
+                mesh_axes=mesh_axes,
             )
         )

@@ -113,6 +113,46 @@ def _memo_reader(read: Callable[[], np.ndarray]) -> Callable[[], np.ndarray]:
     return cached
 
 
+class _CountingReader:
+    """Delegating reader that accounts storage bytes into restore stats.
+
+    Broadcast restore's contract — survivors hydrate device-to-device
+    instead of each hammering storage — is only checkable if the bytes a
+    restore actually pread are measured at the reader boundary; tests and
+    the dedup bench assert on ``last_restore_stats["storage_read_bytes"]``.
+    Counter updates are lock-guarded: block reads run on the fastcopy pool.
+    """
+
+    def __init__(self, base, stats: Dict[str, Any]):
+        import threading
+
+        self._base = base
+        self._stats = stats
+        self._lock = threading.Lock()
+
+    def _count(self, n: int):
+        with self._lock:
+            self._stats["storage_read_bytes"] = (
+                self._stats.get("storage_read_bytes", 0) + int(n)
+            )
+
+    def read(self, offset: int, nbytes: int) -> bytes:
+        data = self._base.read(offset, nbytes)
+        self._count(len(data))
+        return data
+
+    def read_into(self, offset: int, view) -> int:
+        got = self._base.read_into(offset, view)
+        self._count(got)
+        return got
+
+    def size(self) -> int:
+        return self._base.size()
+
+    def close(self):
+        self._base.close()
+
+
 @dataclasses.dataclass
 class _Block:
     """One staged block in flight: metadata + an engine-owned data handle."""
@@ -144,6 +184,9 @@ class CheckpointEngine:
         keep_latest: int = 3,
         job: str = "",
         zero_degree: int = 0,
+        replica_rank: int = 0,
+        replica_count: int = 1,
+        mesh_axes: Optional[Dict[str, int]] = None,
     ):
         # Warm the copy engine off the critical path: the first snapshot
         # must not stall behind a toolchain build or calibration.
@@ -154,10 +197,21 @@ class CheckpointEngine:
         # Every process stages to its own shm (so memory restore is local);
         # only processes with persist_shard=True own a disk shard.
         self.persist_shard = persist_shard
+        # Replica-dedup: when `replica_count` > 1 this engine's shard is a
+        # data-parallel replica of `replica_count` identical copies and only
+        # the *elected* writer persists it (master-journaled first-claimant
+        # election; deterministic replica-0 fallback without a master) —
+        # the fleet writes each replicated byte once instead of Ndp times.
+        self.replica_rank = int(replica_rank)
+        self.replica_count = int(replica_count)
+        self._writer_owner: Optional[int] = None
         # ZeRO-1 degree the optimizer state is sharded over (0 = replicated).
         # Stamped into every ShardMeta so restore can name both degrees when
         # a checkpoint saved under a different data degree can't be re-sliced.
         self.zero_degree = int(zero_degree)
+        # Mesh axes this engine saves under (e.g. {"data": 4}); diagnostic
+        # context for cross-topology restore errors.
+        self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         self.storage = get_checkpoint_storage(storage)
         self.keep_latest = keep_latest
         self._job = job or os.getenv(NodeEnv.JOB_NAME, "local-job")
@@ -344,24 +398,65 @@ class CheckpointEngine:
 
         return [jnp.copy(a) for a in arrs]
 
+    # Target bytes per device_get batch on the staging path. One giant
+    # batched fetch serializes the whole D2H on a single transfer (BENCH_r06:
+    # ckpt_staging_mbps 2.0 vs d2h_probe_mbps 96.5); chunking lets the
+    # fastcopy pool overlap transfers and bounds peak scratch-host memory.
+    _STAGE_CHUNK_BYTES = 32 << 20
+
     def _fetch(self, blocks: List[_Block]) -> List[np.ndarray]:
-        """Complete the device→host fetch for every block (one batched
-        transfer), release the engine-owned handles, and return host arrays
-        aligned with `blocks`."""
+        """Complete the device→host fetch for every block, release the
+        engine-owned handles, and return host arrays aligned with `blocks`.
+
+        Device blocks are fetched in ~``_STAGE_CHUNK_BYTES`` groups through
+        the shared fastcopy pool so independent transfers overlap instead of
+        riding one serialized ``device_get``; every staging emits a
+        ``ckpt.io`` event with ``op="staging"`` so D2H throughput is
+        attributable per save."""
         import jax
 
         device_idx = [
             i for i, b in enumerate(blocks) if isinstance(b.handle, jax.Array)
         ]
-        fetched = jax.device_get([blocks[i].handle for i in device_idx])
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        cur_bytes = 0
+        for i in device_idx:
+            cur.append(i)
+            cur_bytes += int(blocks[i].handle.nbytes)
+            if cur_bytes >= self._STAGE_CHUNK_BYTES:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            groups.append(cur)
+
+        t0 = time.perf_counter()
+
+        def _get(idxs: List[int]):
+            return idxs, jax.device_get([blocks[i].handle for i in idxs])
+
+        by_slot: Dict[int, Any] = {}
+        for idxs, fetched in fastcopy.parallel_map(_get, groups):
+            for i, arr in zip(idxs, fetched):
+                by_slot[i] = arr
         out: List[np.ndarray] = []
-        by_slot = dict(zip(device_idx, fetched))
+        staged_bytes = 0
         for i, b in enumerate(blocks):
             arr = by_slot.get(i)
             if arr is None:
                 arr = np.asarray(b.handle)
-            out.append(np.asarray(arr))
+            host = np.asarray(arr)
+            out.append(host)
+            if i in by_slot:
+                staged_bytes += host.nbytes
             b.handle = None  # free the device/host-space copy eagerly
+        if staged_bytes:
+            wall = time.perf_counter() - t0
+            emit(
+                EventKind.CKPT_IO, op="staging", bytes=int(staged_bytes),
+                mbps=round(staged_bytes / max(wall, 1e-9) / 1e6, 1),
+                duration_s=round(wall, 4), chunks=len(groups),
+            )
         return out
 
     def _layout(
@@ -522,9 +617,14 @@ class CheckpointEngine:
                     objects=objects,
                     global_shard_id=self.global_shard_id,
                     global_shard_num=self.global_shard_num,
-                    persist=self.persist_shard,
+                    # Election-gated: the agent saver persists every local
+                    # shard whose meta says persist, so a non-elected
+                    # replica must publish False or the fleet re-gains the
+                    # Ndp× write amplification through the agent path.
+                    persist=self._persist_owner(),
                     layout_version=self._layout_version,
                     zero_degree=self.zero_degree,
+                    mesh_axes=self.mesh_axes,
                 )
                 self._publish_meta(shard_meta)
                 self._cached_step = step
@@ -543,7 +643,12 @@ class CheckpointEngine:
             self._meta_local[f"rank_{self._local_rank}"] = raw
 
     def save_to_storage(self, step: int, state) -> bool:
-        """Memory save + asynchronous (agent) or inline (standalone) persist."""
+        """Memory save + asynchronous (agent) or inline (standalone) persist.
+
+        With data-parallel replicas (``replica_count`` > 1) only the elected
+        writer persists; the other replicas stop after the memory stage —
+        their snapshot still serves warm restarts, but the fleet writes each
+        replicated byte once instead of Ndp times."""
         if not self.save_to_memory(step, state, block=True):
             return False
         if self.agent_mode:
@@ -553,9 +658,62 @@ class CheckpointEngine:
             if self._local_rank == 0:
                 self._events.put(SaveEvent(step=step))
             return True
-        if not self.persist_shard:
+        if not self._persist_owner():
+            if self.persist_shard:
+                # An eligible replica skipped by the election — record a
+                # zero-byte persist so the per-replica persist-bytes gauge
+                # shows the dedup cut, not a gap.
+                emit(
+                    EventKind.CKPT_IO, op="persist-skip", step=step,
+                    bytes=0, written_bytes=0,
+                    replica=self.replica_rank,
+                    owner=self._writer_owner
+                    if self._writer_owner is not None else 0,
+                )
             return True
         return self._persist_inline(step)
+
+    def _persist_owner(self) -> bool:
+        """Is this replica the disk writer for its shard group?
+
+        One replica (or no replica metadata): the static ``persist_shard``
+        flag stands. With data-parallel replicas the master runs a journaled
+        first-claimant election per (checkpoint_dir × shard) group and
+        restart epoch — the winning rank is durable across master failover
+        because the election RPC replays from the WAL and rides in state
+        snapshots. Without a master, the lowest replica rank wins, which
+        reproduces the classic rank-0-writes behavior deterministically."""
+        if not self.persist_shard:
+            return False
+        if self.replica_count <= 1:
+            return True
+        if self._writer_owner is None:
+            owner = 0
+            if os.getenv(NodeEnv.MASTER_ADDR):
+                try:
+                    from dlrover_tpu.agent.master_client import MasterClient
+
+                    epoch = int(os.getenv(NodeEnv.RESTART_COUNT, "0"))
+                    group = (
+                        f"{self.checkpoint_dir}:shard{self.global_shard_id}"
+                    )
+                    lease = MasterClient.singleton_instance().elect_ckpt_writer(
+                        group, epoch, self.replica_rank
+                    )
+                    if lease is not None and lease.exists:
+                        owner = lease.owner_rank
+                except Exception as e:
+                    logger.warning(
+                        "checkpoint writer election failed (%s); falling "
+                        "back to replica 0 as writer", e,
+                    )
+            self._writer_owner = owner
+            logger.info(
+                "checkpoint writer for shard %s is replica %s (this is "
+                "replica %s of %s)", self.global_shard_id, owner,
+                self.replica_rank, self.replica_count,
+            )
+        return self._writer_owner == self.replica_rank
 
     def _persist_inline(self, step: int) -> bool:
         meta = pickle.loads(self._meta_local[f"rank_{self._local_rank}"])
@@ -800,14 +958,18 @@ class CheckpointEngine:
             for gid in sorted(metas):
                 meta = metas[gid]
                 algo = getattr(meta, "crc_algo", "")
-                reader = ckpt_persist.open_shard_reader(
-                    self.storage, self.checkpoint_dir, step, gid
+                # Routed reader: a step persisted incrementally resolves
+                # stripes referencing earlier steps' bins transparently;
+                # for a self-contained step this is a plain shard reader.
+                reader = ckpt_persist.open_routed_reader(
+                    self.storage, self.checkpoint_dir, step, gid, meta
                 )
                 if reader is None and meta.tensors:
                     raise ckpt_persist.StepCorruptionError(
                         step, f"shard {gid} bin missing"
                     )
                 if reader is not None:
+                    reader = _CountingReader(reader, self._restore_stats)
                     readers.append(reader)
                     t_v0 = time.perf_counter()
                     ckpt_persist.verify_stripes(reader, meta, step, gid)
@@ -838,6 +1000,25 @@ class CheckpointEngine:
                     # a wrong slice silently; it propagates to the caller.
                     raise ckpt_persist.ZeroDegreeMismatchError(
                         step, saved_zero, self.zero_degree, str(e)
+                    ) from e
+                if "cover" in str(e):
+                    # Same ZeRO degree but the saved block catalog still
+                    # can't tile the requested template: the checkpoint was
+                    # written under a different mesh topology than the one
+                    # restoring it, and the gap is structural, not data
+                    # damage. Like the ZeRO case this propagates past the
+                    # fallback chain — an older step saved under the same
+                    # topology would have the same gap.
+                    saved_axes = next(
+                        (
+                            getattr(m, "mesh_axes", None)
+                            for m in metas.values()
+                            if getattr(m, "mesh_axes", None)
+                        ),
+                        None,
+                    )
+                    raise ckpt_persist.TopologyMismatchError(
+                        step, saved_axes, self.mesh_axes, str(e)
                     ) from e
                 raise
         finally:
@@ -874,6 +1055,13 @@ class CheckpointEngine:
             "read_mbps": 0.0,
             "step": -1, "skipped": [],
             "fallback_from": None, "fallback_reason": None,
+            # Broadcast-restore accounting: bytes actually pread from
+            # storage (at the reader boundary, so verify+reads both count),
+            # bytes moved host->device (once per unique region) and bytes
+            # replicated device->device along the data axis.
+            "storage_read_bytes": 0,
+            "h2d_bytes": 0,
+            "d2d_bytes": 0,
         }
 
     def _finish_restore_stats(self, source: str, nbytes: int, t0: float):
@@ -1036,8 +1224,13 @@ class CheckpointEngine:
             )
             return arr
         # GSPMD leaf: assemble each unique addressable block of the target
-        # sharding, transfer once per device, rewrap.
+        # sharding, then broadcast-restore: the host bytes go to ONE device
+        # per unique region (H2D), and every further device holding the
+        # same region hydrates device-to-device from that first copy along
+        # the data axis — replicas stop multiplying the host-link traffic.
         region_cache: Dict[Tuple, np.ndarray] = {}
+        first_on_device: Dict[Tuple, Any] = {}
+        stats = getattr(self, "_restore_stats", None)
         single_arrays = []
         for sh in leaf.addressable_shards:
             key = _index_key(sh.index, leaf.shape)
@@ -1048,11 +1241,19 @@ class CheckpointEngine:
                 self._region_fill(host, key, blocks, exact_pairs=None)
                 region_cache[key] = host
             t_put0 = time.perf_counter()
-            single_arrays.append(jax.device_put(host, sh.device))
-            if hasattr(self, "_restore_stats"):
-                self._restore_stats["device_put_s"] += (
-                    time.perf_counter() - t_put0
-                )
+            src = first_on_device.get(key)
+            if src is None:
+                arr = jax.device_put(host, sh.device)
+                first_on_device[key] = arr
+                if stats is not None:
+                    stats["h2d_bytes"] += int(host.nbytes)
+            else:
+                arr = jax.device_put(src, sh.device)
+                if stats is not None:
+                    stats["d2d_bytes"] += int(host.nbytes)
+            single_arrays.append(arr)
+            if stats is not None:
+                stats["device_put_s"] += time.perf_counter() - t_put0
         return jax.make_array_from_single_device_arrays(
             tuple(int(d) for d in leaf.shape), leaf.sharding, single_arrays
         )

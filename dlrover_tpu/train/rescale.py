@@ -235,13 +235,30 @@ class RescaleEngine:
 
     def _hydrate(self, plan: m.RescalePlan, template) -> tuple:
         """No live state: rebuild it from the newest shm snapshot via
-        the block catalog (cross-degree re-slice). Returns
+        the block catalog — a cross-topology restore when the snapshot
+        was saved under a different mesh (the template carries the NEW
+        world's shardings, so restore re-slices saved blocks onto it and
+        broadcast-hydrates replicas device-to-device). Returns
         (state, source)."""
         if self.checkpointer is None:
             raise RescaleInfeasible(
                 "no live train state and no checkpointer to hydrate from"
             )
-        step, state = self.checkpointer.load(template)
+        from dlrover_tpu.common import ckpt_persist
+
+        try:
+            step, state = self.checkpointer.load(template)
+        except (
+            ckpt_persist.ZeroDegreeMismatchError,
+            ckpt_persist.TopologyMismatchError,
+        ) as e:
+            # The saved block catalog cannot be re-sliced onto the new
+            # mesh: nack with the structural reason instead of letting
+            # the generic handler bury it — the master aborts the plan
+            # and survivors take the legacy restart.
+            raise RescaleInfeasible(
+                f"snapshot cannot be re-sliced onto the new topology: {e}"
+            ) from e
         if step < 0:
             raise RescaleInfeasible("no restorable snapshot to hydrate from")
         stats = getattr(self.checkpointer, "last_restore_stats", {}) or {}

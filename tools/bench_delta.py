@@ -39,9 +39,13 @@ _INTERESTING = re.compile(
 )
 
 #: Lower-is-better keys: latencies, wall clocks, overheads — and memory
-#: footprints (``*_gb``/``*_bytes``: train-state, peak-HBM and the
-#: opt_shard section's per-device/persist byte metrics all want to
-#: shrink; the ``_cut_x`` ratios stay higher-is-better).
+#: footprints (``*_gb``/``*_bytes``: train-state, peak-HBM, the
+#: opt_shard section's per-device/persist byte metrics AND the
+#: ckpt_dedup section's ``persist_bytes_per_replica`` /
+#: ``incremental_bytes`` all want to shrink; throughput-flavored
+#: ``_bytes_per_s`` and the ``_bytes_cut``/``_cut_x`` dedup ratios stay
+#: higher-is-better — the lookahead exempts them from the ``_bytes``
+#: match).
 _LOWER_BETTER = re.compile(
     r"(_ms$|(?<!per)_s$|_s_per_gb$|wall|overhead|step_time|compile"
     r"|_gb$|_bytes(?!_per_s|_cut))",
