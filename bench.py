@@ -1073,6 +1073,97 @@ def section_goodput():
     return out
 
 
+def section_straggler():
+    """Straggler-attribution drill (in-process, CPU-friendly): four
+    synthetic workers feed the master-side detector, one of them slowed
+    from a known round. Measures detect latency in telemetry samples
+    (steps, lower is better) and attribution correctness for a compute
+    straggle, a link degrade, and the misattribution guard (compute
+    straggle with link-shaped side effects must NOT book as link), plus
+    the per-call phase-split overhead the trainer pays."""
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+    from dlrover_tpu.master.monitor.straggler import StragglerDetector
+    from dlrover_tpu.utils.profiler import PhaseBreakdown
+
+    normal_phases = {"input_s": 0.01, "compute_s": 0.1,
+                     "collective_s": 0.01, "readback_s": 0.01}
+    probe_ok = {"h2d_mbps": 800.0, "d2h_mbps": 800.0, "rtt_ms": 1.0}
+    degrade_at = 10  # 1-based round the slow worker starts straggling
+    workers, rounds = 4, 40
+
+    def drill(feed):
+        """feed(det, worker, round_) pushes one telemetry sample; the
+        drill returns (rounds-after-degrade until flagged, kind)."""
+        det = StragglerDetector(
+            speed_monitor=SpeedMonitor(), window=32, ratio=2.0,
+            sustain=3, evict_after=1e9, evict_enabled=False,
+        )
+        for r in range(1, rounds + 1):
+            for w in range(workers):
+                feed(det, w, r)
+            det.tick()
+            flagged = det.stragglers()
+            if flagged:
+                [(wid, kind)] = flagged.items()
+                return (r - degrade_at if wid == 0 else None), kind
+        return None, None
+
+    def compute_feed(det, w, r):
+        p = dict(normal_phases)
+        if w == 0 and r > degrade_at:
+            p["compute_s"] = 0.4
+        det.note_phases(w, p, step=r)
+
+    def link_feed(det, w, r):
+        s = dict(probe_ok)
+        if w == 0 and r > degrade_at:
+            s["d2h_mbps"] = 40.0
+            s["rtt_ms"] = 20.0
+        det.note_probe(w, s)
+
+    def guard_feed(det, w, r):
+        # compute straggle that ALSO inflates the link-ish phases —
+        # the classifier must still say compute
+        p = dict(normal_phases)
+        if w == 0 and r > degrade_at:
+            p["compute_s"] = 0.4
+            p["collective_s"] = 0.1
+            p["readback_s"] = 0.1
+        det.note_phases(w, p, step=r)
+
+    lat_compute, kind_compute = drill(compute_feed)
+    lat_link, kind_link = drill(link_feed)
+    _lat_guard, kind_guard = drill(guard_feed)
+    correct = sum((
+        kind_compute == "compute",
+        kind_link == "link",
+        kind_guard == "compute",
+    ))
+    out = {
+        "attribution_correct_pct": round(100.0 * correct / 3, 1),
+    }
+    if lat_compute is not None:
+        out["detect_latency_steps_compute"] = lat_compute
+    if lat_link is not None:
+        out["detect_latency_steps_link"] = lat_link
+    # Worker-side cost of the telemetry: one phase split per step.
+    pb = PhaseBreakdown()
+    n = 20000
+    t0 = time.perf_counter()
+    for i in range(n):
+        pb.split(0.01, 0.02, 0.1, 0.005)
+    out["phase_split_overhead_us"] = round(
+        (time.perf_counter() - t0) / n * 1e6, 2
+    )
+    out["protocol"] = (
+        f"{workers} synthetic workers x {rounds} rounds, worker 0 "
+        f"degraded after round {degrade_at}; detector ratio=2.0 "
+        "sustain=3; latency = rounds from degrade to flag"
+    )
+    log(f"bench[straggler]: {out}")
+    return out
+
+
 def section_rescale():
     """In-place rescale vs full restart for the same 4->3 transition.
 
@@ -1330,8 +1421,9 @@ def main():
     # budget guard sheds the tail sections, not the headline.
     default_sections = (
         "small,large,llama,longctx,goodput,ckpt_io,ckpt_dedup,"
-        "opt_shard,rescale,medium"
-        if on_tpu else "small,goodput,ckpt_io,ckpt_dedup,opt_shard,rescale"
+        "opt_shard,rescale,straggler,medium"
+        if on_tpu else
+        "small,goodput,ckpt_io,ckpt_dedup,opt_shard,rescale,straggler"
     )
     sections = os.getenv(
         "DLROVER_TPU_BENCH_SECTIONS", default_sections
@@ -1373,6 +1465,8 @@ def main():
                 extra["goodput"] = section_goodput()
             elif name == "rescale":
                 extra["rescale"] = section_rescale()
+            elif name == "straggler":
+                extra["straggler"] = section_straggler()
         except Exception as e:
             import traceback
 

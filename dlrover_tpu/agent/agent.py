@@ -229,6 +229,13 @@ class ElasticTrainingAgent:
 
             self._config_tuner = ParalConfigTuner(self._client)
             self._config_tuner.start()
+        # Continuous link telemetry (probe.link events feeding the
+        # master's straggler detector); DLROVER_TPU_PROBE_INTERVAL=0
+        # leaves it off.
+        from dlrover_tpu.agent.device_check import LinkProbe
+
+        self._link_probe = LinkProbe(self._client)
+        self._link_probe.start()
 
     def run(self) -> int:
         self._start_heartbeats()
@@ -629,7 +636,7 @@ class ElasticTrainingAgent:
     def stop(self):
         self._stopped.set()
         for attr in ("_heartbeat_task", "_resource_monitor",
-                     "_training_monitor", "_config_tuner"):
+                     "_training_monitor", "_config_tuner", "_link_probe"):
             task = getattr(self, attr, None)
             if task is not None:
                 task.stop()

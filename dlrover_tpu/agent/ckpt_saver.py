@@ -61,6 +61,10 @@ class CommonDirCheckpointSaver:
         self._last_persisted = -1
         self._flush_lock = threading.Lock()
         self._stopped = False
+        # Persist rounds currently in flight; the agent's LinkProbe
+        # reads this (via `busy`) to stay off the disks and links while
+        # checkpoint I/O is running.
+        self._persisting = 0
         # Aggregated persist_shard stats of the current save round,
         # appended under _io_lock (shards persist concurrently).
         self._io_lock = threading.Lock()
@@ -186,6 +190,18 @@ class CommonDirCheckpointSaver:
             # A previous event already chased past this step; re-copying a
             # multi-GB buffer for a step that is on disk is pure waste.
             return
+        self._persisting += 1
+        try:
+            self._save_step_checkpoint(step, commit_timeout)
+        finally:
+            self._persisting -= 1
+
+    @property
+    def busy(self) -> bool:
+        """True while a persist round is in flight (LinkProbe backs off)."""
+        return self._persisting > 0
+
+    def _save_step_checkpoint(self, step: int, commit_timeout: float):
         commit_at = -1
         persist_t0 = time.monotonic()
         with self._io_lock:

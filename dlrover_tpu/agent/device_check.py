@@ -21,13 +21,17 @@ import subprocess
 import sys
 import tempfile
 import time
-from typing import Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
+from dlrover_tpu.chaos.injector import fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
 from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.constants import NodeEnv, RendezvousName
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.periodic import PeriodicTask
 from dlrover_tpu.common.rpc import find_free_port
+from dlrover_tpu.observability.events import EventKind, emit
 
 _MAX_CHECK_ROUNDS = 3
 
@@ -177,3 +181,168 @@ def run_device_check(config, client) -> bool:
     logger.warning("device check inconclusive after %s rounds; proceeding",
                    _MAX_CHECK_ROUNDS)
     return True
+
+
+# ---------------- continuous link probe ----------------
+
+
+class LinkProbe:
+    """Background link telemetry: the pre-flight check above answers
+    "was the link sane at start" exactly once; this thread keeps
+    answering it for the rest of the job.
+
+    Every ``DLROVER_TPU_PROBE_INTERVAL`` seconds it samples, off the
+    training hot path:
+
+    - **H2D/D2H bandwidth proxy** — a small write+read through the shm
+      staging directory, the same path checkpoint snapshots take. With
+      ``DLROVER_TPU_PROBE_DEVICE=1`` it additionally times a real
+      ``jax`` host↔device round trip (off by default: the *workers* own
+      the TPU runtime; an agent-side client would steal the chips).
+    - **master RPC round-trip** — a read-only kv-store get, the
+      cross-host control-link microbenchmark every agent can run.
+
+    Samples go out as ``probe.link`` events (ring-only on the master —
+    never journaled) for the straggler detector's per-worker link
+    profile. The probe is rate-limited by construction and *pauses
+    under checkpoint pressure*: while the saver has a persist round in
+    flight the sample is skipped, so probe I/O never contends with
+    checkpoint I/O on the same disks and links.
+
+    The ``probe.link degrade`` chaos site scales measured bandwidth
+    down (and inflates RTT) by ``args["factor"]`` — the deterministic
+    link-degradation drill.
+    """
+
+    def __init__(self, client=None,
+                 interval: Optional[float] = None,
+                 payload_mb: Optional[int] = None,
+                 busy_fn: Optional[Callable[[], bool]] = None,
+                 sample_fn: Optional[Callable[[], Dict]] = None):
+        self._client = client
+        self._interval = (
+            interval if interval is not None
+            else env_utils.PROBE_INTERVAL.get()
+        )
+        self._mb = max(1, payload_mb or env_utils.PROBE_MB.get())
+        self._busy_fn = busy_fn or self._saver_busy
+        self._sample_fn = sample_fn
+        self._seq = 0
+        self.skipped = 0
+        self._task: Optional[PeriodicTask] = None
+
+    @staticmethod
+    def _saver_busy() -> bool:
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+        saver = AsyncCheckpointSaver.get_ckpt_saver()
+        return bool(saver is not None and getattr(saver, "busy", False))
+
+    def start(self):
+        if self._interval <= 0:
+            return
+        self._task = PeriodicTask(
+            self.sample_once, self._interval, name="link-probe"
+        )
+        self._task.start()
+
+    def stop(self, join_timeout: float = 2.0):
+        if self._task is not None:
+            self._task.stop(join_timeout)
+            self._task = None
+
+    # ------------- one sample -------------
+    def sample_once(self) -> Optional[Dict]:
+        self._seq += 1
+        try:
+            if self._busy_fn():
+                # Checkpoint persist in flight: stay off its disks/links.
+                self.skipped += 1
+                return None
+        except Exception:  # dtlint: disable=DT001 -- a broken busy probe must not stop link telemetry
+            pass
+        sample = (
+            self._sample_fn() if self._sample_fn is not None
+            else self._measure()
+        )
+        chaos = fault_hit(ChaosSite.PROBE_LINK, detail=str(self._seq))
+        if chaos is not None and chaos.kind == "degrade":
+            factor = float(chaos.args.get("factor", 0.1)) or 0.1
+            for key in ("h2d_mbps", "d2h_mbps"):
+                if key in sample:
+                    sample[key] *= factor
+            if "rtt_ms" in sample:
+                sample["rtt_ms"] /= factor
+        emit(EventKind.PROBE_LINK, seq=self._seq, **sample)
+        return sample
+
+    def _measure(self) -> Dict:
+        sample: Dict = {}
+        sample.update(self._measure_shm())
+        if self._client is not None:
+            t0 = time.perf_counter()
+            try:
+                self._client.kv_store_get("__linkprobe__")
+                sample["rtt_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 3
+                )
+            except Exception:  # dtlint: disable=DT001 -- master briefly down: the probe keeps sampling local links
+                pass
+        if env_utils.PROBE_DEVICE.get():
+            sample.update(self._measure_device())
+        return sample
+
+    def _measure_shm(self) -> Dict:
+        """Write+read through the shm staging dir — the checkpoint D2H
+        path proxy available to every agent without touching the TPU."""
+        shm_dir = env_utils.SHM_DIR.get() or "/dev/shm"
+        if not os.path.isdir(shm_dir):
+            shm_dir = tempfile.gettempdir()
+        path = os.path.join(
+            shm_dir, f".dlrover_tpu_linkprobe_{os.getpid()}"
+        )
+        payload = os.urandom(1 << 20) * self._mb
+        mb = len(payload) / 1e6
+        try:
+            t0 = time.perf_counter()
+            with open(path, "wb") as f:
+                f.write(payload)
+                f.flush()
+            t1 = time.perf_counter()
+            with open(path, "rb") as f:
+                f.read()
+            t2 = time.perf_counter()
+        except OSError as e:
+            logger.warning("link probe shm sample failed: %s", e)
+            return {}
+        finally:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        return {
+            "h2d_mbps": round(mb / max(t1 - t0, 1e-9), 1),
+            "d2h_mbps": round(mb / max(t2 - t1, 1e-9), 1),
+        }
+
+    def _measure_device(self) -> Dict:
+        """True host↔device transfer timing; opt-in only (the agent
+        grabbing the TPU runtime would evict the workers)."""
+        try:
+            import jax
+            import numpy as np
+
+            host = np.zeros((self._mb, 1 << 20 >> 2), dtype=np.float32)
+            mb = host.nbytes / 1e6
+            t0 = time.perf_counter()
+            dev = jax.block_until_ready(jax.device_put(host))
+            t1 = time.perf_counter()
+            np.asarray(dev)
+            t2 = time.perf_counter()
+            return {
+                "dev_h2d_mbps": round(mb / max(t1 - t0, 1e-9), 1),
+                "dev_d2h_mbps": round(mb / max(t2 - t1, 1e-9), 1),
+            }
+        except Exception as e:  # dtlint: disable=DT001 -- no usable backend: device numbers are optional extras
+            logger.debug("link probe device sample unavailable: %s", e)
+            return {}

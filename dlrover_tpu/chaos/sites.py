@@ -44,6 +44,9 @@ class ChaosSite:
     #: Worker transition engine, before re-sharding live state onto the
     #: new mesh (abort/delay), detail = "plan{id}".
     RESCALE_TRANSFER = "rescale.transfer"
+    #: Agent LinkProbe sample (degrade: scale measured bandwidth down /
+    #: inflate RTT by args["factor"]), detail = probe sequence number.
+    PROBE_LINK = "probe.link"
     #: Reserved for unit drills of the injector mechanics themselves
     #: (schedules, journaling): never instrumented in product code.
     TEST_PROBE = "test.probe"

@@ -293,6 +293,50 @@ RESCALE_POLL_INTERVAL_S = ENV.float(
     "Agent/worker poll interval for an active rescale plan after their "
     "round goes stale.")
 
+# ---------------- link probe / straggler attribution ----------------
+PROBE_INTERVAL = ENV.float(
+    "DLROVER_TPU_PROBE_INTERVAL", 30.0,
+    "Seconds between background agent link-probe samples (D2H/H2D "
+    "bandwidth proxy + master RPC round-trip). 0 disables the probe.")
+PROBE_MB = ENV.int(
+    "DLROVER_TPU_PROBE_MB", 8,
+    "Payload megabytes per link-probe bandwidth sample; small on "
+    "purpose — the probe must stay off the hot path.")
+PROBE_DEVICE = ENV.bool(
+    "DLROVER_TPU_PROBE_DEVICE", False,
+    "Let the agent's link probe touch the accelerator runtime for true "
+    "D2H/H2D numbers. Off by default: workers own the TPU, so the agent "
+    "probes the shm staging path and master RTT instead.")
+STRAGGLER_PHASES = ENV.bool(
+    "DLROVER_TPU_STRAGGLER_PHASES", True,
+    "Emit per-step phase-breakdown events (step.phases) from the "
+    "trainer; the master's straggler detector feeds on them.")
+STRAGGLER_PHASE_EVERY = ENV.int(
+    "DLROVER_TPU_STRAGGLER_PHASE_EVERY", 1,
+    "Emit step.phases every N steps (rate limit for very fast steps).")
+STRAGGLER_WINDOW = ENV.int(
+    "DLROVER_TPU_STRAGGLER_WINDOW", 32,
+    "Rolling per-worker sample window (phase vectors and probe "
+    "samples) the straggler detector classifies over.")
+STRAGGLER_RATIO = ENV.float(
+    "DLROVER_TPU_STRAGGLER_RATIO", 2.0,
+    "Outlier threshold: a worker whose recent phase time exceeds (or "
+    "probe bandwidth falls below) baseline by this factor is an "
+    "outlier candidate.")
+STRAGGLER_SUSTAIN = ENV.int(
+    "DLROVER_TPU_STRAGGLER_SUSTAIN", 3,
+    "Consecutive outlier evaluations before a straggler incident "
+    "opens (debounces one-off hiccups).")
+STRAGGLER_EVICT = ENV.bool(
+    "DLROVER_TPU_STRAGGLER_EVICT", False,
+    "Evict a sustained straggler through the node-manager path once "
+    "it outlives DLROVER_TPU_STRAGGLER_EVICT_AFTER. Off: the detector "
+    "only surfaces the recommendation (event + metric).")
+STRAGGLER_EVICT_AFTER = ENV.float(
+    "DLROVER_TPU_STRAGGLER_EVICT_AFTER", 120.0,
+    "Seconds a classified straggler may persist before the eviction "
+    "recommendation (or eviction, if enabled) fires.")
+
 # ---------------- fault injection / debug ----------------
 CHAOS = ENV.str(
     "DLROVER_TPU_CHAOS", "",

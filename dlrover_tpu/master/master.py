@@ -28,6 +28,7 @@ from dlrover_tpu.observability.plane import (
     ObservabilityPlane,
 )
 from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.monitor.straggler import StragglerDetector
 from dlrover_tpu.master.node_manager import JobManager, LocalJobManager
 from dlrover_tpu.master.rendezvous import (
     DeviceCheckRendezvousManager,
@@ -89,10 +90,22 @@ class JobMaster:
         # + /metrics source. Master-local emits flow through the sink
         # below; agent/worker emits arrive as EventReport RPCs.
         self.observability = ObservabilityPlane()
+        # Straggler attribution: phase/probe telemetry events feed the
+        # detector (EventLog listener), the node-monitor loop ticks it,
+        # and its verdict events book straggler:<kind> incidents in the
+        # goodput ledger. Eviction (when enabled) rides _evict_node.
+        self.straggler_detector = StragglerDetector(
+            speed_monitor=self.speed_monitor,
+            evict_cb=self._evict_node,
+        )
+        self.observability.event_log.add_listener(
+            self.straggler_detector.observe
+        )
         self.observability.attach(
             speed_monitor=self.speed_monitor,
             job_manager=self.job_manager,
             task_manager=self.task_manager,
+            straggler_detector=self.straggler_detector,
         )
         self.metric_collector.add_sink(self.observability.metric_sink)
         self._metrics_port_cfg = metrics_port
@@ -106,6 +119,9 @@ class JobMaster:
             for mgr in self.rdzv_managers.values():
                 mgr.set_state_listener(self._journal_rdzv_state)
             self.observability.event_log.journal = self.state_store.append
+            # WAL write/fsync durations land in the plane's histograms
+            # (ROADMAP item 4: native histogram metrics).
+            self.state_store.timing_sink = self.observability.observe_wal
         # Live rescale plane: membership changes with a surviving quorum
         # become in-place transitions (journaled RescalePlans) instead of
         # full restarts.
@@ -362,6 +378,7 @@ class JobMaster:
                     # re-firing every pass.
                     self.speed_monitor.reset_worker_reports()
                 self.rescale.tick()
+                self.straggler_detector.tick()
                 if self.state_store is not None:
                     self.state_store.maybe_snapshot(self._collect_state)
                 if not self.job_manager.all_nodes():
@@ -400,6 +417,7 @@ class JobMaster:
             mgr.remove_alive_node(node_id)
         self.task_manager.recover_worker_tasks(node_id)
         self.speed_monitor.remove_worker(node_id)
+        self.straggler_detector.remove_worker(node_id)
         self.metric_collector.remove_node(node_id)
         if node_id in old_world:
             # Survivors of the shrunken world may transition in place

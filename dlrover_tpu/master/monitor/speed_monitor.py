@@ -25,6 +25,8 @@ class SpeedMonitor:
         # Defined up front so readers before the first
         # set_target_worker_num call see 0, not an AttributeError.
         self._target_worker_num = 0
+        # worker_id -> straggle kind, maintained by the StragglerDetector.
+        self._stragglers: Dict[int, str] = {}
 
     @property
     def global_step(self) -> int:
@@ -84,6 +86,20 @@ class SpeedMonitor:
 
     def remove_worker(self, worker_id: int):
         self._worker_last_report.pop(worker_id, None)
+        self._stragglers.pop(worker_id, None)
+
+    # ------------- straggler feed (StragglerDetector) -------------
+    def set_straggler(self, worker_id: int, kind: str):
+        """The detector classified this worker as a sustained
+        ``kind`` (link/compute/input) straggler."""
+        self._stragglers[worker_id] = kind
+
+    def clear_straggler(self, worker_id: int):
+        self._stragglers.pop(worker_id, None)
+
+    def stragglers(self) -> Dict[int, str]:
+        """worker_id -> straggle kind for currently-flagged workers."""
+        return dict(self._stragglers)
 
     def reset_running_speed_monitor(self):
         self._samples.clear()

@@ -1,0 +1,375 @@
+"""Master-side straggler detection and attribution.
+
+`SpeedMonitor` can say the job got slower; this module says *which
+worker* and *why*. It folds two telemetry streams into a rolling
+per-worker profile:
+
+- ``step.phases`` events from every worker's trainer loop — wall time
+  per step split into host-input / compute / collective-exposed /
+  metric-readback (see :class:`~dlrover_tpu.utils.profiler.
+  PhaseBreakdown` for the split semantics);
+- ``probe.link`` events from every agent's background
+  :class:`~dlrover_tpu.agent.device_check.LinkProbe` — H2D/D2H
+  bandwidth samples plus the master RPC round trip.
+
+Classification is deliberately conservative and direction-safe:
+
+- a worker whose **compute phase** is a sustained outlier is a
+  ``compute`` straggler — checked *first*, so a host/device slowdown
+  can never be misread as a link problem;
+- then the **input phase** (``input`` straggle: its data pipeline);
+- only then do degraded probe bandwidth / inflated RTT / excess
+  collective-exposed time make it a ``link`` straggler.
+
+"Outlier" means the recent mean is ``STRAGGLER_RATIO`` times worse
+than baseline — the median of the worker's peers when two or more
+report the metric, else the worker's own rolling history — for
+``STRAGGLER_SUSTAIN`` consecutive evaluations with fresh samples.
+Baselines freeze while a worker is flagged (otherwise the rolling
+window absorbs the degradation and the flag flaps), and recovery needs
+the same sustained streak back under a margin of the frozen baseline.
+
+Verdicts leave as durable ``straggler.detect`` / ``straggler.recover``
+events: the :class:`~dlrover_tpu.observability.goodput.GoodputLedger`
+turns them into persistent ``straggler:<kind>`` incidents (detect /
+recover stamps, probe/phase evidence line), ``cli timeline`` renders
+them, and the same events rebuild the incident view offline. The
+detector also feeds ``SpeedMonitor.set_straggler`` and — once a flag
+outlives ``STRAGGLER_EVICT_AFTER`` — surfaces an eviction
+recommendation, acted on through the node-manager path only when
+``DLROVER_TPU_STRAGGLER_EVICT`` is set.
+"""
+
+import statistics
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.lockdep import instrumented_lock
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import EventKind, JobEvent, emit
+
+#: Metric keys taken from step.phases events (seconds, lower=better).
+PHASE_KEYS = ("input_s", "compute_s", "collective_s", "readback_s")
+#: Bandwidth keys from probe.link events (MB/s, higher=better).
+BANDWIDTH_KEYS = ("h2d_mbps", "d2h_mbps")
+#: RTT key from probe.link events (ms, lower=better).
+RTT_KEY = "rtt_ms"
+
+#: Absolute noise floors: a baseline below the floor is clamped up so
+#: microsecond jitter on a near-zero phase can't trip the ratio test.
+_FLOORS = {
+    "input_s": 0.005, "compute_s": 0.005, "collective_s": 0.005,
+    "readback_s": 0.005, "rtt_ms": 1.0,
+}
+#: Recovery margin: a flagged metric must come back within this factor
+#: of its frozen baseline (hysteresis against flapping).
+_RECOVER_MARGIN = 1.25
+
+
+class _WorkerProfile:
+    """Rolling per-metric sample rings for one worker."""
+
+    def __init__(self, window: int):
+        self.rings: Dict[str, deque] = {}
+        self.window = window
+        self.samples_seen = 0
+        self.last_step = -1
+        self.last_sample: Dict[str, float] = {}
+        # classification state
+        self.candidate: Optional[str] = None
+        self.streak = 0
+        self.flagged: Optional[str] = None
+        self.since_ts: Optional[float] = None
+        self.detect_ts: Optional[float] = None
+        self.frozen: Dict[str, float] = {}
+        self.clear_streak = 0
+        self.evict_surfaced = False
+
+    def add(self, key: str, value: float):
+        ring = self.rings.get(key)
+        if ring is None:
+            ring = self.rings[key] = deque(maxlen=self.window)
+        ring.append(float(value))
+        self.last_sample[key] = float(value)
+
+    def recent(self, key: str, n: int) -> Optional[float]:
+        ring = self.rings.get(key)
+        if not ring:
+            return None
+        tail = list(ring)[-n:]
+        return sum(tail) / len(tail)
+
+    def own_baseline(self, key: str) -> Optional[float]:
+        ring = self.rings.get(key)
+        if not ring or len(ring) < 4:
+            return None
+        return statistics.median(ring)
+
+
+class StragglerDetector:
+    """Fold phase vectors + probe samples into attributed verdicts."""
+
+    def __init__(
+        self,
+        speed_monitor=None,
+        window: Optional[int] = None,
+        ratio: Optional[float] = None,
+        sustain: Optional[int] = None,
+        evict_after: Optional[float] = None,
+        evict_enabled: Optional[bool] = None,
+        evict_cb: Optional[Callable[[int, str], None]] = None,
+    ):
+        self._speed_monitor = speed_monitor
+        self._window = window or env_utils.STRAGGLER_WINDOW.get()
+        self._ratio = max(1.1, ratio or env_utils.STRAGGLER_RATIO.get())
+        self._sustain = max(1, sustain or env_utils.STRAGGLER_SUSTAIN.get())
+        self._evict_after = (
+            evict_after if evict_after is not None
+            else env_utils.STRAGGLER_EVICT_AFTER.get()
+        )
+        self._evict_enabled = (
+            evict_enabled if evict_enabled is not None
+            else env_utils.STRAGGLER_EVICT.get()
+        )
+        self._evict_cb = evict_cb
+        self._profiles: Dict[int, _WorkerProfile] = {}
+        self._ticked_at: Dict[int, int] = {}  # wid -> samples_seen at tick
+        self._lock = instrumented_lock("master.straggler")
+
+    # ------------- intake -------------
+    def observe(self, ev: JobEvent):
+        """EventLog listener: fold telemetry events into profiles."""
+        if ev.node_id < 0:
+            return
+        if ev.kind == EventKind.STEP_PHASES:
+            self.note_phases(
+                ev.node_id,
+                {k: ev.args[k] for k in PHASE_KEYS if k in ev.args},
+                step=int(ev.args.get("step", -1)),
+            )
+        elif ev.kind == EventKind.PROBE_LINK:
+            self.note_probe(
+                ev.node_id,
+                {k: ev.args[k] for k in
+                 (*BANDWIDTH_KEYS, RTT_KEY) if k in ev.args},
+            )
+
+    def note_phases(self, worker_id: int, phases: Dict[str, float],
+                    step: int = -1):
+        with self._lock:
+            prof = self._profile(worker_id)
+            for key, value in phases.items():
+                prof.add(key, value)
+            prof.samples_seen += 1
+            prof.last_step = max(prof.last_step, step)
+
+    def note_probe(self, worker_id: int, sample: Dict[str, float]):
+        with self._lock:
+            prof = self._profile(worker_id)
+            for key, value in sample.items():
+                prof.add(key, value)
+            prof.samples_seen += 1
+
+    def _profile(self, worker_id: int) -> _WorkerProfile:
+        prof = self._profiles.get(worker_id)
+        if prof is None:
+            prof = self._profiles[worker_id] = _WorkerProfile(self._window)
+        return prof
+
+    def remove_worker(self, worker_id: int):
+        with self._lock:
+            self._profiles.pop(worker_id, None)
+            self._ticked_at.pop(worker_id, None)
+
+    # ------------- classification -------------
+    def _baseline(self, wid: int, key: str) -> Optional[float]:
+        """Peer median of recent means when >=2 peers report the key,
+        else the worker's own rolling median. Lock held."""
+        peers = [
+            p.recent(key, self._sustain)
+            for w, p in self._profiles.items() if w != wid
+        ]
+        peers = [v for v in peers if v is not None]
+        if len(peers) >= 2:
+            return statistics.median(peers)
+        if len(peers) == 1:
+            return peers[0]
+        return self._profiles[wid].own_baseline(key)
+
+    def _outlier_keys(self, wid: int, prof: _WorkerProfile) -> Dict[str, str]:
+        """key -> evidence string for every metric currently out of
+        bounds vs its (frozen or live) baseline. Lock held."""
+        out: Dict[str, str] = {}
+        flagged = prof.flagged is not None
+        for key in (*PHASE_KEYS, RTT_KEY):
+            recent = prof.recent(key, self._sustain)
+            if recent is None:
+                continue
+            base = (
+                prof.frozen.get(key) if flagged else
+                self._baseline(wid, key)
+            )
+            if base is None:
+                continue
+            floor = _FLOORS.get(key, 0.0)
+            threshold = (self._ratio if not flagged else _RECOVER_MARGIN)
+            if recent > threshold * max(base, floor):
+                out[key] = (
+                    f"{key}={recent:.4g} vs baseline {max(base, floor):.4g}"
+                )
+        for key in BANDWIDTH_KEYS:
+            recent = prof.recent(key, self._sustain)
+            if recent is None:
+                continue
+            base = (
+                prof.frozen.get(key) if flagged else
+                self._baseline(wid, key)
+            )
+            if base is None or base <= 0:
+                continue
+            threshold = (self._ratio if not flagged else _RECOVER_MARGIN)
+            if recent < base / threshold:
+                out[key] = f"{key}={recent:.4g} vs baseline {base:.4g}"
+        return out
+
+    @staticmethod
+    def _classify(outliers: Dict[str, str]) -> Optional[str]:
+        """Priority order is the misattribution guard: host/device
+        slowness (compute, then input) always wins over link evidence."""
+        if "compute_s" in outliers:
+            return "compute"
+        if "input_s" in outliers:
+            return "input"
+        if any(k in outliers for k in
+               (*BANDWIDTH_KEYS, RTT_KEY, "collective_s", "readback_s")):
+            return "link"
+        return None
+
+    def tick(self, now: Optional[float] = None):
+        """One evaluation pass (called from the master's node-monitor
+        loop). Emits verdict events outside the detector lock."""
+        now = now if now is not None else time.time()
+        detections: List[tuple] = []
+        recoveries: List[tuple] = []
+        evictions: List[tuple] = []
+        with self._lock:
+            for wid, prof in self._profiles.items():
+                seen = self._ticked_at.get(wid, 0)
+                if prof.samples_seen <= seen:
+                    continue  # nothing new: counters hold, no verdicts
+                self._ticked_at[wid] = prof.samples_seen
+                outliers = self._outlier_keys(wid, prof)
+                kind = self._classify(outliers)
+                if prof.flagged is None:
+                    if kind is None:
+                        prof.candidate, prof.streak = None, 0
+                        continue
+                    if kind == prof.candidate:
+                        prof.streak += 1
+                    else:
+                        prof.candidate, prof.streak = kind, 1
+                        prof.since_ts = now
+                    if prof.streak >= self._sustain:
+                        prof.flagged = kind
+                        prof.detect_ts = now
+                        prof.clear_streak = 0
+                        prof.evict_surfaced = False
+                        # Freeze baselines: the window will absorb the
+                        # degradation; recovery compares against healthy.
+                        prof.frozen = {}
+                        for key in (*PHASE_KEYS, RTT_KEY, *BANDWIDTH_KEYS):
+                            base = self._baseline(wid, key)
+                            if base is not None:
+                                prof.frozen[key] = base
+                        evidence = "; ".join(
+                            outliers[k] for k in sorted(outliers)
+                        )
+                        detections.append(
+                            (wid, kind, prof.since_ts, prof.last_step,
+                             evidence)
+                        )
+                else:
+                    if outliers:
+                        prof.clear_streak = 0
+                        if (
+                            now - (prof.detect_ts or now) > self._evict_after
+                            and not prof.evict_surfaced
+                        ):
+                            prof.evict_surfaced = True
+                            evictions.append((wid, prof.flagged))
+                    else:
+                        prof.clear_streak += 1
+                        if prof.clear_streak >= self._sustain:
+                            recoveries.append((wid, prof.flagged))
+                            prof.flagged = None
+                            prof.candidate, prof.streak = None, 0
+                            prof.frozen = {}
+                            prof.since_ts = prof.detect_ts = None
+        for wid, kind, since_ts, step, evidence in detections:
+            logger.warning(
+                "straggler detected: worker %s kind=%s (%s)",
+                wid, kind, evidence,
+            )
+            emit(
+                EventKind.STRAGGLER_DETECT, _node_id=wid, _role="master",
+                kind=kind, since_ts=since_ts, step=step, evidence=evidence,
+            )
+            if self._speed_monitor is not None:
+                self._speed_monitor.set_straggler(wid, kind)
+        for wid, kind in recoveries:
+            logger.info("straggler recovered: worker %s kind=%s", wid, kind)
+            emit(
+                EventKind.STRAGGLER_RECOVER, _node_id=wid, _role="master",
+                kind=kind,
+            )
+            if self._speed_monitor is not None:
+                self._speed_monitor.clear_straggler(wid)
+        for wid, kind in evictions:
+            if self._evict_enabled and self._evict_cb is not None:
+                logger.warning(
+                    "evicting sustained %s straggler: worker %s", kind, wid
+                )
+                try:
+                    self._evict_cb(wid, f"straggler:{kind}")
+                except Exception:
+                    logger.exception("straggler eviction failed")
+            else:
+                logger.warning(
+                    "straggler eviction recommended for worker %s "
+                    "(kind=%s, persisted > %.0fs); set %s=1 to act on it",
+                    wid, kind, self._evict_after,
+                    env_utils.STRAGGLER_EVICT.name,
+                )
+
+    # ------------- outputs -------------
+    def stragglers(self) -> Dict[int, str]:
+        with self._lock:
+            return {
+                wid: p.flagged
+                for wid, p in self._profiles.items()
+                if p.flagged is not None
+            }
+
+    def metrics(self) -> List:
+        """Exporter gauges (appended by the ObservabilityPlane)."""
+        with self._lock:
+            by_kind: Dict[str, int] = {}
+            for prof in self._profiles.values():
+                if prof.flagged:
+                    by_kind[prof.flagged] = by_kind.get(prof.flagged, 0) + 1
+            tracked = len(self._profiles)
+        return [
+            (
+                "dlrover_tpu_straggler_nodes", "gauge",
+                "Workers currently classified as sustained stragglers.",
+                [({"kind": k}, float(v))
+                 for k, v in sorted(by_kind.items())] or [(None, 0.0)],
+            ),
+            (
+                "dlrover_tpu_straggler_tracked_workers", "gauge",
+                "Workers with telemetry in the straggler detector.",
+                [(None, float(tracked))],
+            ),
+        ]

@@ -83,6 +83,18 @@ class MasterServicer:
 
     # The transport handler.
     def handle(self, request: Any) -> Any:
+        # Whole-handle latency per message type, journal included: the
+        # histogram answers "where did the RPC tail go" after the fact.
+        t0 = time.perf_counter()
+        try:
+            return self._handle(request)
+        finally:
+            if self._observability is not None:
+                self._observability.observe_rpc(
+                    type(request).__name__, time.perf_counter() - t0
+                )
+
+    def _handle(self, request: Any) -> Any:
         chaos = fault_hit(ChaosSite.MASTER_CRASH, detail=type(request).__name__)
         if chaos is not None:
             if chaos.kind == "kill":

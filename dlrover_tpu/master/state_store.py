@@ -154,6 +154,11 @@ class MasterStateStore:
         self.replaying = False
         self.incarnation = 0
         self.last_recovery_stats: Dict[str, Any] = {}
+        #: Optional ``(op, seconds)`` callback ("append" = journal record
+        #: write, "fsync" = snapshot durability point). The master wires
+        #: it to the observability plane's WAL histograms; always invoked
+        #: OUTSIDE the mutation lock.
+        self.timing_sink: Optional[Callable[[str, float], None]] = None
 
     @property
     def mutation_lock(self) -> threading.RLock:
@@ -186,13 +191,18 @@ class MasterStateStore:
         before the first snapshot opened a journal (recovery window —
         the post-recovery snapshot covers that state).
         """
+        dt = None
         with self._lock:
             if self._journal_file is None or self.replaying:
                 return
             payload = pickle.dumps(record)
+            t0 = time.perf_counter()
             self._journal_file.write(_frame(payload, self._algo))
+            dt = time.perf_counter() - t0
             self._records_since_snapshot += 1
             self._appended_records += 1
+        if dt is not None and self.timing_sink is not None:
+            self.timing_sink("append", dt)
 
     def _open_journal(self, seq: int):
         if self._journal_file is not None:
@@ -215,6 +225,7 @@ class MasterStateStore:
     # ---------------- snapshots ----------------
     def snapshot(self, collect_fn: Callable[[], Dict[str, Any]]) -> int:
         """Cut a full snapshot and rotate the journal; returns its seq."""
+        fsync_dt = None
         with self._lock:
             state = collect_fn()
             seq = self._seq + 1
@@ -227,14 +238,18 @@ class MasterStateStore:
                 _write_header(f, _SNAP_MAGIC, self._algo)
                 f.write(_frame(payload, self._algo))
                 f.flush()
+                t0 = time.perf_counter()
                 os.fsync(f.fileno())
+                fsync_dt = time.perf_counter() - t0
             os.replace(tmp, path)
             self._open_journal(seq)
             self._seq = seq
             self._records_since_snapshot = 0
             self._last_snapshot_time = time.monotonic()
             self._gc()
-            return seq
+        if fsync_dt is not None and self.timing_sink is not None:
+            self.timing_sink("fsync", fsync_dt)
+        return seq
 
     def maybe_snapshot(self, collect_fn: Callable[[], Dict[str, Any]]):
         """Periodic-snapshot driver (called from the master's monitor

@@ -23,8 +23,16 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.events import JobEvent
 
 
+#: High-frequency telemetry kinds that arrive every step / probe tick:
+#: ring-only like ``metric.*`` — the straggler detector consumes them
+#: live and their loss across a master restart costs one rolling window,
+#: not an incident.
+_SAMPLING_KINDS = frozenset({"step.phases", "probe.link"})
+
+
 def _durable(ev: JobEvent) -> bool:
-    return not ev.kind.startswith("metric.")
+    return (not ev.kind.startswith("metric.")
+            and ev.kind not in _SAMPLING_KINDS)
 
 
 class EventLog:

@@ -56,6 +56,18 @@ class EventKind:
     CKPT_IO = "ckpt.io"
     CHAOS_INJECT = "chaos.inject"
     STEP_PROGRESS = "step.progress"
+    # Per-step wall-time phase breakdown from the trainer (input_s /
+    # compute_s / collective_s / readback_s) — high-frequency telemetry,
+    # ring-only on the master (excluded from the WAL, see event_log).
+    STEP_PHASES = "step.phases"
+    # Background agent link probe: D2H/H2D bandwidth proxy + master RPC
+    # round-trip — also high-frequency/ring-only.
+    PROBE_LINK = "probe.link"
+    # StragglerDetector verdicts: a sustained per-worker outlier was
+    # classified (kind=link|compute|input, evidence=...), and later
+    # cleared. Durable — these open/close goodput incidents.
+    STRAGGLER_DETECT = "straggler.detect"
+    STRAGGLER_RECOVER = "straggler.recover"
     # Live rescale plane: plan issued (master), survivor applying /
     # applied in place (worker), plan aborted → fall back to restart.
     RESCALE_PLAN = "rescale.plan"

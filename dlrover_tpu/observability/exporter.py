@@ -15,8 +15,12 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from dlrover_tpu.common.log import logger
 
-#: (name, type, help, [(labels, value), ...]) — type is "gauge" or
-#: "counter"; labels may be None for an unlabelled sample.
+#: (name, type, help, [(labels, value), ...]) — type is "gauge",
+#: "counter" or "histogram"; labels may be None for an unlabelled
+#: sample. Histogram sample values are the dict payload produced by
+#: :meth:`~dlrover_tpu.observability.histogram.LatencyHistogram.snapshot`
+#: (``{"buckets": [(le, cumulative_count), ...], "sum": s, "count": n}``)
+#: and render as the conventional ``_bucket``/``_sum``/``_count`` series.
 Metric = Tuple[str, str, str, Sequence[Tuple[Optional[Dict[str, str]], float]]]
 
 
@@ -40,6 +44,33 @@ def _format_value(value) -> str:
     return repr(f)
 
 
+def _label_body(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    return ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+
+
+def _render_histogram(name: str, labels: Optional[Dict[str, str]],
+                      payload: Dict, lines: List[str]):
+    """One histogram sample as ``_bucket{le=...}``/``_sum``/``_count``.
+
+    Bucket counts are already cumulative and the payload ends with the
+    ``+Inf`` bucket (``_format_value`` renders ``inf`` as ``+Inf``)."""
+    base = dict(labels or {})
+    for bound, cum in payload["buckets"]:
+        bl = dict(base)
+        bl["le"] = _format_value(bound)
+        lines.append(
+            f"{name}_bucket{{{_label_body(bl)}}} {_format_value(cum)}"
+        )
+    body = _label_body(base)
+    brace = f"{{{body}}}" if body else ""
+    lines.append(f"{name}_sum{brace} {_format_value(payload['sum'])}")
+    lines.append(f"{name}_count{brace} {_format_value(payload['count'])}")
+
+
 def render_prometheus(metrics: Sequence[Metric]) -> str:
     """Render the exposition text. Label keys are emitted sorted so the
     output is deterministic for a given snapshot."""
@@ -48,12 +79,12 @@ def render_prometheus(metrics: Sequence[Metric]) -> str:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {mtype}")
         for labels, value in samples:
-            if labels:
-                body = ",".join(
-                    f'{k}="{_escape_label(v)}"'
-                    for k, v in sorted(labels.items())
+            if mtype == "histogram" and isinstance(value, dict):
+                _render_histogram(name, labels, value, lines)
+            elif labels:
+                lines.append(
+                    f"{name}{{{_label_body(labels)}}} {_format_value(value)}"
                 )
-                lines.append(f"{name}{{{body}}} {_format_value(value)}")
             else:
                 lines.append(f"{name} {_format_value(value)}")
     return "\n".join(lines) + "\n"

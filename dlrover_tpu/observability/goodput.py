@@ -71,6 +71,14 @@ class Incident:
     recover_ts: Optional[float] = None
     injected: bool = False
     trail: List[str] = field(default_factory=list)
+    #: Persistent incidents (straggler attributions) ride out training
+    #: steps: the job IS progressing, just degraded, so ``note_step``
+    #: must not close them and their span is charged to the per-cause
+    #: table but NOT to the downtime union behind the goodput ratio.
+    persistent: bool = False
+    #: The probe/phase measurement line that triggered classification
+    #: (straggler incidents; rendered by ``cli timeline``).
+    evidence: str = ""
 
     @property
     def open(self) -> bool:
@@ -97,6 +105,8 @@ class Incident:
             "open": self.open,
             "injected": self.injected,
             "trail": list(self.trail),
+            "persistent": self.persistent,
+            "evidence": self.evidence,
         }
 
 
@@ -137,6 +147,10 @@ class GoodputLedger:
         """EventLog listener: fold one event into the incident model."""
         if ev.kind in _OPENING:
             self._on_fault(ev)
+        elif ev.kind == EventKind.STRAGGLER_DETECT:
+            self._on_straggler_detect(ev)
+        elif ev.kind == EventKind.STRAGGLER_RECOVER:
+            self._on_straggler_recover(ev)
         elif ev.kind in _CONTEXT:
             with self._lock:
                 inc = self._open_incident_for(ev.node_id)
@@ -173,13 +187,49 @@ class GoodputLedger:
 
     def _open_incident_for(self, node_id: int) -> Optional[Incident]:
         """Most recent open incident this node's events attach to (with
-        the lock held). node_id -1 (master-global) matches anything."""
+        the lock held). node_id -1 (master-global) matches anything.
+        Persistent (straggler) incidents never absorb fault events —
+        their lifecycle belongs to the detector alone."""
         for inc in reversed(self._incidents):
-            if not inc.open:
+            if not inc.open or inc.persistent:
                 continue
             if node_id < 0 or inc.node_id < 0 or inc.node_id == node_id:
                 return inc
         return None
+
+    def _open_straggler_for(self, node_id: int) -> Optional[Incident]:
+        for inc in reversed(self._incidents):
+            if inc.open and inc.persistent and inc.node_id == node_id:
+                return inc
+        return None
+
+    def _on_straggler_detect(self, ev: JobEvent):
+        """Open (or refresh) a persistent ``straggler:<kind>`` incident.
+
+        ``since_ts`` in the event args is when the outlier first showed;
+        the gap to ``ev.ts`` (classification) is the detect latency."""
+        kind = ev.args.get("kind", "unknown")
+        with self._lock:
+            self._t0 = min(self._t0, ev.ts)
+            inc = self._open_straggler_for(ev.node_id)
+            if inc is None:
+                inc = Incident(
+                    cause=f"straggler:{kind}", node_id=ev.node_id,
+                    start_ts=float(ev.args.get("since_ts", ev.ts)),
+                    detect_ts=ev.ts, persistent=True,
+                )
+                self._incidents.append(inc)
+            inc.cause = f"straggler:{kind}"
+            inc.trail.append(ev.kind)
+            if ev.args.get("evidence"):
+                inc.evidence = str(ev.args["evidence"])
+
+    def _on_straggler_recover(self, ev: JobEvent):
+        with self._lock:
+            inc = self._open_straggler_for(ev.node_id)
+            if inc is not None:
+                inc.recover_ts = ev.ts
+                inc.trail.append(ev.kind)
 
     def note_step(self, step: int, ts: Optional[float] = None):
         """A training step was reported: the job is productive again —
@@ -198,7 +248,7 @@ class GoodputLedger:
             self._steps += 1
             self._last_step = max(self._last_step, step)
             for inc in self._incidents:
-                if inc.open:
+                if inc.open and not inc.persistent:
                     inc.recover_ts = ts
 
     # ------------- outputs -------------
@@ -215,9 +265,12 @@ class GoodputLedger:
             last_step = self._last_step
             productive = self._productive_step_s
         wall = max(0.0, now - t0)
+        # Persistent (straggler) incidents are degradation, not downtime:
+        # steps keep landing, so they stay out of the union behind the
+        # goodput ratio while the per-cause table still charges them.
         intervals = [
             (i.start_ts, i.recover_ts if i.recover_ts is not None else now)
-            for i in incidents
+            for i in incidents if not i.persistent
         ]
         downtime = min(wall, _union_seconds(intervals)) if wall else 0.0
         by_cause: Dict[str, float] = {}

@@ -20,6 +20,7 @@ from dlrover_tpu.observability.event_log import EventLog
 from dlrover_tpu.observability.events import EventKind, JobEvent
 from dlrover_tpu.observability.exporter import Metric, MetricsExporter
 from dlrover_tpu.observability.goodput import GoodputLedger
+from dlrover_tpu.observability.histogram import HistogramFamily, LatencyHistogram
 
 #: Master env knobs: scrape port (unset = exporter off; 0 = ephemeral)
 #: and an on-stop goodput artifact path (the bench harness reads it).
@@ -47,9 +48,17 @@ class ObservabilityPlane:
         self._speed_monitor = None
         self._job_manager = None
         self._task_manager = None
+        self._straggler_detector = None
+        # Native histograms: master RPC handle latency per message type
+        # (servicer.handle) and state-store WAL write/fsync durations
+        # (ROADMAP item 4). Lock-cheap — safe to call on the hot path.
+        self.rpc_hist = HistogramFamily("type", name="observability.rpc_hist")
+        self.wal_fsync_hist = LatencyHistogram(name="observability.wal_fsync")
+        self.wal_append_hist = LatencyHistogram(
+            name="observability.wal_append")
 
     def attach(self, speed_monitor=None, job_manager=None,
-               task_manager=None):
+               task_manager=None, straggler_detector=None):
         """Late-bind the metric sources the exporter reads from."""
         if speed_monitor is not None:
             self._speed_monitor = speed_monitor
@@ -57,6 +66,8 @@ class ObservabilityPlane:
             self._job_manager = job_manager
         if task_manager is not None:
             self._task_manager = task_manager
+        if straggler_detector is not None:
+            self._straggler_detector = straggler_detector
 
     # ------------- intake -------------
     def ingest_report(self, events: List[JobEvent]):
@@ -76,6 +87,18 @@ class ObservabilityPlane:
             node_id=int(payload.get("node_id", -1)), role="master",
             pid=os.getpid(), args=dict(payload),
         ), journal=False)
+
+    def observe_rpc(self, msg_type: str, seconds: float):
+        """Record one master RPC handle duration (servicer hot path)."""
+        self.rpc_hist.observe(msg_type, seconds)
+
+    def observe_wal(self, op: str, seconds: float):
+        """Record a state-store WAL timing: ``append`` (journal write)
+        or ``fsync`` (snapshot durability point)."""
+        if op == "fsync":
+            self.wal_fsync_hist.observe(seconds)
+        else:
+            self.wal_append_hist.observe(seconds)
 
     def _track_ckpt(self, ev: JobEvent):
         if ev.kind == EventKind.CKPT_IO:
@@ -230,6 +253,26 @@ class ObservabilityPlane:
                     "dlrover_tpu_shard_queue_depth", "gauge",
                     "Shard tasks per dataset queue.", samples,
                 ))
+        if self._straggler_detector is not None:
+            metrics.extend(self._straggler_detector.metrics())
+        if self.rpc_hist.total_count:
+            metrics.append((
+                "dlrover_tpu_rpc_handle_seconds", "histogram",
+                "Master RPC handle latency per message type.",
+                self.rpc_hist.samples(),
+            ))
+        if self.wal_fsync_hist.count:
+            metrics.append((
+                "dlrover_tpu_wal_fsync_seconds", "histogram",
+                "State-store snapshot fsync duration.",
+                [(None, self.wal_fsync_hist.snapshot())],
+            ))
+        if self.wal_append_hist.count:
+            metrics.append((
+                "dlrover_tpu_wal_append_seconds", "histogram",
+                "State-store WAL record write duration.",
+                [(None, self.wal_append_hist.snapshot())],
+            ))
         counts = self.event_log.counts_by_kind()
         if counts:
             metrics.append((

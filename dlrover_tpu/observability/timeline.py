@@ -111,6 +111,10 @@ def render_text(events: List[JobEvent], out=None) -> None:
                 f"{'  [injected]' if inc['injected'] else ''}",
                 file=out,
             )
+            # Straggler incidents carry the detector's phase/probe
+            # evidence (which key degraded, by how much vs baseline).
+            if inc["cause"].startswith("straggler:") and inc.get("evidence"):
+                print(f"             evidence: {inc['evidence']}", file=out)
 
 
 def to_chrome_trace(events: List[JobEvent]) -> List[dict]:

@@ -46,6 +46,18 @@ class DeferredMetrics:
         self._pending = (int(step), dict(metrics))
         return prev
 
+    def fence(self):
+        """Block until the *pending* step's metrics are computed, without
+        consuming them. Phase timing uses it to separate the device
+        fence (compute + exposed collective) from the host readback that
+        ``flush`` performs — still lag-1 only, never a sync on the step
+        just dispatched."""
+        if self._pending is None:
+            return
+        import jax
+
+        jax.block_until_ready(self._pending[1])
+
     def flush(self) -> Optional[Tuple[int, Dict]]:
         if self._pending is None:
             return None

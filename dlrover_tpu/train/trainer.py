@@ -34,6 +34,7 @@ from dlrover_tpu.chaos.injector import fault_hit
 from dlrover_tpu.chaos.sites import ChaosSite
 from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import EventKind, emit
 
 
 class TrainerCallback:
@@ -171,6 +172,22 @@ class Trainer:
         self._progress = StepProgressReporter(
             every=env_utils.PROGRESS_EVERY.get()
         )
+        # Per-step phase breakdown (host-input / compute / collective /
+        # readback) feeding the master's straggler detector. Pure
+        # perf_counter bookkeeping around fences the loop takes anyway —
+        # never an extra sync on the run-ahead step.
+        self._phases = None
+        if env_utils.STRAGGLER_PHASES.get():
+            from dlrover_tpu.utils.profiler import PhaseBreakdown
+
+            self._phases = PhaseBreakdown()
+        self._phase_every = max(1, env_utils.STRAGGLER_PHASE_EVERY.get())
+
+    @property
+    def phase_breakdown(self):
+        """The live :class:`~dlrover_tpu.utils.profiler.PhaseBreakdown`
+        (None when DLROVER_TPU_STRAGGLER_PHASES is off)."""
+        return self._phases
 
     @property
     def train_step(self):
@@ -326,6 +343,7 @@ class Trainer:
                     self._eval_step = None
                     if not pipeline and transition.batches is not None:
                         it = iter(transition.batches)
+            t_in0 = time.perf_counter()
             try:
                 batch = next(it)
             except StopIteration:
@@ -336,6 +354,7 @@ class Trainer:
                 else contextlib.nullcontext()
             )
             t_step0 = time.perf_counter()
+            input_s = t_step0 - t_in0
             chaos = fault_hit(ChaosSite.TRAINER_STEP, detail=str(step))
             if chaos is not None and chaos.kind in ("straggle", "delay"):
                 # Scripted straggler: the sleep lands inside the step's
@@ -351,6 +370,11 @@ class Trainer:
                     # Honored only when the profiler runs in sync mode;
                     # otherwise it records async-dispatch time and says so.
                     self._profiler.fence(metrics["loss"])
+            # Host dispatch segment: chaos straggle sleep + device_put +
+            # the jitted step's (async) dispatch. An injected host-side
+            # straggle lands here, never in the collective estimate.
+            t_disp1 = time.perf_counter()
+            dispatch_s = t_disp1 - t_step0
             done = step + 1
             if self._ckpt is not None:
                 if self._persist_every and done % self._persist_every == 0:
@@ -381,12 +405,28 @@ class Trainer:
                     self._progress.note(done)
                 report_training_metrics(done)
             last_loss = metrics["loss"]
+            phases = None
             if pipeline:
                 # Lag-1 fence: block on step N-1 (already finished or
                 # finishing while step N runs), never on step N. This
                 # paces the host to the device rate, which also makes
                 # the inter-fence wall time an honest step time.
-                prev = deferred.push(done, {"loss": last_loss})
+                if self._phases is not None:
+                    # Split the lag-1 wait into the device fence (block
+                    # until step N-1's metrics exist) and the host
+                    # readback (D2H transfer + float conversion) — the
+                    # readback is exactly what a degraded D2H link
+                    # inflates. Still lag-1: never a sync on step N.
+                    t_f0 = time.perf_counter()
+                    deferred.fence()
+                    t_f1 = time.perf_counter()
+                    prev = deferred.push(done, {"loss": last_loss})
+                    t_f2 = time.perf_counter()
+                    phases = self._phases.split(
+                        input_s, dispatch_s, t_f1 - t_f0, t_f2 - t_f1
+                    )
+                else:
+                    prev = deferred.push(done, {"loss": last_loss})
                 now = time.perf_counter()
                 step_metrics = {
                     "loss": last_loss,  # device array: sync if read
@@ -395,10 +435,29 @@ class Trainer:
                 }
                 t_mark = now
             else:
+                if self._phases is not None:
+                    t_f0 = time.perf_counter()
+                    jax.block_until_ready(last_loss)
+                    t_f1 = time.perf_counter()
+                    loss_host = float(last_loss)
+                    t_f2 = time.perf_counter()
+                    phases = self._phases.split(
+                        input_s, dispatch_s, t_f1 - t_f0, t_f2 - t_f1
+                    )
+                else:
+                    loss_host = float(last_loss)
                 step_metrics = {
-                    "loss": float(last_loss),
+                    "loss": loss_host,
                     "step_time_s": time.perf_counter() - t_step0,
                 }
+            if (
+                phases is not None and self._report
+                and done % self._phase_every == 0
+            ):
+                emit(
+                    EventKind.STEP_PHASES, step=done,
+                    step_s=step_metrics["step_time_s"], **phases,
+                )
             tokens = batch_token_count(batch)
             if tokens:
                 step_metrics["tokens_per_s"] = (

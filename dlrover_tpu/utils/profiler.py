@@ -24,7 +24,7 @@ AProfiler's ``compute_gpu_utilization`` analog.
 import contextlib
 import os
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional
 
 from dlrover_tpu.common import env_utils
@@ -52,19 +52,34 @@ def device_peak_flops(device=None) -> float:
 
 
 class StepStats:
-    def __init__(self):
-        self.times: List[float] = []
+    """Bounded step-time accumulator.
+
+    Samples live in a ring (``window`` newest) so a long run neither
+    grows without bound nor pays an ever-larger full sort per
+    ``percentile`` call — the sort cost is capped by the window.
+    ``count`` stays the *total* number of observations (the report's
+    step counter); ``mean``/``percentile`` describe the window.
+    """
+
+    def __init__(self, window: int = 1024):
+        self.times: deque = deque(maxlen=window)
+        self._total = 0
+        self._window_sum = 0.0
 
     def add(self, dt: float):
+        if len(self.times) == self.times.maxlen:
+            self._window_sum -= self.times[0]
         self.times.append(dt)
+        self._window_sum += dt
+        self._total += 1
 
     @property
     def count(self) -> int:
-        return len(self.times)
+        return self._total
 
     @property
     def mean(self) -> float:
-        return sum(self.times) / len(self.times) if self.times else 0.0
+        return self._window_sum / len(self.times) if self.times else 0.0
 
     def percentile(self, p: float) -> float:
         if not self.times:
@@ -72,6 +87,65 @@ class StepStats:
         xs = sorted(self.times)
         idx = min(len(xs) - 1, int(p / 100 * len(xs)))
         return xs[idx]
+
+
+class PhaseBreakdown:
+    """Per-step wall-time split into the four phases a host thread can
+    actually see under async dispatch, with NO extra device syncs.
+
+    The trainer hands over three raw host segments per step:
+
+    - ``input_s``  — blocking on the input pipeline (``next(it)``),
+    - ``dispatch_s`` — from input done to the jitted step's dispatch
+      returning (host-side work; an injected host straggle lands here),
+    - ``fence_s`` — blocking on the lag-1 metric fence (device-bound
+      wait: the previous step's compute plus any exposed collective),
+    - ``readback_s`` — converting the fenced metrics to host floats.
+
+    The fence wall conflates compute with exposed-communication wait, so
+    the split uses a rolling *best-case* fence (the window minimum) as
+    the pure-compute estimate: ``collective_s`` is the excess over that
+    floor — a degraded link inflates it while steady compute does not —
+    and ``compute_s`` is ``dispatch_s`` plus the floor. A heuristic, but
+    one whose failure direction is safe: host-side straggle can never
+    masquerade as link straggle.
+
+    Stats ride the same bounded :class:`StepStats` rings as step times.
+    """
+
+    KEYS = ("input_s", "compute_s", "collective_s", "readback_s")
+
+    def __init__(self, window: int = 256, fence_window: int = 16):
+        self._fences: deque = deque(maxlen=fence_window)
+        self.stats: Dict[str, StepStats] = {
+            k: StepStats(window) for k in self.KEYS
+        }
+        self.last: Dict[str, float] = {}
+
+    def split(self, input_s: float, dispatch_s: float, fence_s: float,
+              readback_s: float = 0.0) -> Dict[str, float]:
+        self._fences.append(fence_s)
+        base = min(self._fences)
+        collective = max(0.0, fence_s - base)
+        phases = {
+            "input_s": input_s,
+            "compute_s": dispatch_s + (fence_s - collective),
+            "collective_s": collective,
+            "readback_s": readback_s,
+        }
+        for k, v in phases.items():
+            self.stats[k].add(v)
+        self.last = phases
+        return phases
+
+    def report(self) -> Dict[str, Dict[str, float]]:
+        return {
+            k: {
+                "mean_s": round(st.mean, 6),
+                "p99_s": round(st.percentile(99), 6),
+            }
+            for k, st in self.stats.items()
+        }
 
 
 class Profiler:

@@ -239,6 +239,144 @@ class TestExporter:
             exp.stop()
 
 
+class TestHistograms:
+    def test_percentiles_derivable_from_buckets(self):
+        from dlrover_tpu.observability.histogram import LatencyHistogram
+
+        h = LatencyHistogram()
+        for _ in range(99):
+            h.observe(0.002)
+        h.observe(0.8)
+        assert h.count == 100
+        assert h.sum == pytest.approx(99 * 0.002 + 0.8)
+        # p50 lands in the 0.0025 bucket, p99 still below the outlier,
+        # p100 in the 1.0 bucket — all from cumulative bucket counts
+        assert h.percentile(50) == 0.0025
+        assert h.percentile(99) == 0.0025
+        assert h.percentile(100) == 1.0
+
+    def test_family_partitions_by_label(self):
+        from dlrover_tpu.observability.histogram import HistogramFamily
+
+        fam = HistogramFamily("type")
+        fam.observe("GlobalStep", 0.001)
+        fam.observe("GlobalStep", 0.002)
+        fam.observe("TaskRequest", 0.2)
+        assert fam.total_count == 3
+        assert fam.percentile("TaskRequest", 99) == 0.25
+        labels = [lbl for lbl, _snap in fam.samples()]
+        assert labels == [{"type": "GlobalStep"}, {"type": "TaskRequest"}]
+
+    def test_prometheus_histogram_golden_text(self):
+        import math
+
+        payload = {
+            "buckets": [(0.005, 1), (0.025, 3), (math.inf, 4)],
+            "sum": 0.236, "count": 4,
+        }
+        text = render_prometheus([
+            ("dlrover_tpu_rpc_handle_seconds", "histogram",
+             "Master RPC handle latency per message type.",
+             [({"type": "GlobalStep"}, payload)]),
+            ("dlrover_tpu_wal_fsync_seconds", "histogram",
+             "State-store snapshot fsync duration.",
+             [(None, {"buckets": [(0.01, 2), (math.inf, 2)],
+                      "sum": 0.004, "count": 2})]),
+        ])
+        assert text == (
+            "# HELP dlrover_tpu_rpc_handle_seconds Master RPC handle "
+            "latency per message type.\n"
+            "# TYPE dlrover_tpu_rpc_handle_seconds histogram\n"
+            'dlrover_tpu_rpc_handle_seconds_bucket{le="0.005",'
+            'type="GlobalStep"} 1\n'
+            'dlrover_tpu_rpc_handle_seconds_bucket{le="0.025",'
+            'type="GlobalStep"} 3\n'
+            'dlrover_tpu_rpc_handle_seconds_bucket{le="+Inf",'
+            'type="GlobalStep"} 4\n'
+            'dlrover_tpu_rpc_handle_seconds_sum{type="GlobalStep"} '
+            "0.236\n"
+            'dlrover_tpu_rpc_handle_seconds_count{type="GlobalStep"} '
+            "4\n"
+            "# HELP dlrover_tpu_wal_fsync_seconds State-store snapshot "
+            "fsync duration.\n"
+            "# TYPE dlrover_tpu_wal_fsync_seconds histogram\n"
+            'dlrover_tpu_wal_fsync_seconds_bucket{le="0.01"} 2\n'
+            'dlrover_tpu_wal_fsync_seconds_bucket{le="+Inf"} 2\n'
+            "dlrover_tpu_wal_fsync_seconds_sum 0.004\n"
+            "dlrover_tpu_wal_fsync_seconds_count 2\n"
+        )
+
+    def test_state_store_timing_sink_sees_append_and_fsync(
+        self, tmp_path
+    ):
+        from dlrover_tpu.master.state_store import MasterStateStore
+
+        store = MasterStateStore(str(tmp_path / "state"))
+        seen = []
+        store.timing_sink = lambda op, dt: seen.append((op, dt))
+        store.snapshot(dict)  # opens the journal + one fsync
+        store.append(("rpc", "id", {"k": 1}, 0.0))
+        ops = [op for op, _dt in seen]
+        assert ops == ["fsync", "append"]
+        assert all(dt >= 0 for _op, dt in seen)
+        store.close()
+
+    def test_live_master_serves_rpc_handle_histogram(self):
+        """Satellite acceptance: after real RPCs, the exporter serves a
+        valid Prometheus histogram for per-type handle latency, and p99
+        is derivable from the plane's family."""
+        master = JobMaster(port=0, node_num=1,
+                           job_name=f"obs-{uuid.uuid4().hex[:6]}",
+                           metrics_port=0)
+        master.prepare()
+        client = MasterClient(master.addr, node_id=0)
+        try:
+            client.report_global_step(3, time.time())
+            client.kv_store_set("k", b"v")
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{master.metrics_port}/metrics",
+                timeout=5,
+            ).read().decode()
+            assert ("# TYPE dlrover_tpu_rpc_handle_seconds histogram"
+                    in body)
+            assert ('dlrover_tpu_rpc_handle_seconds_bucket{le="+Inf",'
+                    'type="GlobalStep"} 1') in body
+            assert ('dlrover_tpu_rpc_handle_seconds_count'
+                    '{type="KVStoreSet"} 1') in body
+            hist = master.observability.rpc_hist
+            assert hist.percentile("GlobalStep", 99) > 0
+        finally:
+            client.close()
+            master.stop()
+
+
+class TestStragglerTimeline:
+    def test_timeline_renders_straggler_incident_with_evidence(
+        self, tmp_path, capsys
+    ):
+        plane = ObservabilityPlane()
+        t = 2000.0
+        plane.event_log.append(_jev(
+            EventKind.STRAGGLER_DETECT, t + 10.0, node=1, role="master",
+            args={"kind": "link", "since_ts": t + 4.0,
+                  "evidence": "d2h_mbps=40 vs baseline 800"},
+        ), journal=False)
+        plane.event_log.append(_jev(
+            EventKind.STRAGGLER_RECOVER, t + 30.0, node=1,
+            role="master", args={"kind": "link"},
+        ), journal=False)
+        dump = str(tmp_path / "goodput.json")
+        plane.dump_json(dump)
+        assert timeline_main(["--goodput-json", dump]) == 0
+        text = capsys.readouterr().out
+        assert "straggler.detect" in text
+        assert "cause=straggler:link" in text
+        assert "evidence: d2h_mbps=40 vs baseline 800" in text
+        # detect latency (since_ts -> classification) and recovery stamp
+        assert "detect=6.0s" in text
+        assert "recover=26.0s" in text
+
+
 class _FlakyClient:
     """report_events fails the first N calls, then records batches."""
 

@@ -294,3 +294,89 @@ class TestAsyncPipeline:
                 np.asarray(got), np.asarray(want)
             )
         trainer.close()
+
+
+class TestPhaseTelemetry:
+    """Per-step phase breakdown (straggler telemetry): pure bookkeeping
+    around fences the loop already takes — bit-identical loss, no sync
+    added to the run-ahead step, step.phases events on the wire."""
+
+    def _make(self, cfg, cb=None):
+        return Trainer(
+            GPT(cfg), optax.adamw(1e-3), token_loss,
+            next(batches(cfg)), spec=ParallelSpec(),
+            callbacks=[cb] if cb else (),
+        )
+
+    def test_phases_on_is_bit_identical_to_off(self, job_name,
+                                               monkeypatch):
+        from dlrover_tpu.train.trainer import TrainerCallback
+
+        def run(phases_on):
+            monkeypatch.setenv("DLROVER_TPU_STRAGGLER_PHASES",
+                               "1" if phases_on else "0")
+            losses = []
+
+            class Rec(TrainerCallback):
+                def on_step_end(self, trainer, step, metrics):
+                    losses.append(float(metrics["loss"]))
+
+            cfg = tiny_cfg()
+            t = self._make(cfg, Rec())
+            assert (t.phase_breakdown is not None) == phases_on
+            out = t.fit(batches(cfg), steps=6, pipeline=True)
+            return losses, out["loss"]
+
+        off_losses, off_final = run(False)
+        on_losses, on_final = run(True)
+        assert on_losses == off_losses
+        assert on_final == off_final
+
+    def test_phase_timing_keeps_runahead_loss_lazy(self, job_name,
+                                                   monkeypatch):
+        """The fence() split blocks lag-1 only: with phases on, the
+        current step's loss must still be an unsynced jax.Array and the
+        lag-1 float contract must hold."""
+        from dlrover_tpu.train.trainer import TrainerCallback
+
+        monkeypatch.setenv("DLROVER_TPU_STRAGGLER_PHASES", "1")
+        rows = []
+
+        class Rec(TrainerCallback):
+            def on_step_end(self, trainer, step, metrics):
+                rows.append(metrics)
+
+        cfg = tiny_cfg()
+        t = self._make(cfg, Rec())
+        t.fit(batches(cfg), steps=4, pipeline=True)
+        assert all(isinstance(r["loss"], jax.Array) for r in rows)
+        assert rows[0]["loss_lag1"] is None
+        assert [r["loss_lag1"] for r in rows[1:]] == [
+            pytest.approx(float(r["loss"])) for r in rows[:-1]
+        ]
+        rep = t.phase_breakdown.report()
+        for key in ("input_s", "compute_s", "collective_s",
+                    "readback_s"):
+            assert rep[key]["p99_s"] >= 0.0
+        assert t.phase_breakdown.stats["compute_s"].count == 4
+
+    def test_step_phase_events_reach_the_sink(self, job_name):
+        from dlrover_tpu.observability import events as events_mod
+        from dlrover_tpu.observability.event_log import EventLog
+        from dlrover_tpu.observability.events import EventKind
+
+        log = EventLog()
+        events_mod.install_sink(log.append)
+        events_mod.set_identity(3, "worker")
+        try:
+            cfg = tiny_cfg()
+            self._make(cfg).fit(batches(cfg), steps=3, pipeline=True)
+        finally:
+            events_mod.reset()
+        evs = log.events(kinds=[EventKind.STEP_PHASES])
+        assert [e.args["step"] for e in evs] == [1, 2, 3]
+        assert all(e.node_id == 3 for e in evs)
+        for e in evs:
+            for key in ("input_s", "compute_s", "collective_s",
+                        "readback_s", "step_s"):
+                assert e.args[key] >= 0.0

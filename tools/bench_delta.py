@@ -35,7 +35,7 @@ REGRESSION_PCT = 5.0
 _INTERESTING = re.compile(
     r"(tokens_per_s|goodput_.*_pct|mbps|speedup|mfu_pct|step_time_ms"
     r"|_save_s|restore_ms|overhead|wall_.*_s|blocking_save"
-    r"|_gb$|_bytes|_cut_x|rescale)", re.I,
+    r"|_gb$|_bytes|_cut_x|rescale|detect_latency|attribution)", re.I,
 )
 
 #: Lower-is-better keys: latencies, wall clocks, overheads — and memory
@@ -45,10 +45,11 @@ _INTERESTING = re.compile(
 #: ``incremental_bytes`` all want to shrink; throughput-flavored
 #: ``_bytes_per_s`` and the ``_bytes_cut``/``_cut_x`` dedup ratios stay
 #: higher-is-better — the lookahead exempts them from the ``_bytes``
-#: match).
+#: match). Straggler ``detect_latency*`` (steps until the detector
+#: flags) also wants to shrink; ``attribution_correct_pct`` does not.
 _LOWER_BETTER = re.compile(
     r"(_ms$|(?<!per)_s$|_s_per_gb$|wall|overhead|step_time|compile"
-    r"|_gb$|_bytes(?!_per_s|_cut))",
+    r"|_gb$|_bytes(?!_per_s|_cut)|detect_latency)",
     re.I,
 )
 
