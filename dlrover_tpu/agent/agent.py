@@ -161,6 +161,14 @@ class ElasticTrainingAgent:
         self._restart_count = 0
         self._ckpt_saver = None  # wired by start_saver()
         self._stopped = threading.Event()
+        # Heartbeat coalescing (DLROVER_TPU_AGENT_BEAT): monitors deposit
+        # their newest observations here and the periodic beat folds them
+        # into ONE AgentBeat RPC — at 10k agents the master sees one
+        # request per agent per interval instead of three.
+        self._beat_mode = env_utils.AGENT_BEAT.get()
+        self._beat_lock = threading.Lock()
+        self._beat_step: Tuple[int, float] = (-1, 0.0)
+        self._beat_probe: Optional[Dict] = None
 
     # ---------------- checkpoint saver hook ----------------
     def start_saver(self):
@@ -182,6 +190,33 @@ class ElasticTrainingAgent:
                 logger.exception("flash-checkpoint crash flush failed")
 
     # ---------------- run loop ----------------
+    def _note_step(self, step: int, ts: float):
+        """TrainingMonitor sink: keep the newest observation for the
+        next beat. Monotonic max — a restarted worker replaying earlier
+        steps still refreshes the timestamp (liveness first)."""
+        with self._beat_lock:
+            self._beat_step = (max(step, self._beat_step[0]), ts)
+
+    def _note_probe(self, sample: Dict):
+        """LinkProbe sink: latest-wins — the straggler profile wants the
+        current link state, not a backlog of stale samples."""
+        with self._beat_lock:
+            self._beat_probe = sample
+
+    def _send_beat(self):
+        with self._beat_lock:
+            step, step_ts = self._beat_step
+            probe = self._beat_probe
+            # Clear after snapshot: a beat only carries step progress the
+            # monitors observed since the last one, so the master's hang
+            # detection still sees silence when workers stop writing
+            # metrics (a sticky step would mask the hang forever).
+            self._beat_step = (-1, 0.0)
+            self._beat_probe = None
+        self._client.report_beat(
+            step=step, step_ts=step_ts, probe=probe or {}
+        )
+
     def _start_heartbeats(self):
         """Agent-level liveness, independent of worker state: covers the
         stop-workers/re-rendezvous gaps so the master's heartbeat monitor
@@ -189,7 +224,8 @@ class ElasticTrainingAgent:
         from dlrover_tpu.common.periodic import PeriodicTask
 
         self._heartbeat_task = PeriodicTask(
-            self._client.report_heartbeat,
+            self._send_beat if self._beat_mode
+            else self._client.report_heartbeat,
             self._config.monitor_interval,
             "agent-heartbeat",
         )
@@ -216,7 +252,8 @@ class ElasticTrainingAgent:
             )
         )
         self._training_monitor = TrainingMonitor(
-            self._metrics_path, self._client
+            self._metrics_path, self._client,
+            step_sink=self._note_step if self._beat_mode else None,
         )
         self._training_monitor.start()
         # The tuner loop only runs when auto-tuning is enabled (same gate
@@ -234,7 +271,10 @@ class ElasticTrainingAgent:
         # leaves it off.
         from dlrover_tpu.agent.device_check import LinkProbe
 
-        self._link_probe = LinkProbe(self._client)
+        self._link_probe = LinkProbe(
+            self._client,
+            sink=self._note_probe if self._beat_mode else None,
+        )
         self._link_probe.start()
 
     def run(self) -> int:

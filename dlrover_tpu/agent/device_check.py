@@ -218,7 +218,8 @@ class LinkProbe:
                  interval: Optional[float] = None,
                  payload_mb: Optional[int] = None,
                  busy_fn: Optional[Callable[[], bool]] = None,
-                 sample_fn: Optional[Callable[[], Dict]] = None):
+                 sample_fn: Optional[Callable[[], Dict]] = None,
+                 sink: Optional[Callable[[Dict], None]] = None):
         self._client = client
         self._interval = (
             interval if interval is not None
@@ -227,6 +228,11 @@ class LinkProbe:
         self._mb = max(1, payload_mb or env_utils.PROBE_MB.get())
         self._busy_fn = busy_fn or self._saver_busy
         self._sample_fn = sample_fn
+        # Optional sample sink: with heartbeat coalescing on, the agent
+        # collects samples here and folds the newest into its periodic
+        # AgentBeat — the master synthesizes the probe.link event, so
+        # emitting one here too would double-count.
+        self._sink = sink
         self._seq = 0
         self.skipped = 0
         self._task: Optional[PeriodicTask] = None
@@ -273,7 +279,10 @@ class LinkProbe:
                     sample[key] *= factor
             if "rtt_ms" in sample:
                 sample["rtt_ms"] /= factor
-        emit(EventKind.PROBE_LINK, seq=self._seq, **sample)
+        if self._sink is not None:
+            self._sink(dict(sample, seq=self._seq))
+        else:
+            emit(EventKind.PROBE_LINK, seq=self._seq, **sample)
         return sample
 
     def _measure(self) -> Dict:

@@ -318,6 +318,15 @@ class MasterClient:
     def report_heartbeat(self):
         return self._call(m.NodeHeartbeat(timestamp=time.time()))
 
+    def report_beat(self, step: int = -1, step_ts: float = 0.0,
+                    probe: Optional[Dict] = None):
+        """The coalesced periodic beat: heartbeat + newest step progress
+        + latest probe sample in ONE RPC (see ``m.AgentBeat``)."""
+        return self._call(m.AgentBeat(
+            timestamp=time.time(), step=step, step_ts=step_ts,
+            probe=probe or {},
+        ))
+
     def report_events(self, events, timeout: Optional[float] = None):
         """Forward a batch of JobEvents to the master's event log."""
         return self._call(

@@ -100,10 +100,15 @@ class TrainingMonitor:
 
     def __init__(self, metrics_path: str,
                  client: Optional[MasterClient] = None,
-                 interval: float = 5.0):
+                 interval: float = 5.0,
+                 step_sink=None):
         self._path = metrics_path
         self._client = client or MasterClient.singleton_instance()
         self._offset = 0
+        # Optional (step, ts) sink: with heartbeat coalescing on, the
+        # agent collects steps here and folds them into its periodic
+        # AgentBeat instead of a dedicated GlobalStep RPC per tail.
+        self._step_sink = step_sink
         self._task = PeriodicTask(
             self.report_once, interval, "training-monitor"
         )
@@ -137,9 +142,14 @@ class TrainingMonitor:
             if isinstance(rec, dict) and "step" in rec:
                 newest = rec
         if newest is not None:
-            self._client.report_global_step(
-                int(newest["step"]), float(newest.get("timestamp", 0.0))
-            )
+            if self._step_sink is not None:
+                self._step_sink(
+                    int(newest["step"]), float(newest.get("timestamp", 0.0))
+                )
+            else:
+                self._client.report_global_step(
+                    int(newest["step"]), float(newest.get("timestamp", 0.0))
+                )
             # Workers may attach device stats (the agent process holds no
             # TPU client, so this is the only channel for them). They ride
             # their own report — a zeroed cpu/mem report would stomp the

@@ -193,6 +193,70 @@ METRICS_PORT = ENV.int(
     "DLROVER_TPU_METRICS_PORT", -1,
     "Port for the master's Prometheus /metrics exporter; 0 = ephemeral, "
     "unset = exporter off.")
+WAL_SYNC = ENV.str(
+    "DLROVER_TPU_WAL_SYNC", "group",
+    "State-store journal durability policy: 'group' (default) batches "
+    "fsyncs across concurrent mutations via a dedicated commit thread "
+    "(callers block on their batch's durability barrier), 'always' "
+    "fsyncs once per mutation (the per-mutation baseline arm), 'none' "
+    "never fsyncs the journal (page-cache durability only, the pre-"
+    "group-commit legacy behavior).")
+WAL_GROUP_WINDOW_S = ENV.float(
+    "DLROVER_TPU_WAL_GROUP_WINDOW_S", 0.002,
+    "Group-commit accumulation window: the commit thread waits this "
+    "long after the first pending record before fsyncing, so one fsync "
+    "covers every mutation that landed meanwhile. Bounds the extra "
+    "latency a journaled RPC pays for durability; 0 fsyncs immediately "
+    "(batching then comes only from records landing during the "
+    "previous fsync).")
+RPC_DEDUP_SIZE = ENV.int(
+    "DLROVER_TPU_RPC_DEDUP_SIZE", 65536,
+    "Entries the master's RPC dedup cache remembers. Must exceed the "
+    "requests the whole fleet can have in retry flight at once: an "
+    "evicted id makes a client retry re-apply a mutating message, so "
+    "size it ~= agents x in-flight-RPCs-per-agent with headroom.")
+RPC_DEDUP_TTL_S = ENV.float(
+    "DLROVER_TPU_RPC_DEDUP_TTL_S", 0.0,
+    "Seconds a dedup entry outlives its request. 0 (default) derives "
+    "retry_deadline + request_timeout from the transport constants — "
+    "strictly longer than any client can still be retrying. Only "
+    "lower it in tests.")
+RPC_WORKERS = ENV.int(
+    "DLROVER_TPU_RPC_WORKERS", 16,
+    "Bulk-lane handler threads in the master's RPC server (telemetry: "
+    "beats, event batches, step/resource reports). The selector accept "
+    "loop multiplexes all connections; this bounds concurrent handler "
+    "execution instead of thread-per-connection.")
+RPC_CONTROL_WORKERS = ENV.int(
+    "DLROVER_TPU_RPC_CONTROL_WORKERS", 4,
+    "Control-lane handler threads reserved for rendezvous / rescale / "
+    "failure / kv / task RPCs, so a telemetry storm saturating the "
+    "bulk lane can never starve the calls that re-form the world.")
+RPC_DRAIN_S = ENV.float(
+    "DLROVER_TPU_RPC_DRAIN_S", 5.0,
+    "Seconds RpcServer.stop() waits for in-flight handlers to finish "
+    "and their responses to flush before severing connections, so a "
+    "graceful master stop under load doesn't leak half-applied socket "
+    "errors into client retries.")
+AGENT_BEAT = ENV.bool(
+    "DLROVER_TPU_AGENT_BEAT", True,
+    "Coalesce the agent's periodic node heartbeat, newest training "
+    "step, and link-probe sample into one AgentBeat RPC per interval "
+    "(one RPC per agent per tick instead of three). 0/false/off sends "
+    "the legacy separate NodeHeartbeat/GlobalStep/probe-event RPCs.")
+EVENT_SHED_PCT = ENV.float(
+    "DLROVER_TPU_EVENT_SHED_PCT", 75.0,
+    "Client-side backpressure: when the agent/worker event buffer is "
+    "fuller than this percentage, ring-only telemetry events (step "
+    "phases, probe samples, metric.*) are shed at emit time so "
+    "incident events keep their buffer space. 100 disables shedding.")
+EVENT_SHED_BACKLOG = ENV.int(
+    "DLROVER_TPU_EVENT_SHED_BACKLOG", 64,
+    "Master-side backpressure: when the RPC bulk lane has more than "
+    "this many requests queued, the EventReport handler drops the "
+    "ring-only telemetry kinds from incoming batches (incident events "
+    "always land) so a telemetry storm can't starve rendezvous or "
+    "rescale RPCs.")
 STATE_SNAPSHOT_SECS = ENV.float(
     "DLROVER_TPU_STATE_SNAPSHOT_SECS", 30.0,
     "Seconds between periodic master state-store snapshots (journal "

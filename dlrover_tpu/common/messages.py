@@ -272,6 +272,25 @@ class NodeHeartbeat(BaseRequest):
 
 
 @dataclass
+class AgentBeat(BaseRequest):
+    """One coalesced periodic agent RPC: node heartbeat + newest step
+    progress + the latest link-probe sample, folded into a single
+    message so 10k agents cost one RPC per interval each instead of
+    three. Not journaled: every constituent is soft state (heartbeat
+    times are zeroed on restore, steps are monotonic maxima, probe
+    samples are ring-only telemetry), so a replayed/duplicated beat is
+    idempotent by construction.
+    """
+
+    timestamp: float = 0.0
+    #: Newest observed global step; -1 = no step progress this interval.
+    step: int = -1
+    step_ts: float = 0.0
+    #: Latest link-probe sample (empty = none this interval).
+    probe: Dict = field(default_factory=dict)
+
+
+@dataclass
 class EventReport(BaseRequest):
     """A batch of JobEvents forwarded from an agent/worker event buffer.
 

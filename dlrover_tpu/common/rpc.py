@@ -12,18 +12,20 @@ host), same trust model as the reference's pickled-gRPC protocol.
 """
 
 import pickle
+import selectors
 import socket
-import socketserver
 import struct
 import threading
 import time
 import uuid
-from collections import OrderedDict
-from typing import Any, Callable, Optional, Tuple
+from collections import OrderedDict, deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from dlrover_tpu.chaos.injector import fault_hit
 from dlrover_tpu.chaos.sites import ChaosSite
 from dlrover_tpu.common.backoff import ExponentialBackoff
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 
@@ -88,12 +90,22 @@ class _DedupCache:
     instead of re-executing the handler concurrently.
     """
 
-    def __init__(self, maxsize: int = 4096, ttl: float = DEDUP_TTL):
+    def __init__(self, maxsize: Optional[int] = None,
+                 ttl: Optional[float] = None):
         # req_id -> (timestamp, response) once done; response is None and a
         # pending Event is registered while the handler is executing.
         self._entries: "OrderedDict[str, Tuple[float, Any]]" = OrderedDict()
         self._pending: dict = {}
         self._lock = instrumented_lock("rpc.dedup")
+        if maxsize is None:
+            # Sized from the env registry, not a hardcoded constant: the
+            # cache must hold at least one in-retry-window entry per
+            # client or eviction silently breaks exactly-once at scale.
+            maxsize = env_utils.RPC_DEDUP_SIZE.get()
+        if ttl is None:
+            ttl = env_utils.RPC_DEDUP_TTL_S.get()
+            if ttl <= 0:
+                ttl = DEDUP_TTL
         self._maxsize = maxsize
         self._ttl = ttl
 
@@ -134,8 +146,29 @@ class _DedupCache:
             event.set()
 
 
+class _Conn:
+    """Per-connection state owned by the selector loop thread."""
+
+    __slots__ = ("sock", "rbuf", "wbuf", "pending", "busy")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.rbuf = bytearray()     # partial inbound frames
+        self.wbuf = bytearray()     # outbound bytes not yet written
+        self.pending: deque = deque()  # decoded envelopes awaiting dispatch
+        self.busy = False           # a worker is executing for this conn
+
+
 class RpcServer:
-    """Threaded request/response server: ``handler(request) -> response``.
+    """Selector-loop request/response server: ``handler(request) -> response``.
+
+    One event-loop thread owns every socket (accept, read, write); decoded
+    requests execute on a bounded worker pool instead of a thread per
+    connection, so 10k idle agent connections cost file descriptors, not
+    threads. Two lanes — ``control`` and ``bulk`` — each get their own
+    pool, sized by ``DLROVER_TPU_RPC_CONTROL_WORKERS`` /
+    ``DLROVER_TPU_RPC_WORKERS``: a telemetry storm can exhaust the bulk
+    lane without ever queueing ahead of a rendezvous or rescale RPC.
 
     Requests arrive as ``(req_id, payload)``; responses for recent ids are
     cached so a retried request is answered from cache instead of being
@@ -143,8 +176,12 @@ class RpcServer:
     mutating messages such as KVStoreAdd/JoinRendezvous/TaskReport).
     """
 
-    def __init__(self, port: int, handler: Callable[[Any], Any], host: str = "0.0.0.0"):
+    def __init__(self, port: int, handler: Callable[[Any], Any],
+                 host: str = "0.0.0.0",
+                 classify: Optional[Callable[[Any], str]] = None):
         self._handler = handler
+        #: request -> "control" | "bulk" lane (default: all control).
+        self._classify = classify or (lambda request: "control")
         self._dedup = _DedupCache()
         # Monotonic boot counter of the process logically behind this
         # server (the master's incarnation). When set, every response is
@@ -152,89 +189,43 @@ class RpcServer:
         # fencing signal that triggers re-registration. None (the
         # default) keeps the legacy 2-tuple wire format.
         self.incarnation: Optional[int] = None
-        # Established per-client connections, so stop() can sever them:
-        # a killed master process drops every socket, and the in-process
-        # analog (tests, graceful handover) must behave the same — a
-        # stopped server that keeps answering on old connections would
-        # let clients talk to a master that no longer exists logically.
-        self._conns: set = set()
-        self._conns_lock = instrumented_lock("rpc.server_conns")
-
-        outer = self
-
-        class _Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                sock = self.request
-                with outer._conns_lock:
-                    outer._conns.add(sock)
-                try:
-                    self._serve(sock)
-                finally:
-                    with outer._conns_lock:
-                        outer._conns.discard(sock)
-
-            def _serve(self, sock):
-                while True:
-                    try:
-                        envelope = _recv(sock)
-                    except (ConnectionError, EOFError, OSError):
-                        return
-                    if isinstance(envelope, tuple) and len(envelope) == 2:
-                        req_id, request = envelope
-                    else:  # bare request (tests / simple callers)
-                        req_id, request = None, envelope
-                    chaos = fault_hit(
-                        ChaosSite.RPC_SERVER_RECV,
-                        detail=type(request).__name__,
-                    )
-                    if chaos is not None:
-                        if chaos.kind == "delay":
-                            time.sleep(chaos.delay_s)  # dtlint: disable=DT003 -- scripted chaos delay, not a poll
-                        elif chaos.kind == "drop":
-                            # Request lost before execution: the client
-                            # sees a dead connection and must retry.
-                            sock.close()
-                            return
-                    duplicate, response = (
-                        outer._dedup.begin(req_id) if req_id else (False, None)
-                    )
-                    if not duplicate:
-                        _req_ctx.req_id = req_id
-                        try:
-                            response = (True, outer._handler(request))
-                        except Exception as e:
-                            logger.exception(
-                                "rpc handler error for %r", type(request)
-                            )
-                            response = (False, repr(e))
-                        finally:
-                            _req_ctx.req_id = None
-                        if req_id is not None:
-                            outer._dedup.finish(req_id, response)
-                    if outer.incarnation is not None:
-                        # Stamp at send time (not into the dedup cache):
-                        # a cache entry seeded from the previous
-                        # incarnation's journal still answers with THIS
-                        # incarnation.
-                        response = response + (outer.incarnation,)
-                    if chaos is not None and chaos.kind == "drop_response":
-                        # Executed and dedup-cached, but the answer is
-                        # lost: the retry MUST be served from the cache,
-                        # not re-applied — the exact failure the dedup
-                        # layer exists for.
-                        sock.close()
-                        return
-                    try:
-                        _send(sock, response)
-                    except OSError:
-                        return
-
-        class _Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = _Server((host, port), _Handler)
-        self.port = self._server.server_address[1]
+        # Established per-client connections (loop-owned _Conn objects),
+        # so stop() can sever them: a killed master process drops every
+        # socket, and the in-process analog (tests, graceful handover)
+        # must behave the same — a stopped server that keeps answering
+        # on old connections would let clients talk to a master that no
+        # longer exists logically.
+        self._conns: Dict[socket.socket, _Conn] = {}
+        self._pools = {
+            "control": ThreadPoolExecutor(
+                max_workers=max(1, env_utils.RPC_CONTROL_WORKERS.get()),
+                thread_name_prefix="rpc-ctl",
+            ),
+            "bulk": ThreadPoolExecutor(
+                max_workers=max(1, env_utils.RPC_WORKERS.get()),
+                thread_name_prefix="rpc-bulk",
+            ),
+        }
+        # Submitted-but-unfinished handler count per lane. The bulk
+        # figure is the backpressure probe the servicer's event-shedding
+        # reads; plain int += under one tiny lock.
+        self._lane_backlog = {"control": 0, "bulk": 0}
+        self._stats_lock = instrumented_lock("rpc.server_stats")
+        # Worker -> loop handoff: thread-safe deque of ("send"|"close",
+        # conn, bytes) plus a socketpair to wake the selector.
+        self._outbox: deque = deque()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._inflight = 0          # loop-owned: dispatched, not yet sent
+        self._running = False
+        self._stop_accepting = False
+        self._listener_closed = threading.Event()
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, port))
+        self._listen.listen(1024)
+        self._listen.setblocking(False)
+        self.port = self._listen.getsockname()[1]
         self._thread: Optional[threading.Thread] = None
 
     def seed_dedup(self, req_id: str, result: Any):
@@ -247,30 +238,306 @@ class RpcServer:
         """
         self._dedup.finish(req_id, (True, result))
 
+    def backlog(self, lane: str = "bulk") -> int:
+        """Submitted-but-unfinished handler count for one lane — the
+        load probe behind event-bus backpressure."""
+        with self._stats_lock:
+            return self._lane_backlog.get(lane, 0)
+
     def start(self):
+        self._running = True
         self._thread = threading.Thread(
-            target=self._server.serve_forever, name="rpc-server", daemon=True
+            target=self._loop, name="rpc-server", daemon=True
         )
         self._thread.start()
 
-    def stop(self):
-        if self._thread is not None:
-            # socketserver.shutdown() blocks until serve_forever acks;
-            # if start() was never called that ack never comes.
-            self._server.shutdown()
-        self._server.server_close()
-        with self._conns_lock:
-            conns = list(self._conns)
-            self._conns.clear()
-        for sock in conns:
+    # ---------------- event loop (single thread) ----------------
+    def _loop(self):
+        sel = selectors.DefaultSelector()
+        sel.register(self._listen, selectors.EVENT_READ, "accept")
+        sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        try:
+            while self._running:
+                if self._stop_accepting and not self._listener_closed.is_set():
+                    try:
+                        sel.unregister(self._listen)
+                    except (KeyError, ValueError):
+                        pass
+                    self._listen.close()
+                    self._listener_closed.set()
+                for key, _ in sel.select(timeout=0.5):
+                    what = key.data
+                    if what == "accept":
+                        self._accept(sel)
+                    elif what == "wake":
+                        try:
+                            while self._wake_r.recv(4096):
+                                pass
+                        except (BlockingIOError, OSError):
+                            pass
+                    else:
+                        self._service_conn(sel, what, key.events)
+                self._drain_outbox(sel)
+        finally:
+            for conn in list(self._conns.values()):
+                self._close_conn(sel, conn)
+            if not self._listener_closed.is_set():
+                try:
+                    self._listen.close()
+                except OSError:
+                    pass
+                self._listener_closed.set()
+            sel.close()
+
+    def _accept(self, sel):
+        while True:
             try:
-                sock.shutdown(socket.SHUT_RDWR)
+                sock, _ = self._listen.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except OSError:
                 pass
+            conn = _Conn(sock)
+            self._conns[sock] = conn
+            sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _service_conn(self, sel, conn: _Conn, events: int):
+        if events & selectors.EVENT_READ:
+            while True:
+                try:
+                    chunk = conn.sock.recv(65536)
+                except (BlockingIOError, InterruptedError):
+                    break
+                except OSError:
+                    self._close_conn(sel, conn)
+                    return
+                if not chunk:
+                    self._close_conn(sel, conn)
+                    return
+                conn.rbuf += chunk
+                if len(chunk) < 65536:
+                    break
+            if not self._parse_frames(sel, conn):
+                return
+            self._dispatch(sel, conn)
+        if events & selectors.EVENT_WRITE and conn.wbuf:
             try:
-                sock.close()
+                sent = conn.sock.send(conn.wbuf)
+                del conn.wbuf[:sent]
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError:
+                self._close_conn(sel, conn)
+                return
+            if not conn.wbuf:
+                self._update_interest(sel, conn)
+
+    def _parse_frames(self, sel, conn: _Conn) -> bool:
+        """Decode complete length-prefixed pickles out of rbuf; False if
+        the connection was torn down on a decode error."""
+        while len(conn.rbuf) >= _LEN.size:
+            (n,) = _LEN.unpack_from(conn.rbuf)
+            if len(conn.rbuf) < _LEN.size + n:
+                break
+            raw = bytes(conn.rbuf[_LEN.size:_LEN.size + n])
+            del conn.rbuf[:_LEN.size + n]
+            try:
+                envelope = pickle.loads(raw)
+            except Exception:
+                logger.warning("rpc server: undecodable frame; closing conn")
+                self._close_conn(sel, conn)
+                return False
+            conn.pending.append(envelope)
+        return True
+
+    def _dispatch(self, sel, conn: _Conn):
+        """Hand the next decoded request to its lane's worker pool.
+        One in-flight request per connection: the RpcClient is strict
+        request-response, and in-order responses are part of the
+        contract."""
+        if conn.busy or not conn.pending:
+            return
+        envelope = conn.pending.popleft()
+        if isinstance(envelope, tuple) and len(envelope) == 2:
+            req_id, request = envelope
+        else:  # bare request (tests / simple callers)
+            req_id, request = None, envelope
+        try:
+            lane = self._classify(request)
+        except Exception:
+            lane = "control"
+        if lane not in self._pools:
+            lane = "control"
+        conn.busy = True
+        self._inflight += 1
+        with self._stats_lock:
+            self._lane_backlog[lane] += 1
+        try:
+            self._pools[lane].submit(self._work, conn, req_id, request, lane)
+        except RuntimeError:  # pool shut down: stop() already severing
+            self._inflight -= 1
+            with self._stats_lock:
+                self._lane_backlog[lane] -= 1
+            self._close_conn(sel, conn)
+
+    def _drain_outbox(self, sel):
+        while True:
+            try:
+                op, conn, data = self._outbox.popleft()
+            except IndexError:
+                return
+            self._inflight -= 1
+            conn.busy = False
+            if op == "close" or conn.sock not in self._conns:
+                self._close_conn(sel, conn)
+                continue
+            conn.wbuf += _LEN.pack(len(data)) + data
+            # Opportunistic inline write: the common case (small
+            # response, empty socket buffer) completes here without a
+            # second selector pass.
+            try:
+                sent = conn.sock.send(conn.wbuf)
+                del conn.wbuf[:sent]
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError:
+                self._close_conn(sel, conn)
+                continue
+            self._update_interest(sel, conn)
+            self._dispatch(sel, conn)
+
+    def _update_interest(self, sel, conn: _Conn):
+        want = selectors.EVENT_READ
+        if conn.wbuf:
+            want |= selectors.EVENT_WRITE
+        try:
+            sel.modify(conn.sock, want, conn)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _close_conn(self, sel, conn: _Conn):
+        self._conns.pop(conn.sock, None)
+        try:
+            sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _wake(self):
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+
+    # ---------------- worker side ----------------
+    def _work(self, conn: _Conn, req_id: Optional[str], request: Any,
+              lane: str):
+        try:
+            chaos = fault_hit(
+                ChaosSite.RPC_SERVER_RECV, detail=type(request).__name__
+            )
+            if chaos is not None:
+                if chaos.kind == "delay":
+                    time.sleep(chaos.delay_s)  # dtlint: disable=DT003 -- scripted chaos delay, not a poll
+                elif chaos.kind == "drop":
+                    # Request lost before execution: the client sees a
+                    # dead connection and must retry.
+                    self._outbox.append(("close", conn, b""))
+                    self._wake()
+                    return
+            duplicate, response = (
+                self._dedup.begin(req_id) if req_id else (False, None)
+            )
+            if not duplicate:
+                _req_ctx.req_id = req_id
+                try:
+                    response = (True, self._handler(request))
+                except Exception as e:
+                    logger.exception(
+                        "rpc handler error for %r", type(request)
+                    )
+                    response = (False, repr(e))
+                finally:
+                    _req_ctx.req_id = None
+                if req_id is not None:
+                    self._dedup.finish(req_id, response)
+            if self.incarnation is not None:
+                # Stamp at send time (not into the dedup cache): a cache
+                # entry seeded from the previous incarnation's journal
+                # still answers with THIS incarnation.
+                response = response + (self.incarnation,)
+            if chaos is not None and chaos.kind == "drop_response":
+                # Executed and dedup-cached, but the answer is lost: the
+                # retry MUST be served from the cache, not re-applied —
+                # the exact failure the dedup layer exists for.
+                self._outbox.append(("close", conn, b""))
+                self._wake()
+                return
+            try:
+                data = pickle.dumps(response)
+            except Exception as e:
+                logger.exception("rpc response unpicklable")
+                data = pickle.dumps((False, repr(e)))
+            self._outbox.append(("send", conn, data))
+            self._wake()
+        finally:
+            with self._stats_lock:
+                self._lane_backlog[lane] -= 1
+
+    # ---------------- shutdown ----------------
+    def stop(self, drain: Optional[float] = None):
+        """Stop accepting, drain in-flight handlers (bounded by
+        ``DLROVER_TPU_RPC_DRAIN_S``), then sever every connection.
+
+        The drain keeps a failover drill at high concurrency from
+        leaking half-applied socket errors into client retries: a
+        request whose handler already ran gets its response flushed (and
+        its dedup entry written) before the socket dies.
+        """
+        if drain is None:
+            drain = env_utils.RPC_DRAIN_S.get()
+        if self._thread is None:
+            # start() never ran: nothing in flight, just release the port.
+            try:
+                self._listen.close()
             except OSError:
                 pass
+            self._listener_closed.set()
+        else:
+            self._stop_accepting = True
+            self._wake()
+            # The loop closes the listener (it owns the selector); wait
+            # so a successor can rebind the port the moment we return.
+            self._listener_closed.wait(timeout=5.0)
+            deadline = time.monotonic() + max(0.0, drain)
+            while time.monotonic() < deadline:
+                # Racy read of loop-owned state is fine for a drain
+                # poll: a false "not drained" just waits one more tick.
+                if self._inflight == 0 and not any(
+                    c.wbuf or c.pending for c in list(self._conns.values())
+                ):
+                    break
+                time.sleep(0.02)  # dtlint: disable=DT003 -- bounded shutdown drain poll
+            self._running = False
+            self._wake()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        for pool in self._pools.values():
+            pool.shutdown(wait=False)
+        try:
+            self._wake_w.close()
+        except OSError:
+            pass
+        try:
+            self._wake_r.close()
+        except OSError:
+            pass
 
 
 class RpcClient:

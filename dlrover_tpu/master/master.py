@@ -29,6 +29,7 @@ from dlrover_tpu.observability.plane import (
 )
 from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.monitor.straggler import StragglerDetector
+from dlrover_tpu.master.mutation_locks import MutationLocks
 from dlrover_tpu.master.node_manager import JobManager, LocalJobManager
 from dlrover_tpu.master.rendezvous import (
     DeviceCheckRendezvousManager,
@@ -129,6 +130,13 @@ class JobMaster:
             rdzv_managers=self.rdzv_managers,
             state_store=self.state_store,
         )
+        # Per-subsystem mutation shards replace the old global mutation
+        # lock; the snapshot quiesce holds ALL of them (in canonical
+        # order) so no journal record can land past a rotation it isn't
+        # covered by.
+        self.mutation_locks = MutationLocks()
+        if self.state_store is not None:
+            self.state_store.quiesce = self.mutation_locks.all
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             kv_store=self.kv_store,
@@ -140,6 +148,7 @@ class JobMaster:
             state_store=self.state_store,
             observability=self.observability,
             rescale_coordinator=self.rescale,
+            mutation_locks=self.mutation_locks,
         )
         self._server = create_master_service(port, self.servicer)
         self.port = self._server.port
@@ -400,12 +409,13 @@ class JobMaster:
         )
         store = self.state_store
         if store is not None and not store.replaying:
-            # Write-ahead, under the mutation lock so the eviction's
-            # queue requeues serialize against concurrent RPC mutations
-            # in journal order.
-            with store.mutation_lock:
-                store.append(("evict", node_id, reason, time.time()))
+            # Write-ahead. Eviction spans tasks/nodes/rdzv, so it holds
+            # every mutation shard: the queue requeues serialize against
+            # concurrent RPC mutations in journal order.
+            with self.mutation_locks.all():
+                seq = store.append(("evict", node_id, reason, time.time()))
                 self._apply_evict(node_id, reason)
+            store.wait_durable(seq)
             return
         self._apply_evict(node_id, reason)
 

@@ -40,10 +40,11 @@ recommendation, acted on through the node-manager path only when
 ``DLROVER_TPU_STRAGGLER_EVICT`` is set.
 """
 
+import bisect
 import statistics
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.lockdep import instrumented_lock
@@ -66,6 +67,34 @@ _FLOORS = {
 #: Recovery margin: a flagged metric must come back within this factor
 #: of its frozen baseline (hysteresis against flapping).
 _RECOVER_MARGIN = 1.25
+
+#: Every metric key a baseline can be asked for.
+_ALL_KEYS = (*PHASE_KEYS, RTT_KEY, *BANDWIDTH_KEYS)
+
+
+def _median_sorted(vals: List[float]) -> float:
+    """``statistics.median`` semantics on an already-sorted list."""
+    n = len(vals)
+    if n % 2 == 1:
+        return vals[n // 2]
+    return (vals[n // 2 - 1] + vals[n // 2]) / 2.0
+
+
+def _median_excluding(vals: List[float], value: float) -> float:
+    """Median of a sorted list with one occurrence of ``value`` removed,
+    without materializing the reduced list — O(log n). Equal values are
+    interchangeable, so removing any occurrence yields the same median.
+    """
+    n = len(vals)
+    idx = bisect.bisect_left(vals, value)
+    m = n - 1  # reduced length (caller guarantees >= 1)
+
+    def at(i: int) -> float:
+        return vals[i] if i < idx else vals[i + 1]
+
+    if m % 2 == 1:
+        return at(m // 2)
+    return (at(m // 2 - 1) + at(m // 2)) / 2.0
 
 
 class _WorkerProfile:
@@ -184,21 +213,43 @@ class StragglerDetector:
             self._ticked_at.pop(worker_id, None)
 
     # ------------- classification -------------
-    def _baseline(self, wid: int, key: str) -> Optional[float]:
+    #: Per-tick baseline cache: key -> (sorted recent means, mean by wid).
+    _BaselineCache = Dict[str, Tuple[List[float], Dict[int, float]]]
+
+    def _baseline_cache(self) -> "_BaselineCache":
+        """One pass over all profiles per tick. The old per-worker peer
+        scan made a tick O(workers^2 x keys) — at 10k workers that held
+        the detector lock for minutes, freezing the bulk RPC lane (every
+        beat's probe ingestion queues on this lock via the event-log
+        listener chain). With the cache a tick is O(workers x keys) to
+        gather plus O(log n) per baseline lookup. Lock held."""
+        per_key: Dict[str, Dict[int, float]] = {k: {} for k in _ALL_KEYS}
+        for wid, prof in self._profiles.items():
+            for key in _ALL_KEYS:
+                r = prof.recent(key, self._sustain)
+                if r is not None:
+                    per_key[key][wid] = r
+        return {
+            key: (sorted(by_wid.values()), by_wid)
+            for key, by_wid in per_key.items()
+        }
+
+    def _baseline(self, wid: int, key: str,
+                  cache: "_BaselineCache") -> Optional[float]:
         """Peer median of recent means when >=2 peers report the key,
         else the worker's own rolling median. Lock held."""
-        peers = [
-            p.recent(key, self._sustain)
-            for w, p in self._profiles.items() if w != wid
-        ]
-        peers = [v for v in peers if v is not None]
-        if len(peers) >= 2:
-            return statistics.median(peers)
-        if len(peers) == 1:
-            return peers[0]
-        return self._profiles[wid].own_baseline(key)
+        sorted_vals, by_wid = cache.get(key, ((), {}))
+        own = by_wid.get(wid)
+        peers = len(sorted_vals) - (1 if own is not None else 0)
+        if peers == 0:
+            prof = self._profiles.get(wid)
+            return prof.own_baseline(key) if prof is not None else None
+        if own is None:
+            return _median_sorted(sorted_vals)
+        return _median_excluding(sorted_vals, own)
 
-    def _outlier_keys(self, wid: int, prof: _WorkerProfile) -> Dict[str, str]:
+    def _outlier_keys(self, wid: int, prof: _WorkerProfile,
+                      cache: "_BaselineCache") -> Dict[str, str]:
         """key -> evidence string for every metric currently out of
         bounds vs its (frozen or live) baseline. Lock held."""
         out: Dict[str, str] = {}
@@ -209,7 +260,7 @@ class StragglerDetector:
                 continue
             base = (
                 prof.frozen.get(key) if flagged else
-                self._baseline(wid, key)
+                self._baseline(wid, key, cache)
             )
             if base is None:
                 continue
@@ -225,7 +276,7 @@ class StragglerDetector:
                 continue
             base = (
                 prof.frozen.get(key) if flagged else
-                self._baseline(wid, key)
+                self._baseline(wid, key, cache)
             )
             if base is None or base <= 0:
                 continue
@@ -255,12 +306,13 @@ class StragglerDetector:
         recoveries: List[tuple] = []
         evictions: List[tuple] = []
         with self._lock:
+            cache = self._baseline_cache()
             for wid, prof in self._profiles.items():
                 seen = self._ticked_at.get(wid, 0)
                 if prof.samples_seen <= seen:
                     continue  # nothing new: counters hold, no verdicts
                 self._ticked_at[wid] = prof.samples_seen
-                outliers = self._outlier_keys(wid, prof)
+                outliers = self._outlier_keys(wid, prof, cache)
                 kind = self._classify(outliers)
                 if prof.flagged is None:
                     if kind is None:
@@ -279,8 +331,8 @@ class StragglerDetector:
                         # Freeze baselines: the window will absorb the
                         # degradation; recovery compares against healthy.
                         prof.frozen = {}
-                        for key in (*PHASE_KEYS, RTT_KEY, *BANDWIDTH_KEYS):
-                            base = self._baseline(wid, key)
+                        for key in _ALL_KEYS:
+                            base = self._baseline(wid, key, cache)
                             if base is not None:
                                 prof.frozen[key] = base
                         evidence = "; ".join(

@@ -1,0 +1,87 @@
+"""Sharded master mutation locks.
+
+One global mutation lock serialized every servicer dispatch against
+every other — a kv barrier ping could queue behind a 256-event
+telemetry batch. These shards split that lock by subsystem so
+independent mutations proceed in parallel while each subsystem keeps
+its strict journal-order = apply-order guarantee (the state store's
+``append`` is internally serialized; cross-shard interleavings replay
+identically because replay is single-threaded and the subsystems are
+disjoint).
+
+Deadlock discipline: every multi-shard acquisition takes locks in the
+canonical ``SHARDS`` order, and each lock carries a lockdep-instrumented
+hierarchical name (``master.mutation.<shard>``) so the runtime lockdep
+from PR 7 proves the order cycle-free (``tests`` assert it). The store
+lock (``master.state_store``) only ever nests INSIDE a shard — never the
+reverse — and the snapshot path acquires ALL shards first via
+:meth:`MutationLocks.all`, matching that order.
+"""
+
+from contextlib import ExitStack, contextmanager
+from typing import Dict, Iterable, Tuple
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.lockdep import instrumented_lock
+
+#: Canonical acquisition order. Multi-shard holders (NodeFailure, the
+#: snapshot quiesce) always acquire in this sequence.
+SHARDS: Tuple[str, ...] = ("kv", "tasks", "nodes", "rdzv", "events")
+
+#: Message class -> the shards its handler mutates. A journaled message
+#: missing here falls back to every shard (correct, just slower) so a
+#: future message class cannot silently under-lock.
+_SHARDS_BY_TYPE: Dict[type, Tuple[str, ...]] = {
+    m.KVStoreSet: ("kv",),
+    m.KVStoreAdd: ("kv",),
+    m.KVStoreDelete: ("kv",),
+    # Writer election is a first-claimant race over kv state.
+    m.CkptWriterElect: ("kv",),
+    m.DatasetShardParams: ("tasks",),
+    m.TaskRequest: ("tasks",),
+    m.TaskReport: ("tasks",),
+    m.TaskHoldReport: ("tasks",),
+    # Status changes also reclaim the node's in-flight shards.
+    m.NodeStatusReport: ("tasks", "nodes"),
+    # Failure handling spans the node registry, every rendezvous, task
+    # reclaim, and the rescale coordinator (rdzv shard).
+    m.NodeFailure: ("tasks", "nodes", "rdzv"),
+    m.RescaleAck: ("rdzv",),
+    m.EventReport: ("events",),
+}
+
+
+class MutationLocks:
+    """The servicer's per-subsystem mutation shards."""
+
+    def __init__(self):
+        self._locks = {
+            name: instrumented_lock(f"master.mutation.{name}", rlock=True)
+            for name in SHARDS
+        }
+
+    def shard(self, name: str):
+        return self._locks[name]
+
+    @contextmanager
+    def acquire(self, names: Iterable[str]):
+        """Hold the named shards, always in canonical order."""
+        wanted = set(names)
+        with ExitStack() as stack:
+            for name in SHARDS:
+                if name in wanted:
+                    stack.enter_context(self._locks[name])
+            yield
+
+    def all(self):
+        """Every shard, in canonical order — the snapshot quiesce and
+        the master's own multi-subsystem mutations (evict) use this."""
+        return self.acquire(SHARDS)
+
+    def shards_for(self, request) -> Tuple[str, ...]:
+        """The canonical-order shard tuple a message's handler holds."""
+        wanted = set(_SHARDS_BY_TYPE.get(type(request), SHARDS))
+        return tuple(n for n in SHARDS if n in wanted)
+
+    def for_message(self, request) -> "ExitStack":
+        return self.acquire(self.shards_for(request))

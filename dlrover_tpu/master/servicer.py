@@ -13,10 +13,13 @@ from typing import Any, Dict
 
 from dlrover_tpu.chaos.injector import fault_hit
 from dlrover_tpu.chaos.sites import ChaosSite
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RpcServer, current_request_id
+from dlrover_tpu.master.mutation_locks import MutationLocks
+from dlrover_tpu.observability.event_log import is_telemetry
 from dlrover_tpu.observability.events import EventKind, emit
 
 #: Messages whose handlers mutate durable master state. With a state
@@ -66,6 +69,7 @@ class MasterServicer:
         state_store=None,
         observability=None,
         rescale_coordinator=None,
+        mutation_locks=None,
     ):
         self._rdzv_managers = rdzv_managers
         self._kv_store = kv_store
@@ -77,9 +81,22 @@ class MasterServicer:
         self._state_store = state_store
         self._observability = observability
         self._rescale = rescale_coordinator
+        self._locks = mutation_locks or MutationLocks()
+        # Bulk-lane load probe, wired by attach_server: drives the
+        # EventReport telemetry-shedding backpressure below.
+        self._bulk_backlog: Any = None
         self._paral_config = m.ParallelConfig()
         self._job_exit = None
         self._start_time = time.time()
+
+    @property
+    def mutation_locks(self) -> MutationLocks:
+        return self._locks
+
+    def attach_server(self, server: RpcServer):
+        """Late-bind the transport so handlers can read its lane
+        backlog (the backpressure probe)."""
+        self._bulk_backlog = lambda: server.backlog("bulk")
 
     # The transport handler.
     def handle(self, request: Any) -> Any:
@@ -114,10 +131,11 @@ class MasterServicer:
             # the record must carry the chosen shard's exact range, and
             # a lost record is safe — the replayed master still holds
             # the shard in todo and the fenced client re-reports it.
-            with store.mutation_lock:
+            seq = None
+            with self._locks.for_message(request):
                 task = handler(self, request)
                 if task.exists:
-                    store.append(("dispatch", current_request_id(), {
+                    seq = store.append(("dispatch", current_request_id(), {
                         "worker": request.node_id,
                         "dataset": task.dataset_name,
                         "task_id": task.task_id,
@@ -126,13 +144,18 @@ class MasterServicer:
                         "end": task.end,
                         "record_indices": task.record_indices,
                     }, time.time()))
-                return task
+            # Durability barrier OUTSIDE the shard: the group-commit
+            # fsync wait must never serialize unrelated mutations.
+            store.wait_durable(seq)
+            return task
         if isinstance(request, _JOURNALED):
-            with store.mutation_lock:
-                store.append(
+            with self._locks.for_message(request):
+                seq = store.append(
                     ("rpc", current_request_id(), request, time.time())
                 )
-                return handler(self, request)
+                resp = handler(self, request)
+            store.wait_durable(seq)
+            return resp
         return handler(self, request)
 
     # ---------------- rendezvous ----------------
@@ -362,14 +385,51 @@ class MasterServicer:
 
     def _report_events(self, req: m.EventReport):
         if self._observability:
+            events = req.events
+            store = self._state_store
+            replaying = store is not None and store.replaying
+            if not replaying and self._bulk_backlog is not None:
+                # Backpressure: when the bulk lane is backed up, shed the
+                # ring-only telemetry kinds (metric.*, step.phases,
+                # probe.link) and keep only durable incident events, so
+                # a telemetry storm can never starve rendezvous/rescale
+                # RPCs. Replay never sheds (the probe reads 0 backlog) —
+                # acceptable nondeterminism for explicitly loss-tolerant
+                # sampling data.
+                try:
+                    backlog = self._bulk_backlog()
+                except Exception:
+                    backlog = 0
+                if backlog > env_utils.EVENT_SHED_BACKLOG.get():
+                    kept = [e for e in events
+                            if not is_telemetry(getattr(e, "kind", ""))]
+                    shed = len(events) - len(kept)
+                    if shed:
+                        self._observability.note_shed(shed)
+                        events = kept
             # Not re-journaled per event: this EventReport is itself a
             # journaled RPC and replays through this same path.
-            self._observability.ingest_report(req.events)
+            self._observability.ingest_report(events)
         return m.Response()
 
     def _report_heartbeat(self, req: m.NodeHeartbeat):
         if self._job_manager:
             self._job_manager.report_heartbeat(req.node_id, req.timestamp)
+        return m.Response()
+
+    def _agent_beat(self, req: m.AgentBeat):
+        """The coalesced agent heartbeat: one RPC folds the node
+        heartbeat, the newest step progress and the latest link-probe
+        sample, applied as a single dispatch instead of three."""
+        if self._job_manager:
+            self._job_manager.report_heartbeat(req.node_id, req.timestamp)
+        if req.step >= 0:
+            self._report_step(m.GlobalStep(
+                node_id=req.node_id, node_type=req.node_type,
+                step=req.step, timestamp=req.step_ts or req.timestamp,
+            ))
+        if req.probe and self._observability is not None:
+            self._observability.ingest_probe(req.node_id, req.probe)
         return m.Response()
 
     def _report_node_status(self, req: m.NodeStatusReport):
@@ -449,6 +509,7 @@ MasterServicer._HANDLERS = {
     m.NodeFailure: MasterServicer._report_failure,
     m.EventReport: MasterServicer._report_events,
     m.NodeHeartbeat: MasterServicer._report_heartbeat,
+    m.AgentBeat: MasterServicer._agent_beat,
     m.NodeStatusReport: MasterServicer._report_node_status,
     m.SyncJoin: MasterServicer._sync_join,
     m.SyncFinish: MasterServicer._sync_finished,
@@ -459,5 +520,27 @@ MasterServicer._HANDLERS = {
 }
 
 
+#: High-volume periodic telemetry classes routed to the RPC server's
+#: bulk worker lane; everything else (rendezvous, rescale, kv barriers,
+#: shard dispatch) stays on the control lane, so a telemetry storm can
+#: exhaust bulk workers without queueing ahead of a rescale ack.
+_BULK_CLASSES = (
+    m.EventReport,
+    m.GlobalStep,
+    m.NodeResourceStats,
+    m.NodeHeartbeat,
+    m.AgentBeat,
+    m.ModelInfo,
+)
+
+
+def message_priority(request: Any) -> str:
+    """RpcServer lane classifier: ``bulk`` for periodic telemetry,
+    ``control`` for everything latency-critical."""
+    return "bulk" if isinstance(request, _BULK_CLASSES) else "control"
+
+
 def create_master_service(port: int, servicer: MasterServicer) -> RpcServer:
-    return RpcServer(port, servicer.handle)
+    server = RpcServer(port, servicer.handle, classify=message_priority)
+    servicer.attach_server(server)
+    return server

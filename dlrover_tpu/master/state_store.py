@@ -29,7 +29,8 @@ import pickle
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from contextlib import nullcontext
+from typing import Any, Callable, ContextManager, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.checksum import (
     DEFAULT_ALGO,
@@ -121,9 +122,25 @@ class MasterStateStore:
     Concurrency contract: ``mutation_lock`` (re-entrant) serializes
     every state mutation WITH its journal append, so the journal order
     equals the apply order and replay is deterministic. The servicer
-    holds it across each mutating handler; ``snapshot`` holds it across
-    collect + rotate so no mutation can land in a journal that the new
-    snapshot already covers.
+    holds the per-subsystem mutation shard for each mutating handler
+    (append itself stays internally serialized, so the journal order
+    within a subsystem equals its apply order); ``snapshot`` first
+    enters the ``quiesce`` hook (the master wires it to "hold every
+    mutation shard") and then holds the store lock across collect +
+    rotate, so no mutation can land in a journal that the new snapshot
+    already covers.
+
+    Durability contract (``DLROVER_TPU_WAL_SYNC``):
+
+    - ``group`` (default): ``append`` writes the record under the lock
+      and returns a commit sequence; a dedicated commit thread fsyncs
+      in batches and ``wait_durable(seq)`` blocks the caller on its
+      batch's durability barrier. Write-ahead + exactly-once replay are
+      byte-for-byte unchanged — only *when* os.fsync runs moves.
+    - ``always``: one fsync per mutation, inline (the per-mutation
+      baseline the bench compares against).
+    - ``none``: never fsync the journal (page-cache durability only —
+      the pre-group-commit legacy behavior; snapshots still fsync).
     """
 
     def __init__(
@@ -132,12 +149,14 @@ class MasterStateStore:
         snapshot_interval: Optional[float] = None,
         snapshot_every_records: int = DEFAULT_SNAPSHOT_EVERY_RECORDS,
         keep_generations: int = 3,
+        sync_policy: Optional[str] = None,
     ):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
         self._algo = DEFAULT_ALGO
         self._lock = instrumented_lock("master.state_store", rlock=True)
         self._journal_file = None
+        self._journal_path: Optional[str] = None
         self._seq = 0
         self._records_since_snapshot = 0
         self._appended_records = 0
@@ -155,10 +174,42 @@ class MasterStateStore:
         self.incarnation = 0
         self.last_recovery_stats: Dict[str, Any] = {}
         #: Optional ``(op, seconds)`` callback ("append" = journal record
-        #: write, "fsync" = snapshot durability point). The master wires
-        #: it to the observability plane's WAL histograms; always invoked
-        #: OUTSIDE the mutation lock.
+        #: write, "fsync" = journal/snapshot durability point). The
+        #: master wires it to the observability plane's WAL histograms;
+        #: always invoked OUTSIDE the mutation lock.
         self.timing_sink: Optional[Callable[[str, float], None]] = None
+        #: Snapshot pre-lock: returns a context manager held across the
+        #: whole snapshot. The master wires it to "acquire every
+        #: servicer mutation shard", so a snapshot cannot capture state
+        #: from a mutation whose journal record lands after rotation
+        #: (which replay would then lose). Default: no-op.
+        self.quiesce: Callable[[], ContextManager] = nullcontext
+        if sync_policy is None:
+            sync_policy = env_utils.WAL_SYNC.get()
+        if sync_policy not in ("group", "always", "none"):
+            logger.warning(
+                "unknown WAL sync policy %r; using 'group'", sync_policy
+            )
+            sync_policy = "group"
+        self.sync_policy = sync_policy
+        self._group_window = max(0.0, env_utils.WAL_GROUP_WINDOW_S.get())
+        # Group-commit plumbing. The condition has its own lock; the
+        # only nesting ever used is store-lock -> commit-lock (append,
+        # snapshot). The commit thread takes each alone, never nested.
+        self._commit_cv = threading.Condition(
+            instrumented_lock("master.state_store.commit")
+        )
+        self._commit_seq = 0        # records written to the journal
+        self._durable_seq = 0       # records known fsynced (or covered)
+        self._durable_offset = 0    # journal byte offset at the barrier
+        self._fsync_count = 0       # journal fsyncs (not snapshot's)
+        self._commit_stop = False
+        self._commit_thread: Optional[threading.Thread] = None
+        if self.sync_policy == "group":
+            self._commit_thread = threading.Thread(
+                target=self._commit_loop, name="wal-commit", daemon=True
+            )
+            self._commit_thread.start()
 
     @property
     def mutation_lock(self) -> threading.RLock:
@@ -184,28 +235,155 @@ class MasterStateStore:
         return self.incarnation
 
     # ---------------- journal ----------------
-    def append(self, record: Any):
+    def append(self, record: Any) -> Optional[int]:
         """Append one mutation record to the journal (write-ahead).
 
-        No-op while replaying (replay must not re-journal itself) and
-        before the first snapshot opened a journal (recovery window —
-        the post-recovery snapshot covers that state).
+        Returns the record's commit sequence — pass it to
+        :meth:`wait_durable` for the group-commit durability barrier.
+        Returns ``None`` when nothing was journaled: while replaying
+        (replay must not re-journal itself) and before the first
+        snapshot opened a journal (recovery window — the post-recovery
+        snapshot covers that state).
         """
         dt = None
+        fsync_dt = None
         with self._lock:
             if self._journal_file is None or self.replaying:
-                return
+                return None
+            f = self._journal_file
             payload = pickle.dumps(record)
             t0 = time.perf_counter()
-            self._journal_file.write(_frame(payload, self._algo))
+            f.write(_frame(payload, self._algo))
             dt = time.perf_counter() - t0
+            pos = f.tell()
             self._records_since_snapshot += 1
             self._appended_records += 1
-        if dt is not None and self.timing_sink is not None:
-            self.timing_sink("append", dt)
+            with self._commit_cv:
+                self._commit_seq += 1
+                seq = self._commit_seq
+                if self.sync_policy == "group":
+                    self._commit_cv.notify_all()
+                elif self.sync_policy == "none":
+                    # Legacy page-cache durability: the record counts as
+                    # committed the moment write() returns.
+                    self._durable_seq = seq
+                    self._durable_offset = pos
+        if self.sync_policy == "always":
+            # Inline per-mutation fsync (the bench baseline arm),
+            # deliberately OUTSIDE the store lock so it serializes the
+            # caller, not every other appender.
+            t0 = time.perf_counter()
+            try:
+                os.fsync(f.fileno())
+            except (OSError, ValueError):
+                # Rotated mid-flight: _open_journal fsynced the old
+                # journal before closing it, so the record is durable.
+                pass
+            fsync_dt = time.perf_counter() - t0
+            with self._commit_cv:
+                self._durable_seq = max(self._durable_seq, seq)
+                if self._journal_path is not None and f is self._journal_file:
+                    self._durable_offset = max(self._durable_offset, pos)
+                self._fsync_count += 1
+                self._commit_cv.notify_all()
+        if self.timing_sink is not None:
+            if dt is not None:
+                self.timing_sink("append", dt)
+            if fsync_dt is not None:
+                self.timing_sink("fsync", fsync_dt)
+        return seq
+
+    def wait_durable(self, seq: Optional[int], timeout: float = 30.0) -> bool:
+        """Block until record ``seq`` is durable (batch-fsynced, or
+        covered by a snapshot rotation). This is the group-commit
+        durability barrier: a caller that journaled a mutation waits
+        here AFTER releasing its mutation shard, so fsync latency never
+        serializes unrelated subsystems. Returns ``False`` only on
+        timeout; ``seq=None`` (nothing journaled) and non-group sync
+        policies return immediately."""
+        if seq is None or self.sync_policy != "group":
+            return True
+        deadline = time.monotonic() + timeout
+        with self._commit_cv:
+            while self._durable_seq < seq and not self._commit_stop:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._commit_cv.wait(min(remaining, 1.0))
+            # On shutdown close() fsyncs the journal tail itself.
+            return True
+
+    def _commit_loop(self):
+        """Dedicated group-commit thread: one fsync covers every record
+        appended since the previous barrier. Sleeps the accumulation
+        window so concurrent appends coalesce, snapshots (file, target
+        seq, byte offset) under the store lock, fsyncs OUTSIDE all
+        locks, then advances the barrier and wakes the waiters."""
+        while True:
+            with self._commit_cv:
+                while (
+                    self._commit_seq <= self._durable_seq
+                    and not self._commit_stop
+                ):
+                    self._commit_cv.wait(1.0)
+                if self._commit_stop:
+                    return
+            if self._group_window > 0:
+                time.sleep(self._group_window)  # dtlint: disable=DT003 -- deliberate accumulation window: coalescing appends into one fsync is the point
+            with self._lock:
+                f = self._journal_file
+                path = self._journal_path
+                if f is None:
+                    continue
+                with self._commit_cv:
+                    target = self._commit_seq
+                try:
+                    pos = f.tell()
+                except (OSError, ValueError):
+                    continue
+            t0 = time.perf_counter()
+            try:
+                os.fsync(f.fileno())
+            except (OSError, ValueError):
+                # Rotated and closed mid-batch: _open_journal fsynced
+                # the old journal before closing, so target is durable.
+                pass
+            fsync_dt = time.perf_counter() - t0
+            with self._commit_cv:
+                self._durable_seq = max(self._durable_seq, target)
+                if path == self._journal_path:
+                    self._durable_offset = max(self._durable_offset, pos)
+                self._fsync_count += 1
+                self._commit_cv.notify_all()
+            if self.timing_sink is not None:
+                self.timing_sink("fsync", fsync_dt)
+
+    def wal_status(self) -> Dict[str, Any]:
+        """Group-commit counters for the fleet harness, the bench's
+        fsyncs-per-mutation arms, and the torn-tail boundary tests
+        (``durable_offset`` is the journal byte offset of the last
+        durability barrier — truncating there simulates a power cut
+        that loses exactly the un-fsynced batch tail)."""
+        with self._commit_cv:
+            return {
+                "policy": self.sync_policy,
+                "commit_seq": self._commit_seq,
+                "durable_seq": self._durable_seq,
+                "durable_offset": self._durable_offset,
+                "fsync_count": self._fsync_count,
+                "appended_records": self._appended_records,
+                "journal_path": self._journal_path,
+            }
 
     def _open_journal(self, seq: int):
         if self._journal_file is not None:
+            try:
+                # Keep the rotated-out journal durable before closing:
+                # the corrupt-snapshot fallback chain replays it, and
+                # the commit thread may still be mid-batch against it.
+                os.fsync(self._journal_file.fileno())  # dtlint: disable=DT002 -- rotation must stay atomic with the snapshot cut; appends block by design
+            except (OSError, ValueError):
+                pass
             try:
                 self._journal_file.close()
             except OSError:
@@ -221,40 +399,109 @@ class MasterStateStore:
             raw = self._algo.encode()
             f.write(_JOURNAL_MAGIC + bytes([len(raw)]) + raw)
         self._journal_file = f
+        self._journal_path = path
 
     # ---------------- snapshots ----------------
     def snapshot(self, collect_fn: Callable[[], Dict[str, Any]]) -> int:
-        """Cut a full snapshot and rotate the journal; returns its seq."""
-        fsync_dt = None
-        with self._lock:
+        """Cut a full snapshot and rotate the journal; returns its seq.
+
+        Holds the ``quiesce`` hook (every servicer mutation shard) only
+        for ``collect_fn`` — at fleet scale the expensive parts of a
+        cut are pickling and fsyncing megabytes of state, and doing
+        that under the quiesce used to stall every mutation for whole
+        seconds. ``collect_fn`` also runs OUTSIDE the store lock:
+        collectors take subsystem locks (task manager, job manager,
+        rdzv), and those subsystems journal while holding their own
+        lock, so calling them under the store lock would invert the
+        canonical ``shard -> subsystem -> store`` order
+        (lockdep-enforced).
+
+        Atomicity is preserved by journal carry-forward instead of
+        exclusion: any record appended after collect began — sharded
+        mutations flowing while the snapshot serializes, plus
+        journal-after-apply paths that never hold a shard (the rdzv
+        state listener, the rescale coordinator, durable event sinks)
+        — lands in the old journal past the carry mark, and rotation
+        copies those bytes into the fresh journal so they replay on
+        top of the snapshot. A sharded record past the mark cannot be
+        reflected in the collected state (its shard was held by the
+        quiesce during collect), so replay applies it exactly once;
+        non-sharded records are replay-idempotent by contract (rdzv
+        counters max-merge, rescale records are set-union/overwrite,
+        a duplicated event costs one ring entry).
+        """
+        with self.quiesce():
+            with self._lock:
+                # Byte offset where the carry window opens: appends
+                # landing past this offset are not reflected in the
+                # collected state and must ride into the new journal.
+                carry_path = self._journal_path
+                carry_from = (
+                    self._journal_file.tell()
+                    if self._journal_file is not None else 0
+                )
             state = collect_fn()
-            seq = self._seq + 1
-            payload = pickle.dumps(state)
-            path = os.path.join(
-                self.state_dir, f"{SNAPSHOT_PREFIX}{seq}{SNAPSHOT_SUFFIX}"
-            )
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:  # dtlint: disable=DT002 -- snapshot+rotate must be atomic w.r.t. appends; mutations block on the lock by design
-                _write_header(f, _SNAP_MAGIC, self._algo)
-                f.write(_frame(payload, self._algo))
-                f.flush()
-                t0 = time.perf_counter()
-                os.fsync(f.fileno())
-                fsync_dt = time.perf_counter() - t0
+        # Serialize + persist outside quiesce AND store lock: mutations
+        # keep flowing (into the old journal, past the carry mark)
+        # while the heavy I/O runs. _seq only changes here, and the
+        # monitor loop is the single snapshot caller.
+        seq = self._seq + 1
+        payload = pickle.dumps(state)
+        path = os.path.join(
+            self.state_dir, f"{SNAPSHOT_PREFIX}{seq}{SNAPSHOT_SUFFIX}"
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            _write_header(f, _SNAP_MAGIC, self._algo)
+            f.write(_frame(payload, self._algo))
+            f.flush()
+            t0 = time.perf_counter()
+            os.fsync(f.fileno())
+            fsync_dt = time.perf_counter() - t0
+        with self._lock:
             os.replace(tmp, path)
+            carry = b""
+            if carry_path and self._journal_file is not None:
+                # Whole frames only: appends are single unbuffered
+                # writes under the store lock, which we hold from
+                # here through rotation.
+                with open(carry_path, "rb") as jf:  # dtlint: disable=DT002 -- carry read must be atomic with the rotation; appends block on the lock by design
+                    jf.seek(carry_from)
+                    carry = jf.read()
             self._open_journal(seq)
+            if carry:
+                self._journal_file.write(carry)
+                # The old journal was fsynced at rotation but is
+                # GC-eligible; the carried tail must be durable in
+                # the journal that will actually replay.
+                os.fsync(self._journal_file.fileno())  # dtlint: disable=DT002 -- carry tail must outlive the rotated-out journal's GC
             self._seq = seq
             self._records_since_snapshot = 0
             self._last_snapshot_time = time.monotonic()
+            with self._commit_cv:
+                # Every record journaled so far is covered by this
+                # snapshot (or carried into its journal): rebase the
+                # durability barrier onto the fresh journal and
+                # release any group-commit waiters.
+                self._durable_seq = self._commit_seq
+                self._durable_offset = self._journal_file.tell()
+                self._commit_cv.notify_all()
             self._gc()
-        if fsync_dt is not None and self.timing_sink is not None:
+        if self.timing_sink is not None:
             self.timing_sink("fsync", fsync_dt)
         return seq
 
     def maybe_snapshot(self, collect_fn: Callable[[], Dict[str, Any]]):
         """Periodic-snapshot driver (called from the master's monitor
         loop): cut one when the interval elapsed or the journal grew
-        past the record backstop."""
+        past the record backstop.
+
+        The dueness check and the cut are deliberately NOT atomic:
+        ``snapshot`` enters the quiesce hook (servicer mutation shards)
+        BEFORE the store lock, so holding the store lock across the
+        call would invert that order. The single monitor thread is the
+        only caller, so the check cannot race another cut.
+        """
         with self._lock:
             if self._journal_file is None:
                 return
@@ -266,7 +513,7 @@ class MasterStateStore:
             )
             if not due or self._records_since_snapshot == 0:
                 return
-            self.snapshot(collect_fn)
+        self.snapshot(collect_fn)
 
     def _gc(self):
         """Drop generations older than the keep window (lock held)."""
@@ -385,8 +632,21 @@ class MasterStateStore:
         return state, records
 
     def close(self):
+        if self._commit_thread is not None:
+            with self._commit_cv:
+                self._commit_stop = True
+                self._commit_cv.notify_all()
+            self._commit_thread.join(timeout=2.0)
+            self._commit_thread = None
         with self._lock:
             if self._journal_file is not None:
+                if self.sync_policy != "none":
+                    try:
+                        # Final durability point: cover any batch tail
+                        # the commit thread had not fsynced yet.
+                        os.fsync(self._journal_file.fileno())  # dtlint: disable=DT002 -- shutdown path; no concurrent appenders remain
+                    except (OSError, ValueError):
+                        pass
                 try:
                     self._journal_file.close()
                 except OSError:

@@ -30,9 +30,17 @@ from dlrover_tpu.observability.events import JobEvent
 _SAMPLING_KINDS = frozenset({"step.phases", "probe.link"})
 
 
+def is_telemetry(kind: str) -> bool:
+    """Ring-only, loss-tolerant sampling kinds (``metric.*`` plus the
+    per-step phase and link-probe samples). Excluded from the WAL, and
+    the first — and only — events shed under control-plane backpressure
+    (reporter fill watermark agent-side, bulk-lane backlog master-side):
+    dropping one costs a rolling-window sample, never an incident."""
+    return kind.startswith("metric.") or kind in _SAMPLING_KINDS
+
+
 def _durable(ev: JobEvent) -> bool:
-    return (not ev.kind.startswith("metric.")
-            and ev.kind not in _SAMPLING_KINDS)
+    return not is_telemetry(ev.kind)
 
 
 class EventLog:

@@ -56,6 +56,8 @@ class ObservabilityPlane:
         self.wal_fsync_hist = LatencyHistogram(name="observability.wal_fsync")
         self.wal_append_hist = LatencyHistogram(
             name="observability.wal_append")
+        #: Telemetry events shed by the EventReport backpressure path.
+        self.shed_events = 0
 
     def attach(self, speed_monitor=None, job_manager=None,
                task_manager=None, straggler_detector=None):
@@ -75,6 +77,22 @@ class ObservabilityPlane:
         the EventReport RPC itself is a journaled mutation and replays
         through this same path."""
         self.event_log.extend(events, journal=False)
+
+    def ingest_probe(self, node_id: int, sample: Dict):
+        """A link-probe sample that rode in on a coalesced AgentBeat:
+        synthesize the ring-only ``probe.link`` event the straggler
+        detector consumes (the uncoalesced path emits the identical
+        event agent-side and forwards it via EventReport)."""
+        self.event_log.append(JobEvent(
+            kind=EventKind.PROBE_LINK, ts=time.time(), node_id=node_id,
+            role="agent", pid=0, args=dict(sample),
+        ), journal=False)
+
+    def note_shed(self, count: int):
+        """Count telemetry events shed by the EventReport backpressure
+        path (callers hold the events mutation shard, so plain
+        increments are already serialized)."""
+        self.shed_events += count
 
     def note_step(self, step: int, ts: Optional[float] = None):
         self.ledger.note_step(step, ts)
@@ -272,6 +290,13 @@ class ObservabilityPlane:
                 "dlrover_tpu_wal_append_seconds", "histogram",
                 "State-store WAL record write duration.",
                 [(None, self.wal_append_hist.snapshot())],
+            ))
+        if self.shed_events:
+            metrics.append((
+                "dlrover_tpu_events_shed_total", "counter",
+                "Ring-only telemetry events shed under control-plane "
+                "backpressure (bulk-lane backlog over the threshold).",
+                [(None, self.shed_events)],
             ))
         counts = self.event_log.counts_by_kind()
         if counts:

@@ -13,6 +13,14 @@ so a retried batch is applied exactly once. A short master outage is
 absorbed by the RpcClient's own ride-out; if a flush still fails (the
 master stayed down past the retry deadline) the batch is re-queued at
 the front and the loop backs off with jitter before trying again.
+
+Backpressure: when the buffer fills past ``DLROVER_TPU_EVENT_SHED_PCT``
+of its capacity the reporter sheds *telemetry* kinds (metric samples,
+phase breakdowns, probe samples — see ``event_log.is_telemetry``) at
+the emit site instead of letting them push lifecycle events out the
+head of the deque. The master applies the same lane split server-side
+(``MasterServicer._report_events``); shedding here too keeps a slow
+link from burning RPC budget on events the master would drop anyway.
 """
 
 import atexit
@@ -20,9 +28,11 @@ import threading
 from collections import deque
 from typing import List, Optional
 
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.backoff import ExponentialBackoff, poll_until
 from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.event_log import is_telemetry
 from dlrover_tpu.observability.events import JobEvent
 
 
@@ -44,8 +54,14 @@ class EventReporter:
         self._wake = threading.Event()
         self._stopped = threading.Event()
         self._degraded = False  # last send failed; master presumed gone
+        # Buffer fill (fraction of maxlen) past which telemetry kinds
+        # are shed at emit instead of buffered.
+        self._shed_fill = max(
+            0.0, min(1.0, env_utils.EVENT_SHED_PCT.get() / 100.0)
+        )
         self.sent = 0
         self.dropped = 0
+        self.shed = 0
         self._thread = threading.Thread(
             target=self._flush_loop, daemon=True, name="event-reporter"
         )
@@ -68,6 +84,12 @@ class EventReporter:
 
     def emit(self, ev: JobEvent):
         with self._lock:
+            fill = len(self._buffer) / (self._buffer.maxlen or 1)
+            if fill >= self._shed_fill and is_telemetry(ev.kind):
+                # Backlogged: telemetry is droppable by contract
+                # (ring-only on the master), lifecycle events are not.
+                self.shed += 1
+                return
             if len(self._buffer) == self._buffer.maxlen:
                 self.dropped += 1
             self._buffer.append(ev)

@@ -10,6 +10,8 @@ drill lives in test_chaos.py (marked chaos/slow).
 
 import os
 import pickle
+import shutil
+import threading
 import time
 
 import pytest
@@ -134,6 +136,141 @@ class TestStateStore:
 
 
 # ---------------------------------------------------------------------------
+# WAL group commit (control-plane scale)
+# ---------------------------------------------------------------------------
+
+
+class TestGroupCommit:
+    def test_group_commit_batches_fsyncs(self, tmp_path):
+        store = MasterStateStore(str(tmp_path), sync_policy="group")
+        store.snapshot(lambda: {})
+        seqs = []
+
+        def writer(base):
+            for i in range(50):
+                seqs.append(store.append(("rec", base, i)))
+
+        threads = [
+            threading.Thread(target=writer, args=(t,)) for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert store.wait_durable(max(seqs))
+        status = store.wal_status()
+        assert status["appended_records"] == 200
+        assert status["durable_seq"] >= max(seqs)
+        # The whole point: far fewer fsyncs than mutations.
+        assert status["fsync_count"] < status["appended_records"]
+        store.close()
+        _, records = MasterStateStore(str(tmp_path)).recover()
+        assert len(records) == 200
+
+    def test_snapshot_carries_records_appended_during_collect(self, tmp_path):
+        # Journal-after-apply paths (rdzv listener, rescale, durable
+        # events) hold no mutation shard, so they can append while the
+        # snapshot's collect_fn runs. Rotation must carry those records
+        # into the fresh journal — otherwise they'd sit in the rotated-
+        # out journal and be lost on recovery.
+        store = MasterStateStore(str(tmp_path), sync_policy="group")
+        store.snapshot(lambda: {})
+        store.append(("rec", "before"))
+
+        def collect_and_append():
+            # Mimics a concurrent non-sharded journaler: collect_fn runs
+            # outside the store lock, so this append interleaves exactly
+            # where the carry window opens.
+            store.append(("rec", "during-collect"))
+            return {"n": 1}
+
+        store.snapshot(collect_and_append)
+        status = store.wal_status()
+        assert status["durable_offset"] > 0
+        store.close()
+        state, records = MasterStateStore(str(tmp_path)).recover()
+        assert state == {"n": 1}
+        assert [r[1] for r in records] == ["during-collect"]
+
+    def test_sync_policy_always_fsyncs_each_append(self, tmp_path):
+        store = MasterStateStore(str(tmp_path), sync_policy="always")
+        store.snapshot(lambda: {})
+        for i in range(5):
+            seq = store.append(("rec", i))
+            assert store.wait_durable(seq)  # immediate: fsynced inline
+        status = store.wal_status()
+        assert status["fsync_count"] == status["appended_records"] == 5
+        store.close()
+
+    def test_torn_tail_at_group_commit_boundary(self, tmp_path):
+        """SIGKILL between batch append and batch fsync: recovery from
+        a power-cut image truncated at the last durability barrier must
+        replay exactly the durable records, land on a frame boundary
+        (no partial batch visible), and lose nothing wait_durable()
+        acknowledged."""
+        state = tmp_path / "state"
+        store = MasterStateStore(str(state), sync_policy="group")
+        store.snapshot(lambda: {})
+        durable_seq = None
+        for i in range(3):
+            durable_seq = store.append(("durable", i))
+        assert store.wait_durable(durable_seq)
+        status = store.wal_status()
+        offset = status["durable_offset"]
+        assert offset > 0
+        # The un-durable tail: appended (visible in the file) but the
+        # commit thread may not have fsynced it yet. A power cut can
+        # lose any suffix of it; the barrier is the guaranteed floor.
+        for i in range(2):
+            store.append(("tail", i))
+        # Power-cut image: copy the state dir with the journal cut at
+        # the barrier — bytes past durable_offset never hit the platter.
+        image = tmp_path / "image"
+        shutil.copytree(state, image)
+        journal = image / os.path.basename(status["journal_path"])
+        with open(journal, "r+b") as f:
+            f.truncate(offset)
+
+        recovered = MasterStateStore(str(image))
+        _, records = recovered.recover()
+        assert records == [("durable", i) for i in range(3)]
+        # The barrier sits exactly on a frame boundary: the truncated
+        # image has no torn frame to skip.
+        assert recovered.last_recovery_stats["torn_tails"] == 0
+        store.close()
+
+    def test_snapshot_resets_durability_barrier(self, tmp_path):
+        store = MasterStateStore(str(tmp_path), sync_policy="group")
+        store.snapshot(lambda: {})
+        seq = store.append(("rec",))
+        assert store.wait_durable(seq)
+        store.snapshot(lambda: {"rotated": True})
+        status = store.wal_status()
+        # Rotation cut a fresh journal: the barrier covers everything
+        # (commit == durable) and the offset points into the NEW file.
+        assert status["durable_seq"] == status["commit_seq"]
+        assert status["journal_path"].endswith("journal-2.wal")
+        assert status["durable_offset"] == os.path.getsize(
+            status["journal_path"]
+        )
+        store.close()
+
+    def test_close_fsyncs_group_tail(self, tmp_path):
+        store = MasterStateStore(str(tmp_path), sync_policy="group")
+        store.snapshot(lambda: {})
+        for i in range(10):
+            store.append(("rec", i))
+        store.close()  # must flush the un-fsynced tail
+        _, records = MasterStateStore(str(tmp_path)).recover()
+        assert records == [("rec", i) for i in range(10)]
+
+    def test_unknown_policy_falls_back_to_group(self, tmp_path):
+        store = MasterStateStore(str(tmp_path), sync_policy="bogus")
+        assert store.sync_policy == "group"
+        store.close()
+
+
+# ---------------------------------------------------------------------------
 # JobMaster recovery (in-process crash simulation)
 # ---------------------------------------------------------------------------
 
@@ -218,6 +355,53 @@ class TestMasterRecovery:
             # cache: a wire retry is answered from cache, not re-applied.
             duplicate, _ = m2._server._dedup.begin("retry-req-id")
             assert duplicate
+        finally:
+            m2.stop()
+
+    def test_evicted_dedup_id_journal_seed_still_wins(
+        self, state_dir, monkeypatch
+    ):
+        """Regression for env-sized dedup caches: a request id evicted
+        from the LIVE cache by maxsize pressure must still be answered
+        from cache after a master restart — the journal, not the
+        bounded in-memory cache, is the durable exactly-once record."""
+        monkeypatch.setenv("DLROVER_TPU_RPC_DEDUP_SIZE", "2")
+        m1 = JobMaster(port=0, node_num=1, job_name="evict",
+                       state_dir=state_dir)
+        m1.prepare()
+        try:
+            assert m1._server._dedup._maxsize == 2
+            client = MasterClient(m1.addr, node_id=0)
+            client.kv_store_add("ctr", 7)  # journaled under some req id
+            # Flood the tiny cache so that id is evicted live.
+            for i in range(6):
+                client.kv_store_set(f"k{i}", b"v")
+            rpc_ids = [
+                rec[1] for _, rec in read_journal_records(state_dir)
+                if rec[0] == "rpc"
+            ]
+            assert len(rpc_ids) == 7
+            evicted = sum(
+                1 for rid in rpc_ids if not m1._server._dedup.begin(rid)[0]
+            )
+            assert evicted >= 5  # maxsize=2 kept at most the newest two
+        finally:
+            crash_master(m1)
+
+        # The relaunched master runs the production cache size: every
+        # retryable-age id fits (TTL bounds the retry window, and
+        # maxsize is sized above the in-window request population).
+        monkeypatch.delenv("DLROVER_TPU_RPC_DEDUP_SIZE")
+        m2 = JobMaster(port=0, node_num=1, job_name="evict",
+                       state_dir=state_dir)
+        try:
+            # Replay seeded EVERY journaled request id, including the
+            # live-evicted ones: a wire retry of any of them is answered
+            # from cache, never re-applied on top of the replayed state.
+            for rid in rpc_ids:
+                duplicate, _ = m2._server._dedup.begin(rid)
+                assert duplicate, f"journal-seeded id {rid} was lost"
+            assert m2.kv_store.get("ctr") == b"7"
         finally:
             m2.stop()
 

@@ -35,7 +35,8 @@ REGRESSION_PCT = 5.0
 _INTERESTING = re.compile(
     r"(tokens_per_s|goodput_.*_pct|mbps|speedup|mfu_pct|step_time_ms"
     r"|_save_s|restore_ms|overhead|wall_.*_s|blocking_save"
-    r"|_gb$|_bytes|_cut_x|rescale|detect_latency|attribution)", re.I,
+    r"|_gb$|_bytes|_cut_x|rescale|detect_latency|attribution"
+    r"|agents_sustained|beats_per_s|fsyncs_per_mutation|rpc_p99)", re.I,
 )
 
 #: Lower-is-better keys: latencies, wall clocks, overheads — and memory
@@ -47,9 +48,13 @@ _INTERESTING = re.compile(
 #: higher-is-better — the lookahead exempts them from the ``_bytes``
 #: match). Straggler ``detect_latency*`` (steps until the detector
 #: flags) also wants to shrink; ``attribution_correct_pct`` does not.
+#: Master-scale: ``fsyncs_per_mutation`` wants to shrink (group commit
+#: batches appends); ``rpc_p99_ms`` already matches ``_ms$`` and
+#: ``beats_per_s``/``agents_sustained`` stay higher-is-better (the
+#: ``(?<!per)`` lookbehind exempts ``_per_s`` rates).
 _LOWER_BETTER = re.compile(
     r"(_ms$|(?<!per)_s$|_s_per_gb$|wall|overhead|step_time|compile"
-    r"|_gb$|_bytes(?!_per_s|_cut)|detect_latency)",
+    r"|_gb$|_bytes(?!_per_s|_cut)|detect_latency|fsyncs_per_mutation)",
     re.I,
 )
 

@@ -1,0 +1,301 @@
+"""Synthetic fleet harness: hammer a REAL master with thousands of agents.
+
+Control-plane scale testing without 10k hosts: one in-process
+:class:`JobMaster` (real ``RpcServer``, real ``MasterServicer``, real
+``MasterStateStore`` WAL) takes traffic from N connection threads, each
+multiplexing a slice of M simulated agents over its own ``RpcClient``
+— the same persistent-connection transport real agents use, so framing,
+dedup, incarnation stamping and the servicer's lane split are all
+exercised, not mocked.
+
+Traffic mix per simulated agent "tick" (mirrors a live agent's steady
+state): one coalesced :class:`AgentBeat` (heartbeat + step + probe
+sample) always; a journaled kv-store set/get pair every ``kv_every``
+ticks; an :class:`EventReport` batch (telemetry + lifecycle kinds)
+every ``events_every`` ticks; a shard ``TaskRequest``/``TaskReport``
+round-trip every ``task_every`` ticks. The journaled fraction is what
+makes the WAL arms comparable: ``fsyncs_per_mutation`` comes straight
+from ``MasterStateStore.wal_status()``.
+
+Used by ``bench.py section_master_scale`` (the 10k-agent acceptance
+run, group-commit vs per-mutation-fsync arms) and by the tier-1 smoke
+test at ~100 agents. Run standalone::
+
+    python -m tools.fleet_sim --agents 1000 --duration 5
+"""
+
+import argparse
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.rpc import RpcClient
+from dlrover_tpu.observability.events import JobEvent
+
+
+def _raise_nofile(target: int = 65536):
+    """Best-effort RLIMIT_NOFILE bump: every connection thread holds a
+    socket and the master holds the peer end, plus the WAL/snapshot
+    files — the default 1024 soft limit trips first on big fleets."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < target:
+            resource.setrlimit(
+                resource.RLIMIT_NOFILE, (min(target, hard), hard)
+            )
+    except (ImportError, ValueError, OSError):
+        pass
+
+
+def _percentile(samples: List[float], p: float) -> float:
+    if not samples:
+        return 0.0
+    samples = sorted(samples)
+    idx = min(len(samples) - 1, int(p / 100.0 * len(samples)))
+    return samples[idx]
+
+
+class _AgentSlice(threading.Thread):
+    """One connection thread driving a slice of simulated agents.
+
+    Real deployments give every agent its own connection; at harness
+    scale the bottleneck under test is the MASTER (its selector loop,
+    worker lanes, locks and WAL), so multiplexing agents over a few
+    hundred client threads keeps the load generator cheap while the
+    master still sees the full agent population (distinct node_ids,
+    full heartbeat registry, full dedup traffic).
+    """
+
+    def __init__(self, addr: str, agent_ids: List[int], deadline: float,
+                 kv_every: int, events_every: int, task_every: int,
+                 dataset: str, event_batch: int):
+        super().__init__(daemon=True, name=f"fleet-{agent_ids[0]}")
+        self._client = RpcClient(addr, timeout=30.0, retry_deadline=10.0)
+        self._ids = agent_ids
+        self._deadline = deadline
+        self._kv_every = kv_every
+        self._events_every = events_every
+        self._task_every = task_every
+        self._dataset = dataset
+        self._event_batch = event_batch
+        self.latencies: List[float] = []
+        self.beats = 0
+        self.errors = 0
+        self.beaten: Dict[int, int] = {}
+
+    def _call(self, req) -> bool:
+        t0 = time.perf_counter()
+        try:
+            self._client.call(req)
+        except Exception:
+            self.errors += 1
+            return False
+        self.latencies.append(time.perf_counter() - t0)
+        return True
+
+    def run(self):
+        tick = 0
+        probe = {"h2d_mbps": 900.0, "d2h_mbps": 850.0, "rtt_ms": 1.2}
+        while time.monotonic() < self._deadline:
+            tick += 1
+            for aid in self._ids:
+                if time.monotonic() >= self._deadline:
+                    break
+                now = time.time()
+                # Phase every agent's extra work by its id: real fleets
+                # don't fire 10k kv writes on the same clock edge, and
+                # aligned bursts would measure the harness's own queueing,
+                # not the master's steady-state latency.
+                if self._call(m.AgentBeat(
+                    node_id=aid, node_type="worker", timestamp=now,
+                    step=tick, step_ts=now,
+                    probe=probe if (tick + aid) % 3 == 0 else {},
+                )):
+                    self.beats += 1
+                    self.beaten[aid] = self.beaten.get(aid, 0) + 1
+                if self._kv_every and (tick + aid) % self._kv_every == 0:
+                    self._call(m.KVStoreSet(
+                        node_id=aid, key=f"fleet/{aid}",
+                        value=str(tick).encode(),
+                    ))
+                    self._call(m.KVStoreGet(node_id=aid, key=f"fleet/{aid}"))
+                if self._events_every and (tick + aid) % self._events_every == 0:
+                    events = [
+                        JobEvent(
+                            kind="metric.cpu_percent", ts=now, node_id=aid,
+                            role="agent", pid=0, args={"value": 42.0},
+                        )
+                        for _ in range(self._event_batch - 1)
+                    ]
+                    events.append(JobEvent(
+                        kind="node.heartbeat_tick", ts=now, node_id=aid,
+                        role="agent", pid=0, args={"tick": tick},
+                    ))
+                    self._call(m.EventReport(node_id=aid, events=events))
+                if self._task_every and (tick + aid) % self._task_every == 0:
+                    t0 = time.perf_counter()
+                    try:
+                        task = self._client.call(m.TaskRequest(
+                            node_id=aid, dataset_name=self._dataset,
+                        ))
+                    except Exception:
+                        self.errors += 1
+                        continue
+                    self.latencies.append(time.perf_counter() - t0)
+                    if task is not None and task.exists:
+                        self._call(m.TaskReport(
+                            node_id=aid, dataset_name=self._dataset,
+                            task_id=task.task_id, success=True,
+                        ))
+        self._client.close()
+
+
+def run_fleet(agents: int = 1000, duration_s: float = 5.0,
+              conns: int = 32, wal_sync: Optional[str] = None,
+              state_dir: str = "", kv_every: int = 4,
+              events_every: int = 8, task_every: int = 0,
+              event_batch: int = 8,
+              group_window_s: Optional[float] = None,
+              control_workers: Optional[int] = None) -> Dict:
+    """Run the fleet against a fresh in-process master; return metrics.
+
+    ``wal_sync`` pins ``DLROVER_TPU_WAL_SYNC`` for the master's store
+    ("group" vs "always" — the two bench arms); ``group_window_s``
+    likewise pins the accumulation window. ``control_workers`` sizes
+    the control-lane pool: a journaled RPC parks its worker in the
+    group-commit durability wait (~the accumulation window), so the
+    lane needs roughly ``conns`` workers for the waits to overlap
+    instead of queueing — waiting workers sleep on a condvar and cost
+    no GIL. All overrides are restored on exit; they must span
+    ``prepare()`` too, because the RpcServer reads its pool sizes when
+    it starts there.
+    """
+    _raise_nofile()
+    from dlrover_tpu.master.master import JobMaster
+
+    conns = max(1, min(conns, agents))
+    tmp = ""
+    if not state_dir:
+        tmp = state_dir = tempfile.mkdtemp(prefix="fleet_sim_")
+    overrides = {}
+    if wal_sync is not None:
+        overrides[env_utils.WAL_SYNC.name] = wal_sync
+    if group_window_s is not None:
+        overrides[env_utils.WAL_GROUP_WINDOW_S.name] = repr(group_window_s)
+    if control_workers is not None:
+        overrides[env_utils.RPC_CONTROL_WORKERS.name] = str(control_workers)
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        master = JobMaster(
+            port=0, node_num=agents, job_name="fleet-sim",
+            state_dir=state_dir,
+        )
+        master.prepare()  # starts the RpcServer + node-monitor loop
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    addr = master.addr
+    dataset = "fleet-shards"
+    try:
+        admin = RpcClient(addr, timeout=30.0, retry_deadline=10.0)
+        if task_every:
+            admin.call(m.DatasetShardParams(
+                node_id=0, dataset_name=dataset,
+                dataset_size=10_000_000, shard_size=1000, num_epochs=1,
+            ))
+        deadline = time.monotonic() + duration_s
+        ids = list(range(agents))
+        slices = [
+            _AgentSlice(
+                addr, ids[i::conns], deadline, kv_every, events_every,
+                task_every, dataset, event_batch,
+            )
+            for i in range(conns)
+        ]
+        t0 = time.monotonic()
+        for s in slices:
+            s.start()
+        for s in slices:
+            s.join(timeout=duration_s + 60.0)
+        elapsed = time.monotonic() - t0
+
+        latencies = [x for s in slices for x in s.latencies]
+        beats = sum(s.beats for s in slices)
+        errors = sum(s.errors for s in slices)
+        beaten: Dict[int, int] = {}
+        for s in slices:
+            for aid, n in s.beaten.items():
+                beaten[aid] = beaten.get(aid, 0) + n
+        # "Sustained" = the agent completed at least two beat intervals
+        # during the window — it registered AND kept reporting.
+        sustained = sum(1 for n in beaten.values() if n >= 2)
+        wal = master.state_store.wal_status()
+        mutations = max(1, wal["appended_records"])
+        plane = master.observability
+        out = {
+            "agents": agents,
+            "agents_sustained": sustained,
+            "conns": conns,
+            "duration_s": round(elapsed, 2),
+            "rpcs": len(latencies),
+            "rpc_errors": errors,
+            "beats_per_s": round(beats / max(elapsed, 1e-9), 1),
+            "rpc_p50_ms": round(_percentile(latencies, 50) * 1e3, 3),
+            "rpc_p99_ms": round(_percentile(latencies, 99) * 1e3, 3),
+            "rpc_max_ms": round(max(latencies) * 1e3, 3) if latencies else 0.0,
+            "rpc_over_1s": sum(1 for x in latencies if x > 1.0),
+            "server_rpc_p99_ms": round(
+                max(
+                    [
+                        plane.rpc_hist.percentile(labels["type"], 99.0)
+                        for labels, _ in plane.rpc_hist.samples()
+                    ] or [0.0],
+                ) * 1e3, 3,
+            ),
+            "wal_policy": wal["policy"],
+            "wal_mutations": wal["appended_records"],
+            "wal_fsyncs": wal["fsync_count"],
+            "fsyncs_per_mutation": round(wal["fsync_count"] / mutations, 4),
+            "events_shed": plane.shed_events,
+        }
+        return out
+    finally:
+        master.stop()
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--agents", type=int, default=1000)
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--conns", type=int, default=32)
+    ap.add_argument("--wal-sync", default=None,
+                    choices=(None, "group", "always", "none"))
+    ap.add_argument("--kv-every", type=int, default=4)
+    ap.add_argument("--events-every", type=int, default=8)
+    ap.add_argument("--task-every", type=int, default=0)
+    args = ap.parse_args(argv)
+    out = run_fleet(
+        agents=args.agents, duration_s=args.duration, conns=args.conns,
+        wal_sync=args.wal_sync, kv_every=args.kv_every,
+        events_every=args.events_every, task_every=args.task_every,
+    )
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
