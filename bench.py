@@ -1164,6 +1164,41 @@ def section_straggler():
     return out
 
 
+def section_dtlint():
+    """Static-analysis wall time, cold vs cached: ``tools.dtlint`` over
+    the whole package with ``--no-cache`` (every file parsed, all 12
+    rules) vs a warm ``.dtlint_cache/`` (stat-check per file, only the
+    whole-program passes re-run). Host-side only; the exit status also
+    re-asserts the tier-1 "package lints clean" gate from a cold
+    process."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def run(*extra):
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.dtlint", *extra],
+            cwd=repo, capture_output=True, text=True, timeout=300,
+        )
+        return time.perf_counter() - t0, r.returncode
+
+    cold_s, cold_rc = run("--no-cache")
+    prime_s, _ = run()          # populates .dtlint_cache/
+    cached_s, cached_rc = run()  # served from it
+    out = {
+        "cold_s": round(cold_s, 2),
+        "cached_s": round(cached_s, 2),
+        "cache_prime_s": round(prime_s, 2),
+        "cache_speedup_x": round(cold_s / max(cached_s, 1e-6), 1),
+        "clean": cold_rc == 0 and cached_rc == 0,
+    }
+    log(f"bench[dtlint]: cold {out['cold_s']}s -> cached "
+        f"{out['cached_s']}s ({out['cache_speedup_x']}x), "
+        f"clean={out['clean']}")
+    return out
+
+
 def section_master_scale():
     """Control-plane scale drill: a REAL master (selector RpcServer +
     sharded servicer locks + group-commit WAL) under a 10k-agent
@@ -1498,10 +1533,10 @@ def main():
     # budget guard sheds the tail sections, not the headline.
     default_sections = (
         "small,large,llama,longctx,goodput,ckpt_io,ckpt_dedup,"
-        "opt_shard,rescale,straggler,master_scale,medium"
+        "opt_shard,rescale,straggler,master_scale,medium,dtlint"
         if on_tpu else
         "small,goodput,ckpt_io,ckpt_dedup,opt_shard,rescale,straggler,"
-        "master_scale"
+        "master_scale,dtlint"
     )
     sections = os.getenv(
         "DLROVER_TPU_BENCH_SECTIONS", default_sections
@@ -1547,6 +1582,8 @@ def main():
                 extra["straggler"] = section_straggler()
             elif name == "master_scale":
                 extra["master_scale"] = section_master_scale()
+            elif name == "dtlint":
+                extra["dtlint"] = section_dtlint()
         except Exception as e:
             import traceback
 

@@ -415,6 +415,11 @@ LOCKDEP = ENV.bool(
     "Arm the runtime lock-order detector: instrumented locks record the "
     "acquisition graph and fail fast on a cycle. Debug-only; plain "
     "threading locks (zero overhead) when unset.")
+LOCKDEP_EXPORT = ENV.path(
+    "DLROVER_TPU_LOCKDEP_EXPORT", "",
+    "Write the recorded lock-order graph as JSON here at master stop "
+    "(lockdep.export_graph). dtlint DT010 merges the artifact with its "
+    "static graph so drill-observed orders join the cycle check.")
 MOCK_ERR_RANK = ENV.int(
     "DLROVER_TPU_MOCK_ERR_RANK", -1,
     "Test knob: node rank that fails its device check.")

@@ -36,6 +36,7 @@ The graph is process-global and append-only; tests snapshot it with
 :func:`lock_graph` and reset with :func:`reset`.
 """
 
+import json
 import os
 import threading
 import traceback
@@ -202,6 +203,28 @@ def instrumented_lock(name: str, rlock: bool = False):
 def lock_graph() -> Dict[str, Tuple[str, ...]]:
     """Snapshot of the recorded acquisition-order edges."""
     return _GRAPH.edges()
+
+
+def export_graph(path: Optional[str] = None) -> Dict[str, object]:
+    """The recorded acquisition-order graph as a JSON-able artifact.
+
+    Written by the chaos drills (and by ``JobMaster.stop`` when
+    ``DLROVER_TPU_LOCKDEP_EXPORT`` is set) so the statically-extracted
+    lock graph in ``tools/dtlint`` can be merged with orders a real run
+    actually exercised — a drill-observed edge joins the DT010 cycle
+    check even when no lexical nesting reveals it.
+    """
+    data: Dict[str, object] = {
+        "version": 1,
+        "armed": lockdep_armed(),
+        "edges": {a: list(bs) for a, bs in _GRAPH.edges().items()},
+    }
+    if path:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    return data
 
 
 def assert_acyclic() -> None:

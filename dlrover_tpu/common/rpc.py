@@ -90,6 +90,10 @@ class _DedupCache:
     instead of re-executing the handler concurrently.
     """
 
+    #: dtlint DT009: exactly-once hinges on these two maps moving
+    #: atomically (claim, wait, publish) — every access is locked.
+    GUARDED_BY = {"_entries": "rpc.dedup", "_pending": "rpc.dedup"}
+
     def __init__(self, maxsize: Optional[int] = None,
                  ttl: Optional[float] = None):
         # req_id -> (timestamp, response) once done; response is None and a
@@ -175,6 +179,19 @@ class RpcServer:
     re-applied (the wire retry in :class:`RpcClient` is therefore safe for
     mutating messages such as KVStoreAdd/JoinRendezvous/TaskReport).
     """
+
+    #: dtlint DT009. Only the lane-backlog counters are cross-thread
+    #: read-modify-write state (loop increments, workers decrement,
+    #: backlog() reads). ``_conns`` is owned by the event-loop thread
+    #: (stop()'s drain poll does a deliberately racy read, see comment
+    #: there); ``_outbox`` relies on deque's atomic append/popleft for
+    #: the worker->loop handoff; ``_pools`` is wired once in __init__.
+    GUARDED_BY = {
+        "_lane_backlog": "rpc.server_stats",
+        "_conns": None,
+        "_outbox": None,
+        "_pools": None,
+    }
 
     def __init__(self, port: int, handler: Callable[[Any], Any],
                  host: str = "0.0.0.0",

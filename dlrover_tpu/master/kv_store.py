@@ -12,6 +12,11 @@ from typing import Dict, Optional, Tuple
 
 
 class KVStoreService:
+    #: dtlint DT009: every access to the declared attrs must hold the
+    #: named lock (see docs/static_analysis.md, "Annotating guarded
+    #: state").
+    GUARDED_BY = {"_store": "master.kv_store"}
+
     def __init__(self):
         self._store: Dict[str, bytes] = {}
         self._lock = instrumented_lock("master.kv_store")

@@ -11,7 +11,7 @@ import threading
 import time
 from typing import Optional
 
-from dlrover_tpu.common import env_utils
+from dlrover_tpu.common import env_utils, lockdep
 from dlrover_tpu.common.constants import JobStage, RendezvousName
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
@@ -403,7 +403,7 @@ class JobMaster:
         logger.error("evicting node %s: %s", node_id, reason)
         # During journal replay the sink drops this (the live eviction's
         # own ("event", ...) record replays it instead).
-        emit(
+        emit(  # dtlint: disable=DT012 -- replay-guarded at the sink: _event_sink drops emits while store.replaying; the journaled ("event", ...) record replays the live emission
             EventKind.NODE_EVICT, _node_id=node_id, _role="master",
             reason=reason,
         )
@@ -469,6 +469,14 @@ class JobMaster:
         if self.auto_scaler is not None:
             self.auto_scaler.stop()
         self._server.stop()
+        export_path = env_utils.LOCKDEP_EXPORT.get()
+        if export_path:
+            # Everything this run's drills exercised, for dtlint's
+            # static+runtime merged lock-order check (DT010).
+            try:
+                lockdep.export_graph(export_path)
+            except OSError:
+                logger.exception("lockdep graph export failed")
         uninstall_sink(self._event_sink_fn)
         self.observability.stop()
         if self.state_store is not None:

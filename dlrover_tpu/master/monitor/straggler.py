@@ -140,6 +140,13 @@ class _WorkerProfile:
 class StragglerDetector:
     """Fold phase vectors + probe samples into attributed verdicts."""
 
+    #: dtlint DT009 — the PR-11 bug class this rule was built for: a
+    #: lock-free metrics()/stragglers() fast path over the profile maps.
+    GUARDED_BY = {
+        "_profiles": "master.straggler",
+        "_ticked_at": "master.straggler",
+    }
+
     def __init__(
         self,
         speed_monitor=None,
@@ -201,7 +208,7 @@ class StragglerDetector:
                 prof.add(key, value)
             prof.samples_seen += 1
 
-    def _profile(self, worker_id: int) -> _WorkerProfile:
+    def _profile(self, worker_id: int) -> _WorkerProfile:  # dtlint: holds(master.straggler)
         prof = self._profiles.get(worker_id)
         if prof is None:
             prof = self._profiles[worker_id] = _WorkerProfile(self._window)
@@ -216,7 +223,7 @@ class StragglerDetector:
     #: Per-tick baseline cache: key -> (sorted recent means, mean by wid).
     _BaselineCache = Dict[str, Tuple[List[float], Dict[int, float]]]
 
-    def _baseline_cache(self) -> "_BaselineCache":
+    def _baseline_cache(self) -> "_BaselineCache":  # dtlint: holds(master.straggler)
         """One pass over all profiles per tick. The old per-worker peer
         scan made a tick O(workers^2 x keys) — at 10k workers that held
         the detector lock for minutes, freezing the bulk RPC lane (every
@@ -234,7 +241,7 @@ class StragglerDetector:
             for key, by_wid in per_key.items()
         }
 
-    def _baseline(self, wid: int, key: str,
+    def _baseline(self, wid: int, key: str,  # dtlint: holds(master.straggler)
                   cache: "_BaselineCache") -> Optional[float]:
         """Peer median of recent means when >=2 peers report the key,
         else the worker's own rolling median. Lock held."""
@@ -248,7 +255,7 @@ class StragglerDetector:
             return _median_sorted(sorted_vals)
         return _median_excluding(sorted_vals, own)
 
-    def _outlier_keys(self, wid: int, prof: _WorkerProfile,
+    def _outlier_keys(self, wid: int, prof: _WorkerProfile,  # dtlint: holds(master.straggler)
                       cache: "_BaselineCache") -> Dict[str, str]:
         """key -> evidence string for every metric currently out of
         bounds vs its (frozen or live) baseline. Lock held."""

@@ -28,6 +28,43 @@ from dlrover_tpu.common.lockdep import instrumented_lock
 #: snapshot quiesce) always acquire in this sequence.
 SHARDS: Tuple[str, ...] = ("kv", "tasks", "nodes", "rdzv", "events")
 
+#: Declared lock hierarchy, coarse to fine. Tier 0 is the mutation
+#: shards in canonical order (ordered *within* the tier: kv before
+#: tasks before ...); later tiers are unordered internally but strictly
+#: finer than every earlier tier — a tier-N lock must never be held
+#: while acquiring a tier-(N-1) lock. dtlint DT010 parses this tuple
+#: and turns it into declared graph edges, so an inversion observed
+#: statically or in a lockdep export closes a cycle deterministically.
+#: ``rdzv.*`` matches every per-rendezvous lock (one order class, as in
+#: kernel lockdep). ``master.state_store`` sits below everything that
+#: journals; ``master.state_store.commit`` is the innermost leaf (the
+#: group-commit cv), which is why ``wait_durable`` must be called with
+#: no coarser lock held at all.
+LOCK_ORDER: Tuple[Tuple[str, ...], ...] = (
+    # == tuple(f"master.mutation.{s}" for s in SHARDS); spelled out as
+    # literals because dtlint reads this tuple from the AST.
+    (
+        "master.mutation.kv",
+        "master.mutation.tasks",
+        "master.mutation.nodes",
+        "master.mutation.rdzv",
+        "master.mutation.events",
+    ),
+    (
+        "master.task_manager",
+        "master.node_manager",
+        "master.kv_store",
+        "master.rescale",
+        "master.sync_service",
+        "master.straggler",
+        "master.job_collector",
+        "rdzv.*",
+        "observability.event_log",
+    ),
+    ("master.state_store",),
+    ("master.state_store.commit",),
+)
+
 #: Message class -> the shards its handler mutates. A journaled message
 #: missing here falls back to every shard (correct, just slower) so a
 #: future message class cannot silently under-lock.

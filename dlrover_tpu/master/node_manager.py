@@ -61,6 +61,15 @@ class NodeEvent:
 
 
 class JobManager:
+    #: dtlint DT009. ``_event_callbacks`` is append-only at wiring time
+    #: and iterated lock-free on purpose: callbacks dispatch node events
+    #: into subsystems that take their own locks and must never run
+    #: inside ours.
+    GUARDED_BY = {
+        "_nodes": "master.node_manager",
+        "_event_callbacks": None,
+    }
+
     """Tracks job nodes and reacts to their lifecycle events."""
 
     def __init__(
@@ -227,11 +236,12 @@ class JobManager:
         that does come back re-registers via its next status report.)"""
         with self._lock:
             node = self._nodes.pop(node_id, None)
+            remaining = len(self._nodes)
         if node is None:
             return False
         logger.warning(
             "removed node %s from the job (%s); %s nodes remain",
-            node_id, reason or "permanent loss", len(self._nodes),
+            node_id, reason or "permanent loss", remaining,
         )
         return True
 

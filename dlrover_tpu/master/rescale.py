@@ -42,6 +42,16 @@ def plan_survivors(plan: m.RescalePlan) -> List[int]:
 
 
 class RescaleCoordinator:
+    #: dtlint DT009: plan lifecycle state — issued plans, their ack
+    #: matrices, settle deadlines and the capability roster all move
+    #: together under the coordinator lock.
+    GUARDED_BY = {
+        "_plans": "master.rescale",
+        "_acks": "master.rescale",
+        "_deadlines": "master.rescale",
+        "_capable": "master.rescale",
+    }
+
     """Decides, journals and tracks in-place scale transitions.
 
     Wiring: the master calls :meth:`on_node_removed` from its eviction
@@ -144,7 +154,7 @@ class RescaleCoordinator:
         }
         if not survivors:
             return None
-        quorum = env_utils.RESCALE_MIN_QUORUM.get()
+        quorum = env_utils.RESCALE_MIN_QUORUM.get()  # dtlint: disable=DT011 -- operator policy deliberately read live; the authoritative plan/abort state replays from ("rescale", ...) records, which overwrite any transient re-derivation
         if len(survivors) / len(old_world) < quorum:
             logger.info(
                 "rescale: %d/%d survivors below quorum %.2f; falling "
@@ -263,7 +273,7 @@ class RescaleCoordinator:
             self._plans[plan.plan_id] = plan
             self._acks[plan.plan_id] = {}
             self._deadlines[plan.plan_id] = (
-                time.monotonic() + env_utils.RESCALE_APPLY_TIMEOUT_S.get()
+                time.monotonic() + env_utils.RESCALE_APPLY_TIMEOUT_S.get()  # dtlint: disable=DT011 -- apply deadlines are process-local liveness timers, deliberately re-armed from the live clock and knob on every run
             )
         for old in superseded:
             self._journal({
@@ -274,7 +284,7 @@ class RescaleCoordinator:
                 "rescale plan %s superseded by plan %s before settling",
                 old.plan_id, plan.plan_id,
             )
-            emit(
+            emit(  # dtlint: disable=DT012 -- replay-guarded at the sink: JobMaster._event_sink drops emits while store.replaying
                 EventKind.RESCALE_ABORT, _role="master",
                 plan_id=old.plan_id, reason="superseded",
             )
@@ -285,7 +295,7 @@ class RescaleCoordinator:
             sorted(old_world), sorted(new_world), plan.old_round,
             plan.new_round, plan.accum_counts, plan.snapshot_step,
         )
-        emit(
+        emit(  # dtlint: disable=DT012 -- replay-guarded at the sink: JobMaster._event_sink drops emits while store.replaying
             EventKind.RESCALE_PLAN, _role="master",
             plan_id=plan.plan_id, transition=transition,
             old_world=sorted(old_world), new_world=sorted(new_world),
@@ -359,7 +369,7 @@ class RescaleCoordinator:
                 "round %s for full restart", plan_id, node_rank, error,
                 new_round,
             )
-            emit(
+            emit(  # dtlint: disable=DT012 -- replay-guarded at the sink: JobMaster._event_sink drops emits while store.replaying
                 EventKind.RESCALE_ABORT, _node_id=node_rank,
                 _role="master", plan_id=plan_id, reason=error or "nack",
             )
@@ -367,7 +377,7 @@ class RescaleCoordinator:
         elif completed:
             logger.info("rescale plan %s complete: every survivor "
                         "transitioned in place", plan_id)
-            emit(
+            emit(  # dtlint: disable=DT012 -- replay-guarded at the sink: JobMaster._event_sink drops emits while store.replaying
                 EventKind.RESCALE_COMPLETE, _role="master",
                 plan_id=plan_id, new_round=new_round,
             )
@@ -492,8 +502,8 @@ class RescaleCoordinator:
                 )
                 if plan.status == PLAN_ISSUED:
                     self._deadlines[plan.plan_id] = (
-                        time.monotonic()
-                        + env_utils.RESCALE_APPLY_TIMEOUT_S.get()
+                        time.monotonic()  # dtlint: disable=DT011 -- a replayed in-flight plan intentionally gets a fresh apply window; the deadline is a process-local timer, not journaled state
+                        + env_utils.RESCALE_APPLY_TIMEOUT_S.get()  # dtlint: disable=DT011 -- same fresh apply window: the knob is a liveness timer input, not journaled state
                     )
         elif rec == "capable":
             with self._lock:

@@ -35,6 +35,15 @@ class DoingTask:
 class DatasetManager:
     """Todo/doing queues for one dataset."""
 
+    #: dtlint DT009: a DatasetManager has no lock of its own — every
+    #: method runs inside the owning TaskManager's critical section
+    #: (hence the holds() marker on each def). The queues are exactly
+    #: the shard state the PR-11 store->task_manager inversion raced on.
+    GUARDED_BY = {
+        "todo": "master.task_manager",
+        "doing": "master.task_manager",
+    }
+
     # A shard held in `doing` longer than this is presumed abandoned (its
     # worker hung or exited without acking) and is returned to `todo` —
     # the liveness fallback behind the clients' block-until-finished
@@ -60,7 +69,7 @@ class DatasetManager:
         # doing entries (lost shards).
         self.journal = None
 
-    def _requeue(self, task: ShardTask):
+    def _requeue(self, task: ShardTask):  # dtlint: holds(master.task_manager)
         """Re-dispatch under a FRESH task id: a late ack from the
         original holder must not pop the new dispatchee's doing entry
         (it finds no matching id and is ignored)."""
@@ -69,7 +78,7 @@ class DatasetManager:
             record_indices=task.record_indices,
         )))
 
-    def _reclaim_stale(self):
+    def _reclaim_stale(self):  # dtlint: holds(master.task_manager)
         now = time.time()
         stale = [
             tid for tid, d in self.doing.items()
@@ -88,7 +97,7 @@ class DatasetManager:
             )
             self._requeue(doing.task)
 
-    def _refill(self):
+    def _refill(self):  # dtlint: holds(master.task_manager)
         self._reclaim_stale()
         if self.todo or self.splitter.epoch_finished():
             return
@@ -129,7 +138,7 @@ class DatasetManager:
             record_indices=d.get("record_indices"),
         )
 
-    def _new_task(self, shard: Shard) -> ShardTask:
+    def _new_task(self, shard: Shard) -> ShardTask:  # dtlint: holds(master.task_manager)
         task = ShardTask(
             task_id=self._task_id,
             dataset_name=self.splitter.dataset_name,
@@ -141,7 +150,7 @@ class DatasetManager:
         self._task_id += 1
         return task
 
-    def get_task(self, worker_id: int) -> ShardTask:
+    def get_task(self, worker_id: int) -> ShardTask:  # dtlint: holds(master.task_manager)
         self._refill()
         if not self.todo:
             # Distinguish "done" from "empty for now": while shards are in
@@ -152,7 +161,7 @@ class DatasetManager:
         self.doing[task.task_id] = DoingTask(task, worker_id, time.time())
         return task
 
-    def report_task(self, task_id: int, success: bool) -> bool:
+    def report_task(self, task_id: int, success: bool) -> bool:  # dtlint: holds(master.task_manager)
         doing = self.doing.pop(task_id, None)
         if doing is None:
             return False
@@ -162,7 +171,7 @@ class DatasetManager:
             self._requeue(doing.task)
         return True
 
-    def recover_worker_tasks(self, worker_id: int) -> int:
+    def recover_worker_tasks(self, worker_id: int) -> int:  # dtlint: holds(master.task_manager)
         """Return a failed worker's in-flight shards to the todo queue."""
         stale = [tid for tid, d in self.doing.items() if d.worker_id == worker_id]
         for tid in stale:
@@ -170,7 +179,7 @@ class DatasetManager:
         return len(stale)
 
     # ------------- journal replay + fencing reclaim -------------
-    def replay_shards(self, state: dict):
+    def replay_shards(self, state: dict):  # dtlint: holds(master.task_manager)
         """Re-apply a journaled split: exact ranges, exact ids."""
         self.splitter.restore(state.get("splitter", {}))
         known = {t.task_id for t in self.todo} | set(self.doing)
@@ -182,7 +191,7 @@ class DatasetManager:
             )
             self._task_id = max(self._task_id, d["task_id"] + 1)
 
-    def replay_dispatch(self, d: dict) -> Optional[ShardTask]:
+    def replay_dispatch(self, d: dict) -> Optional[ShardTask]:  # dtlint: holds(master.task_manager)
         """Re-apply a journaled get_task answer; returns the task so the
         caller can re-seed the RPC dedup cache with it."""
         tid = d["task_id"]
@@ -198,16 +207,16 @@ class DatasetManager:
             self.todo.remove(task)
         else:
             task = self._task_from_dict(d, self.splitter.dataset_name)
-        self.doing[tid] = DoingTask(task, d["worker"], time.time())
+        self.doing[tid] = DoingTask(task, d["worker"], time.time())  # dtlint: disable=DT011 -- dispatch-time liveness clock, deliberately re-stamped on replay: staleness reclaim timers are process-local, not journaled state
         return task
 
-    def replay_reclaim(self, task_ids):
+    def replay_reclaim(self, task_ids):  # dtlint: holds(master.task_manager)
         for tid in task_ids:
             doing = self.doing.pop(tid, None)
             if doing is not None:
                 self._requeue(doing.task)
 
-    def reclaim_task(self, worker_id: int, d: dict) -> bool:
+    def reclaim_task(self, worker_id: int, d: dict) -> bool:  # dtlint: holds(master.task_manager)
         """A fenced client re-reports a shard it still holds. Reaffirm
         the assignment if we know the task; re-install it from the
         carried range if the dispatch was lost with the old incarnation;
@@ -218,7 +227,7 @@ class DatasetManager:
         if doing is not None:
             if doing.worker_id != worker_id:
                 return False  # re-dispatched to someone else
-            doing.start_time = time.time()
+            doing.start_time = time.time()  # dtlint: disable=DT011 -- hold-time liveness clock, deliberately re-stamped: reclaim timers are process-local, not journaled state
             return True
         for queued in list(self.todo):
             if (
@@ -227,12 +236,12 @@ class DatasetManager:
                 and queued.end == d["end"]
             ):
                 self.todo.remove(queued)
-                self.doing[tid] = DoingTask(queued, worker_id, time.time())
+                self.doing[tid] = DoingTask(queued, worker_id, time.time())  # dtlint: disable=DT011 -- dispatch-time liveness clock, deliberately re-stamped: reclaim timers are process-local, not journaled state
                 self._task_id = max(self._task_id, tid + 1)
                 return True
         return False
 
-    def completed(self) -> bool:
+    def completed(self) -> bool:  # dtlint: holds(master.task_manager)
         return (
             self.splitter.epoch_finished()
             and not self.todo
@@ -243,7 +252,7 @@ class DatasetManager:
     def epoch(self) -> int:
         return self.splitter.epoch
 
-    def checkpoint(self) -> dict:
+    def checkpoint(self) -> dict:  # dtlint: holds(master.task_manager)
         # "todo" keeps the legacy merged todo+doing list consumed by the
         # ShardCheckpoint RPC (a *client*-driven restore into a fresh
         # master, where the doing holders are unknown). The exact fields
@@ -289,7 +298,7 @@ class DatasetManager:
             "completed": self._completed_tasks,
         }
 
-    def restore(self, state: dict, exact: bool = False):
+    def restore(self, state: dict, exact: bool = False):  # dtlint: holds(master.task_manager)
         self.splitter.restore(state.get("splitter", {}))
         self.todo.clear()
         self.doing.clear()
@@ -320,6 +329,12 @@ class DatasetManager:
 class TaskManager:
     """All datasets of a job + the worker-failure recovery hook."""
 
+    #: dtlint DT009: dataset registry + per-worker dispatch clocks.
+    GUARDED_BY = {
+        "_datasets": "master.task_manager",
+        "_worker_last_task": "master.task_manager",
+    }
+
     def __init__(self, speed_monitor=None):
         self._lock = instrumented_lock("master.task_manager")
         self._datasets: Dict[str, DatasetManager] = {}
@@ -349,7 +364,7 @@ class TaskManager:
                 storage_type,
             )
 
-    def _create_dataset(self, dataset_name, dataset_size, shard_size,
+    def _create_dataset(self, dataset_name, dataset_size, shard_size,  # dtlint: holds(master.task_manager)
                         num_epochs, shuffle, storage_type):
         """With the lock held."""
         if dataset_name in self._datasets:
@@ -358,7 +373,7 @@ class TaskManager:
             dataset_name, dataset_size, shard_size, num_epochs, shuffle,
             storage_type,
         )
-        timeout = env_utils.SHARD_TIMEOUT.get(
+        timeout = env_utils.SHARD_TIMEOUT.get(  # dtlint: disable=DT011 -- reclaim-timeout knob feeds process-local liveness timers, not journaled state; intentionally re-resolved per run
             default=DatasetManager.DOING_TASK_TIMEOUT
         )
         manager = DatasetManager(splitter, doing_timeout=timeout)

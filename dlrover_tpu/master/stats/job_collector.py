@@ -30,6 +30,16 @@ class ResourceSample:
 class JobMetricCollector:
     """Aggregate per-node resource usage + model info for one job."""
 
+    #: dtlint DT009: every feed mutates under the collector lock; sinks
+    #: are snapshotted under it and invoked outside (see _emit).
+    GUARDED_BY = {
+        "_node_samples": "master.job_collector",
+        "_device_stats": "master.job_collector",
+        "_model_info": "master.job_collector",
+        "_custom": "master.job_collector",
+        "_sinks": "master.job_collector",
+    }
+
     def __init__(self, history: int = 256):
         self._lock = instrumented_lock("master.job_collector")
         self._history = history

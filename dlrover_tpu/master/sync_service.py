@@ -12,6 +12,13 @@ from typing import Dict, Set
 
 
 class SyncService:
+    #: dtlint DT009: barrier/sync membership is read-modify-write state.
+    GUARDED_BY = {
+        "_sync_objs": "master.sync_service",
+        "_finished_syncs": "master.sync_service",
+        "_barriers": "master.sync_service",
+    }
+
     def __init__(self, job_manager=None):
         self._job_manager = job_manager
         self._sync_objs: Dict[str, Set[int]] = {}

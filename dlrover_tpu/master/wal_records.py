@@ -1,0 +1,53 @@
+"""WAL record-tag registry: one row per journal record kind.
+
+The journal's write side and apply side grew up in different files —
+``store.append(("tag", ...))`` calls are scattered across the servicer,
+the task manager, the event log and the rescale coordinator, while the
+single apply dispatcher lives in :meth:`JobMaster._recover_state`. A
+tag present on one side but not the other is exactly the failover bug
+class PR 3 exists to prevent: the record is either written and silently
+skipped on replay (lost mutation) or expected and never written (dead
+replay branch). This registry makes the contract explicit — mirroring
+how ``_HANDLERS``/``_JOURNALED`` declare the RPC contract for DT008 —
+and dtlint DT012 statically cross-checks all three sides: every tag
+appended anywhere in the package, every ``kind == "tag"`` branch of
+``_recover_state``, and every row here must agree.
+
+The handler values are dotted ``Class.method`` names; dtlint resolves
+them in its package-wide function index and uses them (plus the
+``_JOURNALED`` RPC handler methods, for the ``"rpc"`` tag) as the roots
+of the journal-replay purity walk (DT011/DT012): everything reachable
+from an apply handler must be deterministic and replay-idempotent.
+"""
+
+from typing import Dict, Tuple
+
+#: tag -> the apply handler(s) ``JobMaster._recover_state`` dispatches
+#: that record kind to. ``"rpc"`` re-enters the servicer dispatch, so
+#: its effective handlers are the ``_JOURNALED`` RPC handler methods.
+WAL_RECORDS: Dict[str, Tuple[str, ...]] = {
+    # ("rpc", request_id, request, ts) — journaled write-ahead RPCs,
+    # replayed through the full servicer dispatch.
+    "rpc": ("MasterServicer.handle",),
+    # ("dispatch", request_id, payload, ts) — apply-then-log shard
+    # dispatch (TaskRequest): re-marks the recorded shard as doing.
+    "dispatch": ("TaskManager.replay_dispatch",),
+    # ("shards", dataset, state, ts) — a refill's full splitter/todo
+    # state, applied as an overwrite.
+    "shards": ("TaskManager.replay_shards",),
+    # ("reclaim", dataset, task_ids, ts) — stale-task reclaim by id.
+    "reclaim": ("TaskManager.replay_reclaim",),
+    # ("evict", node_id, reason, ts) — master-initiated eviction. The
+    # dispatcher re-enters _evict_node, whose write-ahead branch is
+    # replay-guarded so only _apply_evict re-runs.
+    "evict": ("JobMaster._evict_node",),
+    # ("rdzv", name, state, ts) — absolute rendezvous counters;
+    # restore() max-merges, so duplicates are no-ops.
+    "rdzv": ("RendezvousManager.restore",),
+    # ("event", event, ts) — durable job events (journal=False on
+    # replay so the apply cannot re-journal itself).
+    "event": ("EventLog.append",),
+    # ("rescale", payload, ts) — rescale coordinator journal
+    # (set-union/overwrite semantics, replay-idempotent).
+    "rescale": ("RescaleCoordinator.replay",),
+}

@@ -44,6 +44,16 @@ def _durable(ev: JobEvent) -> bool:
 
 
 class EventLog:
+    #: dtlint DT009. ``_listeners`` is append-only at wiring time and
+    #: iterated lock-free on purpose (listeners must never run under
+    #: the log lock — see append()); ``journal`` is set once at wiring.
+    GUARDED_BY = {
+        "_events": "observability.event_log",
+        "_seq": "observability.event_log",
+        "_listeners": None,
+        "journal": None,
+    }
+
     def __init__(self, capacity: int = 4096):
         self._capacity = capacity
         self._events: List[JobEvent] = []
@@ -65,7 +75,7 @@ class EventLog:
                 del self._events[: len(self._events) - self._capacity]
         if journal and self.journal is not None and _durable(ev):
             try:
-                self.journal(("event", ev, time.time()))
+                self.journal(("event", ev, time.time()))  # dtlint: disable=DT011 -- write-time stamp recorded INTO the ("event", ...) record; replay calls append(journal=False) and never reaches this branch
             except Exception:
                 logger.exception("event journal append failed")
         # Listeners run outside the log lock: the ledger takes its own

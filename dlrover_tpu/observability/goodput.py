@@ -127,6 +127,11 @@ def _union_seconds(intervals: List[tuple]) -> float:
 
 
 class GoodputLedger:
+    #: dtlint DT009: the incident list is folded from the event stream
+    #: under the ledger lock; the _open_*_for helpers document the
+    #: caller-holds contract with holds() markers.
+    GUARDED_BY = {"_incidents": "observability.goodput"}
+
     #: An inter-step gap longer than this is not counted as productive
     #: even without an incident (the fault may simply be undetected yet).
     STEP_GAP_CAP = 120.0
@@ -185,7 +190,7 @@ class GoodputLedger:
             if ev.kind in _DETECT and inc.detect_ts is None:
                 inc.detect_ts = ev.ts
 
-    def _open_incident_for(self, node_id: int) -> Optional[Incident]:
+    def _open_incident_for(self, node_id: int) -> Optional[Incident]:  # dtlint: holds(observability.goodput)
         """Most recent open incident this node's events attach to (with
         the lock held). node_id -1 (master-global) matches anything.
         Persistent (straggler) incidents never absorb fault events —
@@ -197,7 +202,7 @@ class GoodputLedger:
                 return inc
         return None
 
-    def _open_straggler_for(self, node_id: int) -> Optional[Incident]:
+    def _open_straggler_for(self, node_id: int) -> Optional[Incident]:  # dtlint: holds(observability.goodput)
         for inc in reversed(self._incidents):
             if inc.open and inc.persistent and inc.node_id == node_id:
                 return inc

@@ -37,6 +37,18 @@ from dlrover_tpu.observability.events import JobEvent
 
 
 class EventReporter:
+    #: dtlint DT009. shed/dropped are bumped under the lock with the
+    #: buffer mutation they describe; ``sent`` and ``_degraded`` are
+    #: written only by the single flush-loop thread and read lock-free
+    #: as hints, by design.
+    GUARDED_BY = {
+        "_buffer": "observability.reporter",
+        "shed": "observability.reporter",
+        "dropped": "observability.reporter",
+        "sent": None,
+        "_degraded": None,
+    }
+
     _instance: Optional["EventReporter"] = None
     _instance_lock = threading.Lock()
 

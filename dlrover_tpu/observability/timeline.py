@@ -20,10 +20,12 @@ Usage::
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import List, Optional
 
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.observability.events import JobEvent
 from dlrover_tpu.observability.goodput import GoodputLedger
 
@@ -177,6 +179,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.goodput_json:
         sources.append(load_events_from_dump(args.goodput_json))
     events = merge_events(*sources)
+    lockdep_path = env_utils.LOCKDEP_EXPORT.get()
+    if lockdep_path and os.path.exists(lockdep_path):
+        # The master wrote its lock-order graph at stop; point the
+        # operator (and dtlint --lockdep-graph) at it.
+        print(f"lockdep graph artifact: {lockdep_path}", file=sys.stderr)
     if not args.no_text:
         render_text(events)
     if args.chrome_out:

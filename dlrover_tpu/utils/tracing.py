@@ -59,7 +59,7 @@ class Tracer:
         self._emit({
             "name": name, "ph": "i", "s": "p", "pid": self._pid,
             "tid": threading.get_ident() % 1_000_000,
-            "ts": time.time() * 1e6, "args": args,
+            "ts": time.time() * 1e6, "args": args,  # dtlint: disable=DT011 -- Chrome-trace wall stamp for profiling output, never journaled; replay-time traces carry replay-time clocks by design
         })
 
     def counter(self, name: str, **values):

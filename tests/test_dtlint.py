@@ -438,11 +438,12 @@ class TestCli:
         assert main([str(broken)]) == 1
         assert "syntax error" in capsys.readouterr().err
 
-    def test_list_rules_names_all_eight(self, capsys):
+    def test_list_rules_names_all_twelve(self, capsys):
         assert main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rid in ("DT001", "DT002", "DT003", "DT004",
-                    "DT005", "DT006", "DT007", "DT008"):
+                    "DT005", "DT006", "DT007", "DT008",
+                    "DT009", "DT010", "DT011", "DT012"):
             assert rid in out
 
     def test_module_entrypoint(self, tmp_path):

@@ -1,0 +1,487 @@
+"""dtlint v2 drills: guarded-by discipline (DT009), the merged
+static+runtime lock-order graph (DT010), journal-replay purity
+(DT011/DT012), the async-aware walkers, ``--changed``, and the parse
+cache.
+
+The purity rules are exercised against the real package on purpose:
+their findings are computed whole-program, so the fire fixture for
+DT011 is the real ``event_log.py`` with its reasoned suppression
+stripped — the finding is genuine, the suppression is what keeps the
+tier-1 gate clean.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.dtlint.__main__ import changed_files, main
+from tools.dtlint.cache import ResultCache, compute_fingerprint
+from tools.dtlint.core import lint_paths, lint_source
+from tools.dtlint.project import Project
+from tools.dtlint.rules import ALL_RULES, RULES_BY_ID
+from tools.dtlint.rules.dt010_lock_order import project_level_findings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "dlrover_tpu")
+
+PROJECT = Project(REPO)
+
+LOCK_IMPORT = "from dlrover_tpu.common.lockdep import instrumented_lock\n"
+
+
+def run_rule(rule_id, source, path="dlrover_tpu/somewhere/mod.py",
+             project=PROJECT):
+    return lint_source(
+        textwrap.dedent(source), path, [RULES_BY_ID[rule_id]], project
+    )
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+def fixture(body):
+    """A synthetic module: the lockdep import plus a dedented body."""
+    return LOCK_IMPORT + textwrap.dedent(body)
+
+
+def method(body):
+    """A dedented snippet re-indented as a class-body method."""
+    return textwrap.indent(textwrap.dedent(body), "    ")
+
+
+class TestDT009GuardedBy:
+    GOODBAD = fixture("""\
+        class Thing:
+            GUARDED_BY = {"_items": "thing.lock", "_hint": None}
+
+            def __init__(self):
+                self._items = {}
+                self._hint = 0
+                self._lock = instrumented_lock("thing.lock")
+
+            def locked_read(self):
+                with self._lock:
+                    return len(self._items)
+
+            def lockfree_hint(self):
+                return self._hint
+    """)
+
+    def test_quiet_when_held_or_declared_lockfree(self):
+        active, _ = run_rule("DT009", self.GOODBAD)
+        assert active == []
+
+    def test_fires_on_unlocked_access(self):
+        active, _ = run_rule("DT009", self.GOODBAD + method("""\
+            def sneaky(self):
+                return list(self._items)
+        """))
+        assert rule_ids(active) == ["DT009"]
+        assert "guarded_by(thing.lock)" in active[0].message
+        assert "Thing.sneaky" in active[0].message
+
+    def test_holds_marker_preseeds_the_lock(self):
+        active, _ = run_rule("DT009", self.GOODBAD + method("""\
+            def helper(self):  # dtlint: holds(thing.lock)
+                self._items.clear()
+        """))
+        assert active == []
+
+    def test_inline_guarded_by_comment_declares(self):
+        active, _ = run_rule("DT009", fixture("""\
+            class Inline:
+                def __init__(self):
+                    self._lk = instrumented_lock("inline.lock")
+                    self._q = []  # dtlint: guarded_by(inline.lock)
+
+                def bad(self):
+                    self._q.append(1)
+        """))
+        assert rule_ids(active) == ["DT009"]
+        assert "Inline.bad" in active[0].message
+
+    def test_drift_gate_fires_on_undeclared_container(self):
+        active, _ = run_rule("DT009", fixture("""\
+            class Drifty:
+                GUARDED_BY = {"_a": "drift.lock"}
+
+                def __init__(self):
+                    self._a = {}
+                    self._rogue = []
+                    self._lock = instrumented_lock("drift.lock")
+        """))
+        assert rule_ids(active) == ["DT009"]
+        assert "_rogue" in active[0].message
+
+    def test_unknown_lock_name_is_a_finding(self):
+        active, _ = run_rule("DT009", fixture("""\
+            class Typo:
+                GUARDED_BY = {"_a": "no.such.lock"}
+
+                def __init__(self):
+                    self._a = {}
+                    self._lock = instrumented_lock("typo.lock")
+        """))
+        assert any("no.such.lock" in f.message for f in active)
+
+    def test_nested_def_does_not_inherit_the_held_lock(self):
+        active, _ = run_rule("DT009", self.GOODBAD + method("""\
+            def schedule(self):
+                with self._lock:
+                    def callback():
+                        return len(self._items)  # runs after release
+                    return callback
+        """))
+        assert rule_ids(active) == ["DT009"]
+
+    def test_init_is_exempt(self):
+        active, _ = run_rule("DT009", fixture("""\
+            class Pub:
+                GUARDED_BY = {"_a": "pub.lock"}
+
+                def __init__(self):
+                    self._lock = instrumented_lock("pub.lock")
+                    self._a = {}
+                    self._a["seed"] = 1
+        """))
+        assert active == []
+
+    def test_annotation_drift_gate_key_classes_stay_opted_in(self):
+        """The subsystems the lock audit covers must keep their
+        GUARDED_BY maps — deleting one silently un-checks the class."""
+        expected = {
+            "dlrover_tpu/master/state_store.py": "MasterStateStore",
+            "dlrover_tpu/master/rendezvous.py": "RendezvousManager",
+            "dlrover_tpu/master/shard/task_manager.py": "TaskManager",
+            "dlrover_tpu/master/node_manager.py": "JobManager",
+            "dlrover_tpu/master/rescale.py": "RescaleCoordinator",
+            "dlrover_tpu/master/kv_store.py": "KVStoreService",
+            "dlrover_tpu/observability/event_log.py": "EventLog",
+            "dlrover_tpu/observability/reporter.py": "EventReporter",
+            "dlrover_tpu/common/rpc.py": "RpcServer",
+        }
+        for rel, cls_name in expected.items():
+            tree = ast.parse(open(os.path.join(REPO, rel)).read())
+            cls = next(
+                n for n in tree.body
+                if isinstance(n, ast.ClassDef) and n.name == cls_name
+            )
+            has = any(
+                isinstance(s, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "GUARDED_BY"
+                    for t in s.targets
+                )
+                for s in cls.body
+            )
+            assert has, f"{cls_name} ({rel}) lost its GUARDED_BY map"
+
+
+class TestDT010LockOrder:
+    def test_wait_durable_under_lock_fires(self):
+        active, _ = run_rule("DT010", """\
+            class M:
+                def bad(self):
+                    with self._lock:
+                        self._store.wait_durable(seq)
+        """)
+        assert rule_ids(active) == ["DT010"]
+        assert "wait_durable" in active[0].message
+
+    def test_wait_durable_outside_lock_is_quiet(self):
+        active, _ = run_rule("DT010", """\
+            class M:
+                def good(self):
+                    with self._lock:
+                        seq = self._store.append(rec)
+                    self._store.wait_durable(seq)
+        """)
+        assert active == []
+
+    def test_lock_order_tier_zero_is_the_shard_list(self):
+        """LOCK_ORDER's first tier must stay the canonical mutation
+        shards, in shard order — the DT010 graph seeds from it."""
+        tiers, _ = PROJECT.declared_lock_order()
+        assert tuple(tiers[0]) == tuple(PROJECT.canonical_shards())
+
+    def test_package_lock_graph_is_acyclic(self):
+        assert PROJECT.lock_cycles() == []
+
+    def test_pr11_runtime_inversion_closes_a_cycle(self, tmp_path):
+        """Regression for the PR-11 deadlock: a drill that recorded
+        store -> task_manager contradicts the declared
+        task_manager -> state_store order; merging the artifact must
+        turn the pair into a reported cycle."""
+        art = tmp_path / "lockdep.json"
+        art.write_text(json.dumps({
+            "version": 1, "armed": True,
+            "edges": {"master.state_store": ["master.task_manager"]},
+        }))
+        project = Project(REPO, runtime_graph_paths=(str(art),))
+        assert project.lock_cycles() != []
+        cyclic = project.cyclic_edges()
+        assert ("master.state_store", "master.task_manager") in cyclic
+        assert ("master.task_manager", "master.state_store") in cyclic
+        findings = project_level_findings(project)
+        assert any(
+            f.rule == "DT010" and f.path == str(art)
+            and "runtime lock-order edge" in f.message
+            for f in findings
+        )
+
+    def test_unreadable_artifact_is_a_finding_not_a_crash(self, tmp_path):
+        art = tmp_path / "garbage.json"
+        art.write_text("not json at all {")
+        project = Project(REPO, runtime_graph_paths=(str(art),))
+        findings = project_level_findings(project)
+        assert any(
+            f.rule == "DT010" and "unreadable" in f.message
+            for f in findings
+        )
+
+    def test_cli_reports_runtime_cycle(self, tmp_path, capsys):
+        art = tmp_path / "lockdep.json"
+        art.write_text(json.dumps({
+            "version": 1, "armed": True,
+            "edges": {"master.state_store": ["master.task_manager"]},
+        }))
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        rc = main(["--no-cache", "--lockdep-graph", str(art), str(clean)])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "runtime lock-order edge" in out
+        rc = main(["--no-cache", "--format=github",
+                   "--lockdep-graph", str(art), str(clean)])
+        assert rc == 1
+        assert "::error file=" in capsys.readouterr().out
+
+
+class TestDT011ReplayDeterminism:
+    EVENT_LOG = os.path.join(PKG, "observability", "event_log.py")
+
+    def test_real_journal_stamp_is_found_and_suppressed(self):
+        source = open(self.EVENT_LOG).read()
+        active, suppressed = lint_source(
+            source, self.EVENT_LOG, [RULES_BY_ID["DT011"]], PROJECT
+        )
+        assert active == []
+        assert any("time.time" in f.message for f in suppressed)
+
+    def test_stripping_the_suppression_fires(self):
+        """The suppression documents a real finding: without the
+        comment the nondeterministic call in a replay path is active."""
+        source = open(self.EVENT_LOG).read()
+        stripped = "\n".join(
+            line.split("  # dtlint: disable=DT011")[0]
+            for line in source.splitlines()
+        )
+        active, _ = lint_source(
+            stripped, self.EVENT_LOG, [RULES_BY_ID["DT011"]], PROJECT
+        )
+        assert any(
+            f.rule == "DT011" and "time.time" in f.message for f in active
+        )
+
+
+class TestDT012ReplaySideEffects:
+    def test_real_wal_contract_three_way_agreement(self):
+        wal = PROJECT.wal_contract()
+        registry = set(wal["registry"])
+        assert registry, "empty WAL registry"
+        assert set(wal["writes"]) == registry
+        assert set(wal["applies"]) == registry
+
+    def test_ghost_tag_fires_on_the_registry_row(self, tmp_path):
+        """A registered record kind nobody writes or applies is dead
+        contract: the registry row itself is the finding anchor."""
+        real = open(PROJECT.wal_records_path).read()
+        ghost = real.replace('"rpc":', '"ghost": (),\n    "rpc":', 1)
+        wal_path = tmp_path / "wal_records.py"
+        wal_path.write_text(ghost)
+        project = Project(REPO, wal_records_path=str(wal_path))
+        active, _ = lint_source(
+            ghost, str(wal_path), [RULES_BY_ID["DT012"]], project
+        )
+        messages = [f.message for f in active]
+        assert any(
+            "ghost" in m and "appends" in m for m in messages
+        ), messages
+        assert any(
+            "ghost" in m and "dispatcher" in m for m in messages
+        ), messages
+
+    def test_servicer_chaos_is_replay_gated(self):
+        """Regression for the crash-loop bug DT012 caught: the chaos
+        fault injection in the journaled-RPC path must be gated on
+        ``not replaying`` — a replayed record re-rolling the dice would
+        re-kill the recovering master."""
+        source = open(os.path.join(REPO, PROJECT.servicer_path)).read()
+        tree = ast.parse(source)
+        handle = None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and node.name == "_handle":
+                handle = node
+        assert handle is not None
+        chaos_line = replay_line = None
+        for sub in ast.walk(handle):
+            if isinstance(sub, ast.Call):
+                name = getattr(sub.func, "id", getattr(
+                    sub.func, "attr", ""
+                ))
+                if name == "fault_hit" and chaos_line is None:
+                    chaos_line = sub.lineno
+            if isinstance(sub, ast.Name) and sub.id == "replaying":
+                if replay_line is None:
+                    replay_line = sub.lineno
+        assert chaos_line is not None and replay_line is not None
+        assert replay_line < chaos_line, (
+            "chaos fault_hit must sit behind the replaying check"
+        )
+
+
+class TestAsyncWalkers:
+    def test_dt001_fires_inside_async_def(self):
+        active, _ = run_rule("DT001", """\
+            async def f():
+                try:
+                    await risky()
+                except Exception:
+                    pass
+        """)
+        assert rule_ids(active) == ["DT001"]
+
+    def test_dt002_fires_under_async_with_lock(self):
+        active, _ = run_rule("DT002", """\
+            import time
+
+            class A:
+                async def f(self):
+                    async with self._lock:
+                        time.sleep(0.5)
+        """)
+        assert rule_ids(active) == ["DT002"]
+
+    def test_dt002_quiet_in_nested_async_def(self):
+        active, _ = run_rule("DT002", """\
+            import time
+
+            class A:
+                async def f(self):
+                    async with self._lock:
+                        async def later():
+                            time.sleep(0.5)
+                        return later
+        """)
+        assert active == []
+
+    def test_dt003_fires_on_awaited_asyncio_sleep_poll(self):
+        active, _ = run_rule("DT003", """\
+            import asyncio
+
+            async def wait_ready(obj):
+                while not obj.ready():
+                    await asyncio.sleep(0.01)
+        """)
+        assert rule_ids(active) == ["DT003"]
+        assert "asyncio.sleep" in active[0].message
+
+    def test_dt003_quiet_on_asyncio_event_wait(self):
+        active, _ = run_rule("DT003", """\
+            import asyncio
+
+            async def wait_ready(ev):
+                await asyncio.wait_for(ev.wait(), timeout=5.0)
+        """)
+        assert active == []
+
+
+class TestChangedFiles:
+    def _git(self, cwd, *args):
+        return subprocess.run(
+            ("git",) + args, cwd=cwd, capture_output=True, text=True,
+            check=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    def test_reports_worktree_and_untracked_changes(self, tmp_path):
+        repo = str(tmp_path)
+        self._git(repo, "init", "-q", "-b", "main")
+        (tmp_path / "a.py").write_text("x = 1\n")
+        (tmp_path / "b.py").write_text("y = 1\n")
+        self._git(repo, "add", ".")
+        self._git(repo, "commit", "-q", "-m", "seed")
+        (tmp_path / "b.py").write_text("y = 2\n")
+        (tmp_path / "c.py").write_text("z = 1\n")
+        (tmp_path / "notes.txt").write_text("not python\n")
+        got = changed_files(repo)
+        assert got is not None
+        names = sorted(os.path.basename(p) for p in got)
+        assert names == ["b.py", "c.py"]
+
+    def test_returns_none_without_a_main_ref(self, tmp_path):
+        repo = str(tmp_path)
+        self._git(repo, "init", "-q", "-b", "trunk")
+        (tmp_path / "a.py").write_text("x = 1\n")
+        self._git(repo, "add", ".")
+        self._git(repo, "commit", "-q", "-m", "seed")
+        assert changed_files(repo) is None
+
+
+class TestResultCache:
+    def test_warm_run_hits_and_matches_cold_results(self, tmp_path):
+        target = tmp_path / "mod.py"
+        target.write_text("try:\n    x()\nexcept Exception:\n    pass\n")
+        fp = compute_fingerprint(PROJECT, ALL_RULES)
+        cache = ResultCache(str(tmp_path))
+        cache.load(fp)
+        cold = lint_paths([str(target)], ALL_RULES, PROJECT, cache)
+        cache.save()
+        assert cache.misses == 1 and cache.hits == 0
+        warm_cache = ResultCache(str(tmp_path))
+        warm_cache.load(fp)
+        warm = lint_paths([str(target)], ALL_RULES, PROJECT, warm_cache)
+        assert warm_cache.hits == 1 and warm_cache.misses == 0
+        assert warm[0] == cold[0] and warm[1] == cold[1]
+        assert rule_ids(warm[0]) == ["DT001"]
+
+    def test_file_edit_invalidates_its_entry(self, tmp_path):
+        target = tmp_path / "mod.py"
+        target.write_text("x = 1\n")
+        fp = compute_fingerprint(PROJECT, ALL_RULES)
+        cache = ResultCache(str(tmp_path))
+        cache.load(fp)
+        lint_paths([str(target)], ALL_RULES, PROJECT, cache)
+        cache.save()
+        target.write_text("y = 2\n")
+        os.utime(target, ns=(1, 1))  # force a different stat key
+        cache2 = ResultCache(str(tmp_path))
+        cache2.load(fp)
+        lint_paths([str(target)], ALL_RULES, PROJECT, cache2)
+        assert cache2.misses == 1 and cache2.hits == 0
+
+    def test_fingerprint_mismatch_drops_everything(self, tmp_path):
+        target = tmp_path / "mod.py"
+        target.write_text("x = 1\n")
+        cache = ResultCache(str(tmp_path))
+        cache.load("fp-one")
+        lint_paths([str(target)], ALL_RULES, PROJECT, cache)
+        cache.save()
+        cache2 = ResultCache(str(tmp_path))
+        cache2.load("fp-two")
+        assert cache2.get(str(target)) is None
+
+
+class TestRuleRoster:
+    def test_all_twelve_rules_are_armed(self):
+        ids = [r.id for r in ALL_RULES]
+        assert ids == sorted(ids)
+        assert ids == [f"DT{n:03d}" for n in range(1, 13)]

@@ -10,6 +10,7 @@ send plus a reported node failure re-dispatching in-flight shards), and
 the recorded cross-domain lock-order graph must be cycle-free.
 """
 
+import json
 import threading
 
 import pytest
@@ -142,6 +143,32 @@ class TestInstrumentedLock:
         assert a.acquire(blocking=False) is True
         assert a.acquire(blocking=False) is False
         a.release()
+
+
+class TestExportGraph:
+    def test_export_writes_dtlint_mergeable_artifact(
+        self, monkeypatch, tmp_path
+    ):
+        arm(monkeypatch)
+        a = instrumented_lock("drill.a")
+        b = instrumented_lock("drill.b")
+        with a:
+            with b:
+                pass
+        out = tmp_path / "lockdep.json"
+        data = lockdep.export_graph(str(out))
+        assert data == {
+            "version": 1,
+            "armed": True,
+            "edges": {"drill.a": ["drill.b"]},
+        }
+        assert json.loads(out.read_text()) == data
+        assert not list(tmp_path.glob("*.tmp.*"))
+
+    def test_export_disarmed_is_empty_but_valid(self):
+        data = lockdep.export_graph()
+        assert data["armed"] is False
+        assert data["edges"] == {}
 
 
 class TestControlPlaneLockGraph:

@@ -3,17 +3,24 @@
 Exit status: 0 = clean, 1 = findings (or unparseable files), 2 = usage
 error. ``--env-table`` prints the generated markdown table for
 docs/configuration.md from the typed registry (and is how the docs-sync
-test asserts the table never drifts).
+test asserts the table never drifts). ``--changed`` restricts the
+per-file pass to files touched since ``merge-base HEAD main`` (plus
+the working tree); ``--lockdep-graph`` merges one or more runtime
+``lockdep.export_graph()`` artifacts into the DT010 lock-order graph.
+Repeat runs are served from ``.dtlint_cache/`` unless ``--no-cache``.
 """
 
 import argparse
 import ast
 import os
+import subprocess
 import sys
 
+from tools.dtlint.cache import ResultCache, compute_fingerprint
 from tools.dtlint.core import lint_paths
 from tools.dtlint.project import Project
 from tools.dtlint.rules import ALL_RULES
+from tools.dtlint.rules.dt010_lock_order import project_level_findings
 
 
 def build_env_table(registry_path: str) -> str:
@@ -54,6 +61,46 @@ def build_env_table(registry_path: str) -> str:
     return "\n".join(out) + "\n"
 
 
+def changed_files(root: str) -> "list[str] | None":
+    """Python files touched since ``merge-base HEAD <main>`` plus the
+    working tree (staged, unstaged, untracked). Returns ``None`` when
+    git cannot answer (no repo, no main ref): the caller falls back to
+    a full run — a linter must fail open to "check everything", never
+    silently check nothing."""
+
+    def _git(*args: str) -> "str | None":
+        try:
+            proc = subprocess.run(
+                ("git", "-C", root) + args,
+                capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return proc.stdout if proc.returncode == 0 else None
+
+    base = None
+    for ref in ("origin/main", "main"):
+        out = _git("merge-base", "HEAD", ref)
+        if out and out.strip():
+            base = out.strip()
+            break
+    if base is None:
+        return None
+    committed = _git("diff", "--name-only", base, "HEAD")
+    worktree = _git("diff", "--name-only", "HEAD")
+    untracked = _git("ls-files", "--others", "--exclude-standard")
+    if committed is None or worktree is None:
+        return None
+    names = set()
+    for blob in (committed, worktree, untracked or ""):
+        names.update(line.strip() for line in blob.splitlines())
+    return sorted(
+        os.path.join(root, name)
+        for name in names
+        if name.endswith(".py") and os.path.exists(os.path.join(root, name))
+    )
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.dtlint",
@@ -73,9 +120,24 @@ def main(argv=None) -> int:
     parser.add_argument("--env-table", action="store_true",
                         help="print the generated env-var markdown table "
                         "and exit")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only files changed since "
+                        "merge-base(HEAD, main) plus the working tree; "
+                        "falls back to a full run if git cannot answer")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="ignore and do not write .dtlint_cache/")
+    parser.add_argument("--lockdep-graph", action="append", default=[],
+                        metavar="PATH",
+                        help="runtime lockdep.export_graph() JSON artifact "
+                        "to merge into the DT010 lock-order graph "
+                        "(repeatable; see DLROVER_TPU_LOCKDEP_EXPORT)")
     args = parser.parse_args(argv)
 
-    project = Project(args.root) if args.root else Project.default()
+    graphs = tuple(args.lockdep_graph)
+    if args.root:
+        project = Project(args.root, runtime_graph_paths=graphs)
+    else:
+        project = Project.default(runtime_graph_paths=graphs)
 
     if args.list_rules:
         for rule in ALL_RULES:
@@ -91,7 +153,34 @@ def main(argv=None) -> int:
         return 0
 
     paths = args.paths or [os.path.join(project.root, "dlrover_tpu")]
-    active, suppressed, errors = lint_paths(paths, ALL_RULES, project)
+    if args.changed:
+        changed = changed_files(project.root)
+        if changed is None:
+            print("dtlint: --changed: git unavailable; linting everything",
+                  file=sys.stderr)
+        else:
+            roots = tuple(os.path.abspath(p) for p in paths)
+            paths = [
+                p for p in changed
+                if any(
+                    os.path.abspath(p) == r
+                    or os.path.abspath(p).startswith(r + os.sep)
+                    for r in roots
+                )
+            ]
+
+    cache = None
+    if not args.no_cache:
+        cache = ResultCache(project.root)
+        cache.load(compute_fingerprint(project, ALL_RULES))
+
+    active, suppressed, errors = lint_paths(paths, ALL_RULES, project, cache)
+    # Whole-program findings with no single source line in the linted
+    # set (runtime-edge cycles, unreadable artifacts) are appended once
+    # per run, in whichever format the per-file findings use.
+    active = active + project_level_findings(project)
+    if cache is not None:
+        cache.save()
     for err in errors:
         print(f"error: {err}", file=sys.stderr)
     for finding in active:
@@ -99,15 +188,21 @@ def main(argv=None) -> int:
     if args.show_suppressed:
         for finding in suppressed:
             print(f"suppressed: {finding.format('text')}")
+    cache_note = (
+        f", cache: {cache.hits} hit/{cache.misses} linted"
+        if cache is not None else ""
+    )
     if active or errors:
         print(
             f"dtlint: {len(active)} finding(s), "
-            f"{len(suppressed)} suppressed, {len(errors)} error(s)",
+            f"{len(suppressed)} suppressed, {len(errors)} error(s)"
+            f"{cache_note}",
             file=sys.stderr,
         )
         return 1
     print(
-        f"dtlint: clean ({len(suppressed)} documented suppression(s))",
+        f"dtlint: clean ({len(suppressed)} documented suppression(s)"
+        f"{cache_note})",
         file=sys.stderr,
     )
     return 0

@@ -1,0 +1,145 @@
+"""Parse/result cache for repeat dtlint runs.
+
+dtlint's rules are whole-program: the project layer (lock registry,
+WAL contract, replay purity) folds every package file into every
+file's verdict, so a per-file cache keyed only on that file's stat
+would be unsound — editing ``wal_records.py`` changes findings in
+``master.py``. The cache therefore keys each entry on the file's own
+``(mtime_ns, size)`` AND a global fingerprint over the whole package
+plus the linter itself: any change anywhere invalidates everything.
+That still pays for the common case (CI re-runs, pre-commit on an
+unchanged tree, ``--changed`` with an empty diff) where the entire run
+collapses to ~N stat calls, and it can never serve a stale finding.
+
+Layout: ``<root>/.dtlint_cache/results.json`` — one JSON blob
+``{"fingerprint": ..., "files": {path: {"stat": [mtime_ns, size],
+"active": [...], "suppressed": [...]}}}``. Findings are stored as
+5-tuples mirroring :class:`~tools.dtlint.core.Finding`. Writes are
+atomic (tmp + ``os.replace``) and best-effort: a read-only checkout
+just runs cold every time.
+"""
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.dtlint.core import Finding
+
+CACHE_DIR_NAME = ".dtlint_cache"
+_CACHE_VERSION = 1
+
+
+def _stat_key(path: str) -> Optional[Tuple[int, int]]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size)
+
+
+def _linter_files() -> List[str]:
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = []
+    for root, dirs, files in os.walk(here):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for name in sorted(files):
+            if name.endswith(".py"):
+                out.append(os.path.join(root, name))
+    return out
+
+
+def compute_fingerprint(project, rules) -> str:
+    """Stat-level fingerprint of everything that can change a verdict:
+    every package file, every linter file, and the armed rule ids."""
+    parts: List[str] = [f"v{_CACHE_VERSION}", ",".join(r.id for r in rules)]
+    seen = set()
+    for path in _package_files(project) + _linter_files():
+        if path in seen:
+            continue
+        seen.add(path)
+        key = _stat_key(path)
+        parts.append(f"{path}:{key[0]}:{key[1]}" if key else f"{path}:gone")
+    # Runtime lock-graph artifacts feed DT010 edges: stat them too.
+    for path in getattr(project, "runtime_graph_paths", ()):
+        key = _stat_key(path)
+        parts.append(f"{path}:{key[0]}:{key[1]}" if key else f"{path}:gone")
+    import hashlib
+
+    return hashlib.sha256("\n".join(parts).encode()).hexdigest()
+
+
+def _package_files(project) -> List[str]:
+    from tools.dtlint.core import iter_py_files
+
+    return list(iter_py_files([project.package_dir]))
+
+
+class ResultCache:
+    def __init__(self, root: str):
+        self.dir = os.path.join(root, CACHE_DIR_NAME)
+        self.path = os.path.join(self.dir, "results.json")
+        self._data: Dict = {"fingerprint": None, "files": {}}
+        self.hits = 0
+        self.misses = 0
+
+    # ---------------- persistence ----------------
+    def load(self, fingerprint: str) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            data = None
+        if (
+            isinstance(data, dict)
+            and data.get("fingerprint") == fingerprint
+            and isinstance(data.get("files"), dict)
+        ):
+            self._data = data
+        else:
+            # Anything changed anywhere: the whole-program analyses may
+            # have shifted, so every per-file entry is suspect.
+            self._data = {"fingerprint": fingerprint, "files": {}}
+
+    def save(self) -> None:
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self._data, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # best-effort: cold runs are correct, just slower
+
+    # ---------------- per-file entries ----------------
+    def get(self, path: str) -> Optional[Tuple[List[Finding], List[Finding]]]:
+        entry = self._data["files"].get(path)
+        if entry is None:
+            self.misses += 1
+            return None
+        if entry.get("stat") != list(_stat_key(path) or ()):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return (
+            [Finding(*t) for t in entry.get("active", ())],
+            [Finding(*t) for t in entry.get("suppressed", ())],
+        )
+
+    def put(
+        self,
+        path: str,
+        active: Iterable[Finding],
+        suppressed: Iterable[Finding],
+    ) -> None:
+        key = _stat_key(path)
+        if key is None:
+            return
+        self._data["files"][path] = {
+            "stat": list(key),
+            "active": [
+                [f.rule, f.path, f.line, f.col, f.message] for f in active
+            ],
+            "suppressed": [
+                [f.rule, f.path, f.line, f.col, f.message] for f in suppressed
+            ],
+        }

@@ -216,13 +216,29 @@ def lint_source(
 
 
 def lint_paths(
-    paths: Iterable[str], rules, project
+    paths: Iterable[str], rules, project, cache=None
 ) -> Tuple[List[Finding], List[Finding], List[str]]:
-    """Lint files under `paths`; returns (active, suppressed, errors)."""
+    """Lint files under `paths`; returns (active, suppressed, errors).
+
+    ``cache`` is an optional :class:`tools.dtlint.cache.ResultCache`
+    already loaded against the current project fingerprint: files whose
+    stat matches their entry are answered without re-parsing, everything
+    else is linted and written back (the caller saves).
+    """
     active: List[Finding] = []
     suppressed: List[Finding] = []
     errors: List[str] = []
     for path in iter_py_files(paths):
+        # The project layer keys its cross-file maps (lock registry,
+        # WAL contract) by absolute path: a relative CLI argument must
+        # resolve to the same file, not to an unknown stranger.
+        path = os.path.abspath(path)
+        if cache is not None:
+            cached = cache.get(path)
+            if cached is not None:
+                active.extend(cached[0])
+                suppressed.extend(cached[1])
+                continue
         try:
             with open(path, encoding="utf-8") as f:
                 source = f.read()
@@ -234,6 +250,8 @@ def lint_paths(
         except SyntaxError as exc:
             errors.append(f"{path}: syntax error: {exc}")
             continue
+        if cache is not None:
+            cache.put(path, got_active, got_sup)
         active.extend(got_active)
         suppressed.extend(got_sup)
     active.sort(key=lambda f: (f.path, f.line, f.rule))

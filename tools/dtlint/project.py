@@ -1,7 +1,7 @@
 """Cross-file contracts the rules check against.
 
-Three contracts are parsed (AST-only, never imported — dtlint must run
-without jax or the package on sys.path):
+Contracts parsed (AST-only, never imported — dtlint must run without
+jax or the package on sys.path):
 
 - the **env registry** (``common/env_utils.py``): every
   ``DLROVER_TPU_*`` name declared via ``ENV.<kind>("NAME", ...)``;
@@ -9,13 +9,26 @@ without jax or the package on sys.path):
   legal site names (``ChaosSite.X = "..."`` class constants);
 - the **RPC contract** (``common/messages.py`` + ``master/servicer.py``):
   request classes, their ``journaled`` markers, and the servicer's
-  ``_HANDLERS`` / ``_JOURNALED`` / ``_APPLY_THEN_LOG`` maps.
+  ``_HANDLERS`` / ``_JOURNALED`` / ``_APPLY_THEN_LOG`` maps;
+- the **lock registry** (whole package): every ``instrumented_lock``
+  creation, resolved to the attribute/module name that holds it — the
+  name resolution DT009/DT010 build on;
+- the **lock-order graph**: lexically nested acquisitions across the
+  package, the declared ``LOCK_ORDER`` tiers from
+  ``master/mutation_locks.py``, and any runtime ``lockdep.
+  export_graph()`` JSON artifacts, merged into one digraph whose
+  cycles are DT010 findings;
+- the **WAL record contract** (``master/wal_records.py`` + write sites
+  + ``master/master.py``'s replay dispatcher): record tags on all
+  three sides, plus the bounded call-graph walk from every apply
+  handler that powers the DT011/DT012 replay-purity checks.
 
 All parsing is lazy and cached; a missing contract file yields an empty
 contract (rules then act conservatively — see each rule's docstring).
 """
 
 import ast
+import json
 import os
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -59,6 +72,11 @@ class Project:
         messages_path: Optional[str] = None,
         servicer_path: Optional[str] = None,
         durable_modules: Optional[Tuple[str, ...]] = None,
+        mutation_locks_path: Optional[str] = None,
+        master_path: Optional[str] = None,
+        wal_records_path: Optional[str] = None,
+        package_dir: Optional[str] = None,
+        runtime_graph_paths: Tuple[str, ...] = (),
     ):
         self.root = os.path.abspath(root)
 
@@ -77,14 +95,27 @@ class Project:
         self.servicer_path = servicer_path or _default(
             "dlrover_tpu/master/servicer.py"
         )
+        self.mutation_locks_path = mutation_locks_path or _default(
+            "dlrover_tpu/master/mutation_locks.py"
+        )
+        self.master_path = master_path or _default(
+            "dlrover_tpu/master/master.py"
+        )
+        self.wal_records_path = wal_records_path or _default(
+            "dlrover_tpu/master/wal_records.py"
+        )
+        self.package_dir = package_dir or _default("dlrover_tpu")
+        #: Runtime ``lockdep.export_graph()`` JSON artifacts to merge
+        #: into the static lock-order graph (CLI ``--lockdep-graph``).
+        self.runtime_graph_paths = tuple(runtime_graph_paths)
         self.durable_modules = durable_modules or self.DEFAULT_DURABLE_MODULES
         self._cache: Dict[str, object] = {}
 
     @classmethod
-    def default(cls) -> "Project":
+    def default(cls, **kwargs) -> "Project":
         """Project rooted at the repo containing this tools/ package."""
         here = os.path.dirname(os.path.abspath(__file__))
-        return cls(os.path.dirname(os.path.dirname(here)))
+        return cls(os.path.dirname(os.path.dirname(here)), **kwargs)
 
     def is_path(self, path: str, contract_path: str) -> bool:
         return os.path.abspath(path) == os.path.abspath(contract_path)
@@ -179,6 +210,7 @@ class Project:
                                 dispatch_marks.add(node.name)
 
             handlers: Dict[str, int] = {}
+            handler_methods: Dict[str, str] = {}
             journaled_tuple: Dict[str, int] = {}
             apply_then_log_tuple: Dict[str, int] = {}
             tree = _parse_file(self.servicer_path)
@@ -193,10 +225,15 @@ class Project:
                     elif isinstance(target, ast.Attribute):
                         tname = target.attr
                     if tname == "_HANDLERS" and isinstance(node.value, ast.Dict):
-                        for key in node.value.keys:
+                        for key, value in zip(
+                            node.value.keys, node.value.values
+                        ):
                             name = _tail_name(key)
                             if name:
                                 handlers[name] = key.lineno
+                                method = _tail_name(value)
+                                if method:
+                                    handler_methods[name] = method
                     elif tname in ("_JOURNALED", "_APPLY_THEN_LOG") and isinstance(
                         node.value, ast.Tuple
                     ):
@@ -214,10 +251,602 @@ class Project:
                 "journaled_marks": journaled_marks,
                 "dispatch_marks": dispatch_marks,
                 "handlers": handlers,
+                "handler_methods": handler_methods,
                 "journaled_tuple": journaled_tuple,
                 "apply_then_log_tuple": apply_then_log_tuple,
             }
         return self._cache["rpc"]  # type: ignore[return-value]
+
+    # ---------------- package-wide parsing ----------------
+    def package_asts(self) -> Dict[str, ast.Module]:
+        """Every package module parsed once: {abs path: Module}."""
+        if "asts" not in self._cache:
+            trees: Dict[str, ast.Module] = {}
+            for dirpath, dirs, files in os.walk(self.package_dir):
+                dirs[:] = sorted(
+                    d for d in dirs if d not in ("__pycache__", ".git")
+                )
+                for name in sorted(files):
+                    if not name.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, name)
+                    tree = _parse_file(path)
+                    if tree is not None:
+                        trees[os.path.abspath(path)] = tree
+            self._cache["asts"] = trees
+        return self._cache["asts"]  # type: ignore[return-value]
+
+    # ---------------- lock registry ----------------
+    def lock_registry(self) -> Dict[str, object]:
+        """Every ``instrumented_lock`` creation site, resolved to names.
+
+        Returns:
+          ``classes``: {(path, ClassName): {attr: lock name}} for
+          ``self.X = instrumented_lock("...")`` (including locks wrapped
+          in ``threading.Condition``);
+          ``modules``: {(path, var): lock name} for module-level locks;
+          ``attr_names``: {attr: set of lock names} across the package
+          (the unique-attr fallback used to resolve ``obj._lock``);
+          ``wildcards``: names carrying a dynamic suffix, recorded as
+          ``"prefix.*"`` order classes (e.g. ``rdzv.*``).
+        """
+        if "locks" not in self._cache:
+            classes: Dict[Tuple[str, str], Dict[str, str]] = {}
+            modules: Dict[Tuple[str, str], str] = {}
+            attr_names: Dict[str, Set[str]] = {}
+            wildcards: Set[str] = set()
+
+            def note(scope: Optional[Dict[str, str]], path: str,
+                     var: str, lock_name: str):
+                if "*" in lock_name:
+                    wildcards.add(lock_name)
+                if scope is not None:
+                    scope[var] = lock_name
+                else:
+                    modules[(path, var)] = lock_name
+                attr_names.setdefault(var, set()).add(lock_name)
+
+            for path, tree in self.package_asts().items():
+                for node in tree.body:
+                    if isinstance(node, ast.Assign) and len(
+                        node.targets
+                    ) == 1 and isinstance(node.targets[0], ast.Name):
+                        lock_name = _lock_name_of(node.value)
+                        if lock_name:
+                            note(None, path, node.targets[0].id, lock_name)
+                    if not isinstance(node, ast.ClassDef):
+                        continue
+                    cmap = classes.setdefault((path, node.name), {})
+                    for sub in ast.walk(node):
+                        if not isinstance(sub, ast.Assign):
+                            continue
+                        lock_name = _lock_name_of(sub.value)
+                        if not lock_name:
+                            continue
+                        for target in sub.targets:
+                            if (
+                                isinstance(target, ast.Attribute)
+                                and isinstance(target.value, ast.Name)
+                                and target.value.id == "self"
+                            ):
+                                note(cmap, path, target.attr, lock_name)
+                    # ``@property`` aliases of a lock attribute (e.g.
+                    # ``mutation_lock`` returning ``self._lock``).
+                    for stmt in node.body:
+                        if not isinstance(stmt, ast.FunctionDef):
+                            continue
+                        if not any(
+                            isinstance(d, ast.Name) and d.id == "property"
+                            for d in stmt.decorator_list
+                        ):
+                            continue
+                        rets = [
+                            s for s in stmt.body
+                            if isinstance(s, ast.Return)
+                        ]
+                        if len(rets) == 1 and isinstance(
+                            rets[0].value, ast.Attribute
+                        ) and isinstance(
+                            rets[0].value.value, ast.Name
+                        ) and rets[0].value.value.id == "self":
+                            src = cmap.get(rets[0].value.attr)
+                            if src:
+                                note(cmap, path, stmt.name, src)
+            self._cache["locks"] = {
+                "classes": classes,
+                "modules": modules,
+                "attr_names": attr_names,
+                "wildcards": wildcards,
+            }
+        return self._cache["locks"]  # type: ignore[return-value]
+
+    def canonical_shards(self) -> Tuple[str, ...]:
+        """The ``SHARDS`` tuple from mutation_locks.py, as lock names."""
+        if "shards" not in self._cache:
+            shards: Tuple[str, ...] = ()
+            tree = _parse_file(self.mutation_locks_path)
+            if tree is not None:
+                for node in tree.body:
+                    if (
+                        isinstance(node, (ast.Assign, ast.AnnAssign))
+                        and _assign_target_name(node) == "SHARDS"
+                    ):
+                        value = node.value
+                        if isinstance(value, ast.Tuple):
+                            shards = tuple(
+                                f"master.mutation.{e.value}"
+                                for e in value.elts
+                                if isinstance(e, ast.Constant)
+                            )
+            self._cache["shards"] = shards
+        return self._cache["shards"]  # type: ignore[return-value]
+
+    def declared_lock_order(self) -> Tuple[Tuple[Tuple[str, ...], ...], int]:
+        """The ``LOCK_ORDER`` tiers from mutation_locks.py + its line."""
+        if "lock_order" not in self._cache:
+            tiers: Tuple[Tuple[str, ...], ...] = ()
+            lineno = 1
+            tree = _parse_file(self.mutation_locks_path)
+            if tree is not None:
+                for node in tree.body:
+                    if (
+                        isinstance(node, (ast.Assign, ast.AnnAssign))
+                        and _assign_target_name(node) == "LOCK_ORDER"
+                        and isinstance(node.value, ast.Tuple)
+                    ):
+                        lineno = node.lineno
+                        got = []
+                        for tier in node.value.elts:
+                            if isinstance(tier, ast.Tuple):
+                                got.append(tuple(
+                                    e.value for e in tier.elts
+                                    if isinstance(e, ast.Constant)
+                                    and isinstance(e.value, str)
+                                ))
+                        tiers = tuple(got)
+            self._cache["lock_order"] = (tiers, lineno)
+        return self._cache["lock_order"]  # type: ignore[return-value]
+
+    def _resolve_lock_expr(
+        self, expr: ast.AST, path: str, cls: Optional[str],
+        local: Optional[Dict[str, str]] = None,
+    ) -> Tuple[str, ...]:
+        """Lock name(s) a with-item acquires, () when unresolvable.
+
+        ``self._locks.for_message(...)`` / ``.acquire(...)`` / ``.all()``
+        on a mutation-locks object resolve to every canonical shard
+        (conservative: the callee acquires a canonical-order subset).
+        ``local`` maps ``self.<attr>`` lock attributes scraped from the
+        file being linted itself — it wins over the registry, so an
+        in-memory source (or a file newer than the on-disk package)
+        still resolves its own locks.
+        """
+        locks = self.lock_registry()
+        if isinstance(expr, ast.Call):
+            func = expr.func
+            if isinstance(func, ast.Attribute) and func.attr in (
+                "for_message", "acquire", "all", "shard"
+            ):
+                recv = _dotted(func.value)
+                if "lock" in recv.rsplit(".", 1)[-1].lower():
+                    return self.canonical_shards()
+            return ()
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ) and expr.value.id == "self":
+            if local and expr.attr in local:
+                return (local[expr.attr],)
+            if cls is not None:
+                name = locks["classes"].get((path, cls), {}).get(expr.attr)
+                if name:
+                    return (name,)
+            if cls is not None:
+                return ()
+        if isinstance(expr, ast.Name):
+            name = locks["modules"].get((path, expr.id))
+            if name:
+                return (name,)
+        if isinstance(expr, ast.Attribute):
+            candidates = locks["attr_names"].get(expr.attr, set())
+            if len(candidates) == 1:
+                return (next(iter(candidates)),)
+        return ()
+
+    def static_lock_graph(self) -> Dict[Tuple[str, str], Tuple[str, int, str]]:
+        """Merged lock-order edges: {(a, b): (origin, line, kind)}.
+
+        ``kind`` is ``static`` (a lexically nested acquisition, origin =
+        file path), ``declared`` (a LOCK_ORDER tier pair, origin =
+        mutation_locks.py) or ``runtime`` (a lockdep export artifact,
+        origin = the JSON path). Runtime node names are collapsed onto
+        wildcard order classes (``rdzv.training`` -> ``rdzv.*``) so
+        dynamic instances share one node, as in kernel lockdep.
+        """
+        if "lock_graph" not in self._cache:
+            edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+            def add(a: str, b: str, origin: str, line: int, kind: str):
+                if a != b and (a, b) not in edges:
+                    edges[(a, b)] = (origin, line, kind)
+
+            # -- static: lexically nested with-acquisitions --
+            for path, tree in self.package_asts().items():
+                for cls, func in _iter_functions(tree):
+                    self._walk_with_nesting(func, path, cls, [], add)
+
+            # -- declared: LOCK_ORDER tiers --
+            tiers, lineno = self.declared_lock_order()
+            origin = self.mutation_locks_path
+            for i, tier in enumerate(tiers):
+                if i == 0:
+                    # Canonical chain: ordered within the tier.
+                    for a, b in zip(tier, tier[1:]):
+                        add(a, b, origin, lineno, "declared")
+                if i + 1 < len(tiers):
+                    for a in tier:
+                        for b in tiers[i + 1]:
+                            add(a, b, origin, lineno, "declared")
+
+            # -- runtime: lockdep export artifacts --
+            wildcards = self.lock_registry()["wildcards"]
+
+            def canon(name: str) -> str:
+                for wc in wildcards:
+                    if name.startswith(wc[:-1]):
+                        return wc
+                return name
+
+            for art_path in self.runtime_graph_paths:
+                try:
+                    with open(art_path, encoding="utf-8") as f:
+                        data = json.load(f)
+                except (OSError, ValueError):
+                    # Surfaced as a DT010 project-level finding.
+                    self._cache.setdefault("bad_artifacts", []).append(
+                        art_path
+                    )
+                    continue
+                for a, targets in (data.get("edges") or {}).items():
+                    for b in targets:
+                        add(canon(a), canon(b), art_path, 1, "runtime")
+            self._cache["lock_graph"] = edges
+        return self._cache["lock_graph"]  # type: ignore[return-value]
+
+    def bad_runtime_artifacts(self) -> List[str]:
+        self.static_lock_graph()
+        return list(self._cache.get("bad_artifacts", []))
+
+    def _walk_with_nesting(self, func, path, cls, held, add):
+        """Record an edge held -> acquired for every with-nesting."""
+
+        def rec(node, held):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                # Deferred body: locks held lexically are NOT held when
+                # it runs.
+                for child in ast.iter_child_nodes(node):
+                    rec(child, [])
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    acquired.extend(
+                        self._resolve_lock_expr(item.context_expr, path, cls)
+                    )
+                for a in held:
+                    for b in acquired:
+                        add(a, b, path, node.lineno, "static")
+                inner = held + acquired
+                for child in node.body:
+                    rec(child, inner)
+                return
+            for child in ast.iter_child_nodes(node):
+                rec(child, held)
+
+        for child in ast.iter_child_nodes(func):
+            rec(child, held)
+
+    def lock_cycles(self) -> List[List[str]]:
+        """Elementary cycles of the merged graph (one per cyclic SCC,
+        shortest found): [] when the graph is cycle-free."""
+        if "cycles" not in self._cache:
+            edges = self.static_lock_graph()
+            adj: Dict[str, Set[str]] = {}
+            for (a, b) in edges:
+                adj.setdefault(a, set()).add(b)
+            sccs = _tarjan_sccs(adj)
+            cycles: List[List[str]] = []
+            for scc in sccs:
+                scc_set = set(scc)
+                if len(scc) == 1 and scc[0] not in adj.get(scc[0], ()):
+                    continue
+                # One representative cycle: BFS from the smallest node
+                # back to itself inside the SCC.
+                start = sorted(scc)[0]
+                cycles.append(_cycle_through(adj, scc_set, start))
+            self._cache["cycles"] = cycles
+        return self._cache["cycles"]  # type: ignore[return-value]
+
+    def cyclic_edges(self) -> Dict[Tuple[str, str], Tuple[str, int, str]]:
+        """Edges participating in a cycle (both endpoints in one cyclic
+        SCC): the per-edge anchors DT010 reports."""
+        edges = self.static_lock_graph()
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+        cyclic_nodes: Dict[str, int] = {}
+        for i, scc in enumerate(_tarjan_sccs(adj)):
+            if len(scc) > 1 or (
+                len(scc) == 1 and scc[0] in adj.get(scc[0], ())
+            ):
+                for n in scc:
+                    cyclic_nodes[n] = i
+        return {
+            (a, b): origin
+            for (a, b), origin in edges.items()
+            if cyclic_nodes.get(a) is not None
+            and cyclic_nodes.get(a) == cyclic_nodes.get(b)
+        }
+
+    # ---------------- WAL record contract ----------------
+    def wal_contract(self) -> Dict[str, object]:
+        """The journal record-tag contract, all three sides.
+
+        ``registry``: {tag: (lineno, (handler, ...))} from
+        ``master/wal_records.py``;
+        ``writes``: {tag: [(path, lineno)]} — every
+        ``<store>.append(("tag", ...))`` / ``<obj>.journal(("tag",
+        ...))`` site in the package;
+        ``applies``: {tag: lineno} — every ``kind == "tag"`` branch of
+        the replay dispatcher in ``master/master.py``.
+        """
+        if "wal" not in self._cache:
+            registry: Dict[str, Tuple[int, Tuple[str, ...]]] = {}
+            tree = _parse_file(self.wal_records_path)
+            if tree is not None:
+                for node in tree.body:
+                    if not (
+                        isinstance(node, (ast.Assign, ast.AnnAssign))
+                        and _assign_target_name(node) == "WAL_RECORDS"
+                        and isinstance(node.value, ast.Dict)
+                    ):
+                        continue
+                    for key, value in zip(node.value.keys, node.value.values):
+                        if not (
+                            isinstance(key, ast.Constant)
+                            and isinstance(key.value, str)
+                        ):
+                            continue
+                        handlers: Tuple[str, ...] = ()
+                        if isinstance(value, (ast.Tuple, ast.List)):
+                            handlers = tuple(
+                                e.value for e in value.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)
+                            )
+                        elif isinstance(value, ast.Constant) and isinstance(
+                            value.value, str
+                        ):
+                            handlers = (value.value,)
+                        registry[key.value] = (key.lineno, handlers)
+
+            writes: Dict[str, List[Tuple[str, int]]] = {}
+            for path, tree in self.package_asts().items():
+                if os.path.abspath(path) == os.path.abspath(
+                    self.wal_records_path
+                ):
+                    continue
+                for node in ast.walk(tree):
+                    tag = _wal_write_tag(node)
+                    if tag is not None:
+                        writes.setdefault(tag, []).append(
+                            (path, node.lineno)
+                        )
+
+            applies: Dict[str, int] = {}
+            tree = _parse_file(self.master_path)
+            if tree is not None:
+                for node in ast.walk(tree):
+                    if (
+                        isinstance(node, ast.Compare)
+                        and isinstance(node.left, ast.Name)
+                        and node.left.id == "kind"
+                        and len(node.ops) == 1
+                        and isinstance(node.ops[0], ast.Eq)
+                        and isinstance(node.comparators[0], ast.Constant)
+                        and isinstance(node.comparators[0].value, str)
+                    ):
+                        applies.setdefault(
+                            node.comparators[0].value, node.lineno
+                        )
+            self._cache["wal"] = {
+                "registry": registry,
+                "writes": writes,
+                "applies": applies,
+            }
+        return self._cache["wal"]  # type: ignore[return-value]
+
+    # ---------------- function index + replay purity ----------------
+    def function_index(self) -> Dict[str, object]:
+        """Package-wide method/function index for the purity walk.
+
+        ``classes``: {ClassName: {"path", "bases", "methods": {name:
+        node}, "set_attrs": {attr assigned a set in __init__}}};
+        ``methods_by_name``: {method name: [ClassName, ...]};
+        ``functions``: {(path, name): node} for module-level defs.
+        """
+        if "index" not in self._cache:
+            classes: Dict[str, Dict[str, object]] = {}
+            methods_by_name: Dict[str, List[str]] = {}
+            functions: Dict[Tuple[str, str], ast.AST] = {}
+            for path, tree in self.package_asts().items():
+                for node in tree.body:
+                    if isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        functions[(path, node.name)] = node
+                    if not isinstance(node, ast.ClassDef):
+                        continue
+                    info = classes.setdefault(node.name, {
+                        "path": path,
+                        "bases": [
+                            b.id for b in node.bases
+                            if isinstance(b, ast.Name)
+                        ],
+                        "methods": {},
+                        "set_attrs": set(),
+                    })
+                    for stmt in node.body:
+                        if isinstance(
+                            stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            info["methods"][stmt.name] = stmt
+                            methods_by_name.setdefault(
+                                stmt.name, []
+                            ).append(node.name)
+                            if stmt.name == "__init__":
+                                for sub in ast.walk(stmt):
+                                    attr = _set_attr_assign(sub)
+                                    if attr:
+                                        info["set_attrs"].add(attr)
+            self._cache["index"] = {
+                "classes": classes,
+                "methods_by_name": methods_by_name,
+                "functions": functions,
+            }
+        return self._cache["index"]  # type: ignore[return-value]
+
+    def _subclasses_of(self, cls: str) -> List[str]:
+        classes = self.function_index()["classes"]
+        out = []
+        pending = [cls]
+        while pending:
+            base = pending.pop()
+            for name, info in classes.items():
+                if base in info["bases"] and name not in out:
+                    out.append(name)
+                    pending.append(name)
+        return out
+
+    def replay_purity(self) -> List[Dict[str, object]]:
+        """DT011/DT012 findings from the bounded apply-path walk.
+
+        Roots: every WAL registry handler plus the ``_JOURNALED`` RPC
+        handler methods (write-ahead records replay through the full
+        servicer dispatch; ``_APPLY_THEN_LOG`` handlers do NOT re-run on
+        replay — their recorded outcome replays instead — so they are
+        deliberately not roots). From each root, calls are followed
+        best-effort up to ``_PURITY_DEPTH`` hops: ``self.m()`` within the
+        class (and overrides), ``obj.m()`` when at most two classes
+        define ``m`` (skipping generic container/IO names), and bare
+        module-level calls. Replay-aware branches (an ``if`` testing
+        ``replaying``) are skipped wholesale: code that branches on
+        replay has handled it.
+        """
+        if "purity" not in self._cache:
+            self._cache["purity"] = self._compute_replay_purity()
+        return self._cache["purity"]  # type: ignore[return-value]
+
+    def _compute_replay_purity(self) -> List[Dict[str, object]]:
+        index = self.function_index()
+        classes = index["classes"]
+        methods_by_name = index["methods_by_name"]
+        wal = self.wal_contract()
+        rpc = self.rpc_contract()
+
+        # -- roots --
+        roots: List[Tuple[str, str, str]] = []  # (cls, method, origin tag)
+
+        def add_root(cls: str, method: str, tag: str):
+            targets = [cls] + self._subclasses_of(cls)
+            for klass in targets:
+                info = classes.get(klass)
+                if info and method in info["methods"]:
+                    entry = (klass, method, tag)
+                    if entry not in roots:
+                        roots.append(entry)
+
+        unresolved: List[Tuple[str, int, str]] = []
+        for tag, (lineno, handlers) in sorted(wal["registry"].items()):
+            for handler in handlers:
+                if "." not in handler:
+                    unresolved.append((tag, lineno, handler))
+                    continue
+                cls, method = handler.rsplit(".", 1)
+                before = len(roots)
+                add_root(cls, method, tag)
+                if tag == "rpc":
+                    # The servicer dispatch fans out to every journaled
+                    # handler method; walk those, not the generic
+                    # dispatcher (non-journaled handlers never replay).
+                    for req, meth in sorted(
+                        rpc["handler_methods"].items()
+                    ):
+                        if req in rpc["journaled_tuple"]:
+                            add_root("MasterServicer", meth, f"rpc:{req}")
+                elif len(roots) == before:
+                    unresolved.append((tag, lineno, handler))
+
+        # -- BFS over the bounded call graph --
+        findings: List[Dict[str, object]] = []
+        for tag, lineno, handler in unresolved:
+            findings.append({
+                "rule": "DT012",
+                "path": self.wal_records_path,
+                "line": lineno,
+                "col": 0,
+                "message": (
+                    f"WAL tag '{tag}' names apply handler '{handler}' "
+                    "which does not resolve to any class method in the "
+                    "package; the registry must match the code"
+                ),
+            })
+        scanned: Set[Tuple[str, str]] = set()
+        queue: List[Tuple[str, str, str, int]] = [
+            (cls, method, tag, 0) for cls, method, tag in roots
+        ]
+        while queue:
+            cls, method, chain, depth = queue.pop(0)
+            if (cls, method) in scanned:
+                continue
+            scanned.add((cls, method))
+            info = classes.get(cls)
+            if info is None or method not in info["methods"]:
+                continue
+            node = info["methods"][method]
+            path = info["path"]
+            got, callees = _scan_apply_function(
+                node, cls, info, chain, path
+            )
+            findings.extend(got)
+            if depth >= _PURITY_DEPTH:
+                continue
+            next_chain = f"{chain} -> {cls}.{method}"
+            for kind, name in callees:
+                if kind == "self":
+                    for klass in [cls] + self._subclasses_of(cls):
+                        queue.append((klass, name, next_chain, depth + 1))
+                elif kind == "method":
+                    owners = methods_by_name.get(name, [])
+                    if 0 < len(owners) <= 2:
+                        for klass in owners:
+                            queue.append(
+                                (klass, name, next_chain, depth + 1)
+                            )
+                elif kind == "class":
+                    queue.append((name, "__init__", next_chain, depth + 1))
+        # Deterministic order + de-dup (several roots can reach one
+        # function; the first chain wins).
+        seen: Set[Tuple[str, int, str]] = set()
+        out = []
+        for f in findings:
+            key = (f["path"], f["line"], f["message"].split("(")[0])
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        out.sort(key=lambda f: (f["path"], f["line"]))
+        return out
 
 
 def _tail_name(node: ast.AST) -> Optional[str]:
@@ -226,3 +855,411 @@ def _tail_name(node: ast.AST) -> Optional[str]:
     if isinstance(node, ast.Name):
         return node.id
     return None
+
+
+def _dotted(node: ast.AST) -> str:
+    """Dotted path of a Name/Attribute chain ('' when not one)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _assign_target_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+        return _tail_name(node.targets[0])
+    if isinstance(node, ast.AnnAssign):
+        return _tail_name(node.target)
+    return None
+
+
+def local_lock_map(cls_node: ast.ClassDef) -> Dict[str, str]:
+    """{attr: lock name} for every ``self.<attr> = instrumented_lock(...)``
+    (or Condition-wrapped lock) assignment inside one class body — the
+    file-local complement to the package-wide registry."""
+    out: Dict[str, str] = {}
+    for sub in ast.walk(cls_node):
+        if not isinstance(sub, ast.Assign):
+            continue
+        name = _lock_name_of(sub.value)
+        if name is None:
+            continue
+        for target in sub.targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                out[target.attr] = name
+    return out
+
+
+def _lock_name_of(value: ast.AST) -> Optional[str]:
+    """The lock name an expression creates, or None.
+
+    Handles ``instrumented_lock("a.b")``, dynamic names like
+    ``instrumented_lock(f"rdzv.{name}")`` (recorded as the order class
+    ``"rdzv.*"``), and Condition-wrapped locks
+    (``threading.Condition(instrumented_lock("..."))``).
+    """
+    if not isinstance(value, ast.Call):
+        return None
+    tail = _tail_name(value.func)
+    if tail == "Condition" and value.args:
+        return _lock_name_of(value.args[0])
+    if tail != "instrumented_lock" or not value.args:
+        return None
+    arg = value.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        prefix = ""
+        for part in arg.values:
+            if isinstance(part, ast.Constant) and isinstance(
+                part.value, str
+            ):
+                prefix += part.value
+            else:
+                break
+        # A dynamic suffix collapses onto one wildcard order class;
+        # a fully dynamic name is unresolvable.
+        return f"{prefix}*" if prefix else None
+    return None
+
+
+def _iter_functions(tree: ast.Module):
+    """(class name or None, function node) for every top-level def."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield node.name, stmt
+
+
+def _tarjan_sccs(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Strongly connected components, iterative Tarjan."""
+    nodes = sorted(set(adj) | {b for bs in adj.values() for b in bs})
+    index: Dict[str, int] = {}
+    lowlink: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in nodes:
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = lowlink[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            succs = sorted(adj.get(node, ()))
+            for i in range(pi, len(succs)):
+                succ = succs[i]
+                if succ not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((succ, 0))
+                    advanced = True
+                    break
+                if succ in on_stack:
+                    lowlink[node] = min(lowlink[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[node])
+            if lowlink[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+def _cycle_through(
+    adj: Dict[str, Set[str]], scc: Set[str], start: str
+) -> List[str]:
+    """A shortest cycle through ``start`` inside ``scc`` (BFS)."""
+    parent: Dict[str, str] = {}
+    queue = [start]
+    seen = {start}
+    while queue:
+        node = queue.pop(0)
+        for succ in sorted(adj.get(node, ())):
+            if succ == start:
+                path = []
+                cur = node
+                while cur != start:
+                    path.append(cur)
+                    cur = parent[cur]
+                path.append(start)
+                path.reverse()
+                return path + [start]
+            if succ in scc and succ not in seen:
+                seen.add(succ)
+                parent[succ] = node
+                queue.append(succ)
+    return [start, start]
+
+
+def _wal_write_tag(node: ast.AST) -> Optional[str]:
+    """The record tag a journal-write call appends, or None.
+
+    Matches ``<...store>.append(("tag", ...))`` and
+    ``<obj>.journal(("tag", ...))``; the receiver-name filter keeps
+    plain list ``.append`` calls (e.g. an RPC outbox) out.
+    """
+    if not (isinstance(node, ast.Call) and node.args):
+        return None
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr == "append":
+        recv = _dotted(func.value).rsplit(".", 1)[-1].lower()
+        if "store" not in recv:
+            return None
+    elif func.attr != "journal":
+        return None
+    arg = node.args[0]
+    if (
+        isinstance(arg, ast.Tuple)
+        and arg.elts
+        and isinstance(arg.elts[0], ast.Constant)
+        and isinstance(arg.elts[0].value, str)
+    ):
+        return arg.elts[0].value
+    return None
+
+
+def _set_attr_assign(node: ast.AST) -> Optional[str]:
+    """attr when node is ``self.X = set()`` / a set literal, else None."""
+    if not (
+        isinstance(node, ast.Assign)
+        and len(node.targets) == 1
+        and isinstance(node.targets[0], ast.Attribute)
+        and isinstance(node.targets[0].value, ast.Name)
+        and node.targets[0].value.id == "self"
+    ):
+        return None
+    value = node.value
+    if isinstance(value, ast.Set):
+        return node.targets[0].attr
+    if (
+        isinstance(value, ast.Call)
+        and isinstance(value.func, ast.Name)
+        and value.func.id in ("set", "frozenset")
+    ):
+        return node.targets[0].attr
+    return None
+
+
+#: Hops followed from each apply-handler root. Depth 3 covers handler ->
+#: subsystem method -> helper, the deepest real apply chain in the
+#: package; deeper edges are noise from the best-effort name resolution.
+_PURITY_DEPTH = 3
+
+#: Nondeterministic clock/entropy calls (DT011), by dotted name.
+_NONDET_CALLS = {
+    "time.time": "wall clock",
+    "time.time_ns": "wall clock",
+    "time.monotonic": "monotonic clock",
+    "time.monotonic_ns": "monotonic clock",
+    "time.perf_counter": "perf clock",
+    "time.perf_counter_ns": "perf clock",
+    "os.urandom": "entropy",
+    "os.getenv": "environment read",
+    "os.getpid": "process id",
+    "socket.gethostname": "host identity",
+}
+
+#: Generic container/IO/logging method names never followed as callees —
+#: they resolve to dozens of unrelated classes and carry their own
+#: checks (emit/call are flagged in place, not followed).
+_SKIP_CALLEES = frozenset((
+    "append", "appendleft", "extend", "insert", "pop", "popitem",
+    "popleft", "remove", "discard", "clear", "copy", "update",
+    "setdefault", "get", "set", "add", "items", "keys", "values",
+    "index", "count", "sort", "reverse", "join", "split", "strip",
+    "lstrip", "rstrip", "replace", "startswith", "endswith", "format",
+    "encode", "decode", "lower", "upper", "open", "close", "flush",
+    "write", "read", "readline", "seek", "tell", "wait", "notify",
+    "notify_all", "acquire", "release", "locked", "put", "get_nowait",
+    "put_nowait", "info", "warning", "error", "exception", "debug",
+    "log", "emit", "call", "isoformat", "total_seconds", "to_dict",
+    "from_dict", "dumps", "loads",
+))
+
+#: ``self.X += 1``-style counters that must not double-apply on replay
+#: (DT012) — journaled sequence state like ``_seq``/``_completed`` is
+#: deliberately NOT matched, it is restored from the snapshot.
+_COUNTER_HINTS = ("count", "shed", "dropped", "errors", "retries",
+                  "total", "misses", "hits")
+
+
+def _mentions_replaying(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "replay" in sub.id:
+            return True
+        if isinstance(sub, ast.Attribute) and "replay" in sub.attr:
+            return True
+    return False
+
+
+def _scan_apply_function(node, cls, info, chain, path):
+    """One function's DT011/DT012 findings + the callees to follow.
+
+    ``if ... replaying ...`` subtrees are skipped wholesale: code that
+    branches on replay has already handled replay.
+    """
+    findings: List[Dict[str, object]] = []
+    callees: List[Tuple[str, str]] = []
+    where = f"{cls}.{node.name}" if cls else node.name
+    via = f" [apply path: {chain} -> {where}]"
+
+    def emit(rule: str, sub: ast.AST, message: str):
+        findings.append({
+            "rule": rule,
+            "path": path,
+            "line": sub.lineno,
+            "col": getattr(sub, "col_offset", 0),
+            "message": message + via,
+        })
+
+    def rec(sub: ast.AST):
+        if isinstance(sub, ast.If) and _mentions_replaying(sub.test):
+            return
+        if isinstance(sub, ast.Call):
+            dotted = _dotted(sub.func)
+            tail = _tail_name(sub.func)
+            if dotted in _NONDET_CALLS:
+                emit("DT011", sub, (
+                    f"{dotted}() ({_NONDET_CALLS[dotted]}) in a journal "
+                    "apply path; replay re-runs this with a different "
+                    "result — record the value in the journal instead"
+                ))
+            elif dotted.startswith("random.") or dotted.startswith("uuid."):
+                emit("DT011", sub, (
+                    f"{dotted}() in a journal apply path; replay must "
+                    "be deterministic — derive from journaled state or "
+                    "record the value"
+                ))
+            elif "environ" in dotted:
+                emit("DT011", sub, (
+                    f"environment read ({dotted}) in a journal apply "
+                    "path; env can differ across restarts — resolve at "
+                    "write time and journal the value"
+                ))
+            elif "env_utils" in dotted and tail == "get":
+                emit("DT011", sub, (
+                    f"env knob read ({dotted}()) in a journal apply "
+                    "path; the knob can differ across restarts — "
+                    "resolve at write time and journal the value"
+                ))
+            elif isinstance(sub.func, ast.Name) and sub.func.id == "id":
+                emit("DT011", sub, (
+                    "id() in a journal apply path; object addresses "
+                    "differ every run — key by a journaled identifier"
+                ))
+            elif tail == "popitem":
+                emit("DT011", sub, (
+                    "dict.popitem() in a journal apply path; removal "
+                    "order is not part of the journaled state — pop a "
+                    "journaled key instead"
+                ))
+            elif tail == "emit":
+                emit("DT012", sub, (
+                    "event emission in a journal apply path; replay "
+                    "re-emits the event — guard on the store's "
+                    "replaying flag or emit outside the apply"
+                ))
+            elif tail == "call" and isinstance(
+                sub.func, ast.Attribute
+            ) and any(
+                hint in _dotted(sub.func.value).lower()
+                for hint in ("client", "rpc", "stub", "master")
+            ):
+                emit("DT012", sub, (
+                    "RPC send in a journal apply path; replay re-sends "
+                    "the message — replay must be a pure state "
+                    "reconstruction"
+                ))
+            elif dotted in ("os.kill", "os._exit", "sys.exit"):
+                emit("DT012", sub, (
+                    f"{dotted}() reachable in a journal apply path; a "
+                    "replaying master would re-execute the side effect "
+                    "— guard on the store's replaying flag"
+                ))
+            # -- callees to follow --
+            func = sub.func
+            if isinstance(func, ast.Attribute):
+                if func.attr not in _SKIP_CALLEES and not func.attr.startswith("__"):
+                    if isinstance(func.value, ast.Name) and (
+                        func.value.id == "self"
+                    ):
+                        callees.append(("self", func.attr))
+                    else:
+                        callees.append(("method", func.attr))
+            elif isinstance(func, ast.Name) and func.id[:1].isupper():
+                callees.append(("class", func.id))
+        if isinstance(sub, ast.For) and _is_set_iteration(sub.iter, info):
+            emit("DT011", sub, (
+                "iteration over a set in a journal apply path; set "
+                "order varies across runs — iterate a sorted() or "
+                "insertion-ordered container"
+            ))
+        if (
+            isinstance(sub, ast.AugAssign)
+            and isinstance(sub.op, (ast.Add, ast.Sub))
+            and isinstance(sub.target, ast.Attribute)
+            and isinstance(sub.target.value, ast.Name)
+            and sub.target.value.id == "self"
+            and any(h in sub.target.attr.lower() for h in _COUNTER_HINTS)
+        ):
+            emit("DT012", sub, (
+                f"counter self.{sub.target.attr} incremented in a "
+                "journal apply path; replay double-counts — derive the "
+                "counter from journaled state or guard on replaying"
+            ))
+        for child in ast.iter_child_nodes(sub):
+            rec(child)
+
+    for child in node.body:
+        rec(child)
+    return findings, callees
+
+
+def _is_set_iteration(iter_node: ast.AST, info) -> bool:
+    if isinstance(iter_node, ast.Set):
+        return True
+    if (
+        isinstance(iter_node, ast.Call)
+        and isinstance(iter_node.func, ast.Name)
+        and iter_node.func.id in ("set", "frozenset")
+    ):
+        return True
+    if (
+        isinstance(iter_node, ast.Attribute)
+        and isinstance(iter_node.value, ast.Name)
+        and iter_node.value.id == "self"
+        and iter_node.attr in info.get("set_attrs", ())
+    ):
+        return True
+    return False

@@ -10,6 +10,10 @@ from tools.dtlint.rules.dt005_atomic_write import NonAtomicDurableWrite
 from tools.dtlint.rules.dt006_env_registry import EnvRegistryRule
 from tools.dtlint.rules.dt007_chaos_sites import ChaosSiteRegistry
 from tools.dtlint.rules.dt008_rpc_contract import RpcContract
+from tools.dtlint.rules.dt009_guarded_by import GuardedBy
+from tools.dtlint.rules.dt010_lock_order import LockOrder
+from tools.dtlint.rules.dt011_replay_determinism import ReplayDeterminism
+from tools.dtlint.rules.dt012_replay_side_effects import ReplaySideEffects
 
 
 class Rule:
@@ -31,6 +35,10 @@ ALL_RULES = (
     EnvRegistryRule(),
     ChaosSiteRegistry(),
     RpcContract(),
+    GuardedBy(),
+    LockOrder(),
+    ReplayDeterminism(),
+    ReplaySideEffects(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -9,10 +9,12 @@ helpers, wait on an ``Event``/``Condition`` (``stop.wait(t)`` is
 interruptible; ``time.sleep(t)`` is not), or document why a fixed
 cadence is the contract.
 
-Fires on any direct ``time.sleep(...)`` lexically inside a ``while``
-body (nested function bodies and nested loops are judged on their own).
-Backoff sleeps (``backoff.sleep(...)``) and event waits
-(``stop.wait(...)``) do not fire.
+Fires on any direct ``time.sleep(...)`` or ``asyncio.sleep(...)``
+lexically inside a ``while``/``for``/``async for`` body (nested
+function bodies and nested loops are judged on their own) — a
+fixed-interval ``await asyncio.sleep(k)`` herd-synchronizes exactly
+like the threaded form. Backoff sleeps (``backoff.sleep(...)``) and
+event waits (``stop.wait(...)``) do not fire.
 """
 
 import ast
@@ -30,12 +32,14 @@ def _scan_body(body, *, findings, ctx, rule_id):
              ast.While, ast.For, ast.AsyncFor),
         ):
             continue  # nested scopes/loops are judged independently
-        if isinstance(node, ast.Call) and dotted_name(node.func) == "time.sleep":
+        if isinstance(node, ast.Call) and dotted_name(node.func) in (
+            "time.sleep", "asyncio.sleep"
+        ):
             findings.append(Finding(
                 rule_id, ctx.path, node.lineno, node.col_offset,
-                "'time.sleep' inside a while loop is a fixed-interval "
-                "busy-poll; use ExponentialBackoff/poll_until or an "
-                "interruptible Event.wait",
+                f"'{dotted_name(node.func)}' inside a loop is a "
+                "fixed-interval busy-poll; use ExponentialBackoff/"
+                "poll_until or an interruptible Event.wait",
             ))
         stack.extend(ast.iter_child_nodes(node))
 

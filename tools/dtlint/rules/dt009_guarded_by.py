@@ -1,0 +1,265 @@
+"""DT009 — guarded_by discipline: declared shared state needs its lock.
+
+The bug class: a subsystem grows a fast-path read (a metrics getter, a
+``__contains__``, a debug dump) that touches dict/list state the rest
+of the class only mutates under its lock — a torn read under free
+threading, and under the GIL still a stale/inconsistent multi-field
+read. PR 11's straggler detector shipped exactly this: ``metrics()``
+read the phase ledger lock-free while ``tick()`` rewrote it.
+
+The discipline is declarative, as in Clang thread-safety analysis:
+
+- a class opts in with a ``GUARDED_BY = {"_attr": "lock.name", ...}``
+  class attribute (value ``None`` documents a deliberately lock-free
+  attribute: immutable-after-init, or a monitor-external snapshot), or
+  with inline ``# dtlint: guarded_by(lock.name)`` comments on the
+  ``self._attr = ...`` line in ``__init__``;
+- every ``self._attr`` read or write outside a ``with`` that acquires
+  the named lock (resolved through the project lock registry, so
+  ``self._lock``/``self._cv``/mutation-shard helpers all count) is a
+  finding. ``__init__`` is exempt (publication happens-before);
+- a method whose *contract* is caller-holds-the-lock marks its ``def``
+  line with ``# dtlint: holds(lock.name)`` and is checked with that
+  lock pre-held;
+- drift gate: once a class opts in, any ``self._attr`` assigned a
+  mutable container in ``__init__`` but not declared is a finding —
+  annotations cannot silently rot as the class grows.
+
+Declared lock names are validated against the package lock registry
+and the ``LOCK_ORDER`` tiers; a typo is a finding, not a silent pass.
+"""
+
+import ast
+import re
+
+from tools.dtlint.core import Finding
+from tools.dtlint.project import local_lock_map
+
+_GUARDED_RE = re.compile(r"#\s*dtlint:\s*guarded_by\(([^)]*)\)")
+_HOLDS_RE = re.compile(r"#\s*dtlint:\s*holds\(([^)]*)\)")
+
+_MUTABLE_CTORS = ("dict", "list", "set", "deque", "defaultdict",
+                  "OrderedDict", "Counter")
+
+
+def _mutable_initializer(value) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+        return True
+    if isinstance(value, ast.Call):
+        func = value.func
+        name = func.attr if isinstance(func, ast.Attribute) else getattr(
+            func, "id", ""
+        )
+        return name in _MUTABLE_CTORS
+    return False
+
+
+class GuardedBy:
+    id = "DT009"
+    title = "guarded_by: declared shared state accessed without its lock"
+
+    def check(self, ctx, project):
+        guarded_marks = {}
+        holds_marks = {}
+        for lineno, text in enumerate(ctx.lines, 1):
+            m = _GUARDED_RE.search(text)
+            if m:
+                name = m.group(1).strip()
+                guarded_marks[lineno] = name or None
+            m = _HOLDS_RE.search(text)
+            if m:
+                holds_marks[lineno] = tuple(
+                    s.strip() for s in m.group(1).split(",") if s.strip()
+                )
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(
+                    ctx, project, node, guarded_marks, holds_marks
+                )
+
+    # ---------------- per-class ----------------
+    def _declarations(self, ctx, cls, guarded_marks):
+        """{attr: lock name or None} + the declaration lines."""
+        declared = {}
+        decl_lines = {}
+        for stmt in cls.body:
+            if not (
+                isinstance(stmt, (ast.Assign, ast.AnnAssign))
+            ):
+                continue
+            target = (
+                stmt.targets[0] if isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1 else getattr(stmt, "target", None)
+            )
+            if not (
+                isinstance(target, ast.Name) and target.id == "GUARDED_BY"
+                and isinstance(stmt.value, ast.Dict)
+            ):
+                continue
+            for key, value in zip(stmt.value.keys, stmt.value.values):
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str
+                ):
+                    lock = (
+                        value.value
+                        if isinstance(value, ast.Constant) else None
+                    )
+                    declared[key.value] = (
+                        lock if isinstance(lock, str) else None
+                    )
+                    decl_lines[key.value] = key.lineno
+        for sub in ast.walk(cls):
+            if not isinstance(sub, ast.Assign):
+                continue
+            mark = guarded_marks.get(sub.lineno)
+            if sub.lineno not in guarded_marks:
+                continue
+            for target in sub.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    declared[target.attr] = mark
+                    decl_lines[target.attr] = sub.lineno
+        return declared, decl_lines
+
+    def _known_lock_names(self, project, local):
+        locks = project.lock_registry()
+        known = set(local.values())
+        for cmap in locks["classes"].values():
+            known.update(cmap.values())
+        known.update(locks["modules"].values())
+        known.update(locks["wildcards"])
+        tiers, _ = project.declared_lock_order()
+        for tier in tiers:
+            known.update(tier)
+        known.update(project.canonical_shards())
+        return known
+
+    def _check_class(self, ctx, project, cls, guarded_marks, holds_marks):
+        declared, decl_lines = self._declarations(ctx, cls, guarded_marks)
+        if not declared:
+            return
+        local = local_lock_map(cls)
+        known = self._known_lock_names(project, local)
+        for attr, lock in sorted(declared.items()):
+            if lock is not None and lock not in known:
+                yield Finding(
+                    self.id, ctx.path, decl_lines.get(attr, cls.lineno), 0,
+                    f"guarded_by name '{lock}' for {cls.name}.{attr} "
+                    "matches no instrumented_lock in the package; fix "
+                    "the declaration or instrument the lock",
+                )
+        lock_attrs = set(
+            project.lock_registry()["classes"].get(
+                (ctx.path, cls.name), {}
+            )
+        ) | set(local)
+        # -- drift gate: mutable __init__ state must be declared --
+        init = next(
+            (
+                s for s in cls.body
+                if isinstance(s, ast.FunctionDef) and s.name == "__init__"
+            ),
+            None,
+        )
+        if init is not None:
+            for sub in ast.walk(init):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for target in sub.targets:
+                    if not (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        continue
+                    attr = target.attr
+                    if (
+                        attr not in declared
+                        and attr not in lock_attrs
+                        and _mutable_initializer(sub.value)
+                    ):
+                        yield Finding(
+                            self.id, ctx.path, sub.lineno, sub.col_offset,
+                            f"{cls.name} declares guarded state but "
+                            f"self.{attr} (mutable container) is not in "
+                            "its GUARDED_BY map; declare its lock, or "
+                            "None with a comment saying why it is "
+                            "lock-free",
+                        )
+        # -- access discipline --
+        for stmt in cls.body:
+            if not isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if stmt.name == "__init__":
+                continue
+            held = list(holds_marks.get(stmt.lineno, ()))
+            yield from self._walk_method(
+                ctx, project, cls, stmt, declared, held, holds_marks, local
+            )
+
+    def _walk_method(
+        self, ctx, project, cls, method, declared, held, holds_marks, local
+    ):
+        findings = []
+
+        def access(node, held):
+            if not (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in declared
+            ):
+                return
+            lock = declared[node.attr]
+            if lock is None or lock in held:
+                return
+            # A wildcard class guards with its one per-instance lock.
+            findings.append(Finding(
+                self.id, ctx.path, node.lineno, node.col_offset,
+                f"self.{node.attr} is guarded_by({lock}) but "
+                f"{cls.name}.{method.name} touches it without holding "
+                f"it (held: {', '.join(held) if held else 'no lock'})",
+            ))
+
+        def rec(node, held):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                # Deferred body: runs later, when the lexically held
+                # lock is gone. Checked with nothing held; a callback
+                # invoked under the lock marks its def line with
+                # ``# dtlint: holds(...)``.
+                inner_held = list(
+                    holds_marks.get(getattr(node, "lineno", -1), ())
+                )
+                for child in ast.iter_child_nodes(node):
+                    rec(child, inner_held)
+                return
+            access(node, held)
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    acquired.extend(
+                        project._resolve_lock_expr(
+                            item.context_expr, ctx.path, cls.name,
+                            local=local,
+                        )
+                    )
+                    # Arguments of the with-item expression (e.g.
+                    # ``self._locks.acquire(self._wanted)``) are
+                    # evaluated before the lock is held.
+                    rec(item.context_expr, held)
+                for child in node.body:
+                    rec(child, held + acquired)
+                return
+            for child in ast.iter_child_nodes(node):
+                rec(child, held)
+
+        for child in method.body:
+            rec(child, held)
+        yield from findings

@@ -1,0 +1,137 @@
+"""DT010 — whole-program lock-order: the merged graph must be acyclic.
+
+The bug class: an ABBA deadlock across subsystem locks. Runtime lockdep
+(PR 7) catches inversions *that a drill happens to execute*; DT010
+closes the gap by building one digraph from three sources and failing
+on any cycle:
+
+- **static** edges: every lexically nested ``with`` acquisition of two
+  resolvable ``instrumented_lock``\\ s, package-wide (mutation-shard
+  helpers like ``for_message``/``all`` resolve to the canonical shard
+  chain);
+- **declared** edges: the ``LOCK_ORDER`` tiers in
+  ``master/mutation_locks.py`` — the canonical shard order plus the
+  coarse-to-fine tier hierarchy. Declaring intent means a *single*
+  observed inversion closes a 2-cycle deterministically, instead of
+  needing both halves of an ABBA pair to appear;
+- **runtime** edges: ``lockdep.export_graph()`` JSON artifacts written
+  by chaos drills (``DLROVER_TPU_LOCKDEP_EXPORT``), merged via
+  ``--lockdep-graph`` so drill-observed orders join the static check.
+  Dynamic lock names collapse onto wildcard order classes
+  (``rdzv.<name>`` -> ``rdzv.*``), as in kernel lockdep.
+
+A second check enforces the durability contract from PR 10:
+``wait_durable(...)`` lexically inside any lock-holding ``with`` is a
+finding — the group-commit condvar is the innermost leaf of the
+hierarchy, and blocking on fsync latency while holding a coarser lock
+stalls every other writer of that subsystem.
+
+Static/declared cycle edges are anchored at their acquisition site (or
+the ``LOCK_ORDER`` declaration); runtime-artifact edges have no source
+line in the package, so they surface as *project-level* findings
+(:func:`project_level_findings`), which the CLI appends once per run.
+"""
+
+import ast
+
+from tools.dtlint.core import Finding, dotted_name, walk_no_functions
+
+_LOCKISH_CALL_ATTRS = ("for_message", "acquire", "all", "shard")
+
+
+def _lockish_with_desc(expr) -> str:
+    """Description when a with-item plainly acquires *some* lock."""
+    name = dotted_name(expr)
+    if name and "lock" in name.rsplit(".", 1)[-1].lower():
+        return name
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute):
+        if expr.func.attr in _LOCKISH_CALL_ATTRS:
+            recv = dotted_name(expr.func.value)
+            if "lock" in recv.rsplit(".", 1)[-1].lower():
+                return f"{recv}.{expr.func.attr}(...)"
+    return ""
+
+
+def _cycle_text(cycles) -> str:
+    return "; ".join(" -> ".join(c) for c in cycles)
+
+
+class LockOrder:
+    id = "DT010"
+    title = "lock-order: merged static+declared+runtime graph has a cycle"
+
+    def check(self, ctx, project):
+        edges = project.cyclic_edges()
+        if edges:
+            cycles = project.lock_cycles()
+            for (a, b), (origin, line, kind) in sorted(edges.items()):
+                if kind == "runtime":
+                    continue  # no source line: project-level finding
+                if not project.is_path(ctx.path, origin):
+                    continue
+                yield Finding(
+                    self.id, ctx.path, line, 0,
+                    f"{kind} lock-order edge {a} -> {b} participates in "
+                    f"a cycle ({_cycle_text(cycles)}); every path must "
+                    "acquire these locks in one global order",
+                )
+        yield from self._check_wait_durable(ctx)
+
+    def _check_wait_durable(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            desc = ""
+            for item in node.items:
+                desc = _lockish_with_desc(item.context_expr)
+                if desc:
+                    break
+            if not desc:
+                continue
+            for stmt in node.body:
+                for child in walk_no_functions(stmt):
+                    if (
+                        isinstance(child, ast.Call)
+                        and isinstance(child.func, ast.Attribute)
+                        and child.func.attr == "wait_durable"
+                    ):
+                        yield Finding(
+                            self.id, ctx.path, child.lineno,
+                            child.col_offset,
+                            f"wait_durable(...) while holding '{desc}'; "
+                            "the group-commit condvar is the innermost "
+                            "lock-order leaf — journal under the lock, "
+                            "wait for durability after releasing it",
+                        )
+
+
+def project_level_findings(project):
+    """DT010 findings with no package source line.
+
+    Runtime-artifact edges that close a cycle are anchored at the JSON
+    artifact path; unreadable artifacts are findings too (a drill that
+    silently contributes no edges would turn the merge into a no-op).
+    The CLI appends these once per run, after the per-file pass.
+    """
+    out = []
+    cycles = project.lock_cycles()
+    for (a, b), (origin, line, kind) in sorted(
+        project.cyclic_edges().items()
+    ):
+        if kind != "runtime":
+            continue
+        out.append(Finding(
+            "DT010", origin, line, 0,
+            f"runtime lock-order edge {a} -> {b} (recorded by a chaos "
+            f"drill) closes a cycle ({_cycle_text(cycles)}) against the "
+            "static/declared graph; a drill has executed an acquisition "
+            "order the code must not allow",
+        ))
+    for path in project.bad_runtime_artifacts():
+        out.append(Finding(
+            "DT010", path, 1, 0,
+            "unreadable lockdep export artifact (not the JSON "
+            "lockdep.export_graph() writes); re-run the drill or drop "
+            "--lockdep-graph",
+        ))
+    return out

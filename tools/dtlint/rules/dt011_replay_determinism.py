@@ -1,0 +1,46 @@
+"""DT011 — journal apply paths must be deterministic.
+
+The bug class: the PR-3 exactly-once guarantee rests on replay being a
+*pure function of the journal*. An apply handler that consults a wall
+clock, an env knob, entropy, or unordered-set iteration reconstructs a
+*different* state after failover than the one the journal recorded —
+silent divergence that no test catches until a failover lands in the
+wrong rendezvous round.
+
+Roots of the walk are declared in ``master/wal_records.py`` (the WAL
+record-tag registry, the journal's analogue of the DT008 RPC contract):
+each tag's apply handler, plus — for the ``"rpc"`` tag — every
+``_JOURNALED`` servicer handler method, since write-ahead RPC records
+replay through the full dispatch. ``_APPLY_THEN_LOG`` handlers are
+deliberately *not* roots: their recorded outcome replays instead of
+re-running them. From each root the project layer follows calls a
+bounded number of hops (see ``Project.replay_purity``); flagged inside:
+
+- clocks (``time.time``/``monotonic``/``perf_counter``…), ``random.*``,
+  ``uuid.*``, ``os.urandom``/``getpid``, hostname reads;
+- environment reads (``os.getenv``/``os.environ``, ``env_utils``
+  knob ``.get()`` calls) — knobs can differ across restarts;
+- ``id()``-keyed state and ``dict.popitem()``/set iteration, whose
+  order is not part of the journaled state.
+
+Branches that test the store's ``replaying`` flag are skipped: code
+that branches on replay has already handled it. Legit uses (e.g. a
+timestamp recorded *into* the journal at write time) carry a reasoned
+suppression on the flagged line.
+"""
+
+from tools.dtlint.core import Finding
+
+
+class ReplayDeterminism:
+    id = "DT011"
+    title = "nondeterminism reachable from a journal apply handler"
+
+    def check(self, ctx, project):
+        for f in project.replay_purity():
+            if f["rule"] == self.id and project.is_path(
+                ctx.path, f["path"]
+            ):
+                yield Finding(
+                    self.id, ctx.path, f["line"], f["col"], f["message"]
+                )

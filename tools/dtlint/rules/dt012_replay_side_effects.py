@@ -1,0 +1,105 @@
+"""DT012 — replay safety: no side effects, and a three-way tag contract.
+
+The bug class has two faces:
+
+**Side effects on replay.** Replay must reconstruct state, not re-run
+the world: an apply path that emits events, sends RPCs, kills
+processes, or bumps a monotonic counter does it *again* on every
+failover. (The event-sink replay guard exists precisely because early
+drills double-emitted the whole incident timeline.) The purity walk
+(same roots and bounds as DT011 — see ``master/wal_records.py`` and
+``Project.replay_purity``) flags ``emit(...)``, RPC ``.call(...)``,
+``os.kill``/``os._exit``/``sys.exit``, and ``self.<counter> += ...``
+outside a ``replaying`` guard.
+
+**Tag-registry agreement.** A record tag must exist on all three
+sides, or failover silently loses or dead-letters mutations:
+
+- the ``WAL_RECORDS`` registry row (``master/wal_records.py``);
+- at least one write site (``<store>.append(("tag", ...))`` anywhere
+  in the package);
+- a ``kind == "tag"`` branch of the replay dispatcher
+  (``JobMaster._recover_state``).
+
+Each mismatch is anchored on the side that has the evidence: an
+unwritten/unapplied registered tag at its registry row, an
+unregistered write at the write site, an unregistered apply branch at
+the dispatcher line — so one package run reports each exactly once,
+mirroring DT008.
+"""
+
+from tools.dtlint.core import Finding
+
+
+class ReplaySideEffects:
+    id = "DT012"
+    title = "replay-unsafe side effect or WAL tag-contract mismatch"
+
+    def check(self, ctx, project):
+        for f in project.replay_purity():
+            if f["rule"] == self.id and project.is_path(
+                ctx.path, f["path"]
+            ):
+                yield Finding(
+                    self.id, ctx.path, f["line"], f["col"], f["message"]
+                )
+        yield from self._check_tag_contract(ctx, project)
+
+    def _check_tag_contract(self, ctx, project):
+        wal = project.wal_contract()
+        registry = wal["registry"]
+        writes = wal["writes"]
+        applies = wal["applies"]
+        if not registry:
+            # No registry parsed: refuse to guess. The missing-file
+            # case surfaces when linting master.py below.
+            if applies and project.is_path(ctx.path, project.master_path):
+                yield Finding(
+                    self.id, ctx.path, min(applies.values()), 0,
+                    "replay dispatcher has kind branches but "
+                    "master/wal_records.py declares no WAL_RECORDS "
+                    "registry; the journal contract must be explicit",
+                )
+            return
+
+        if project.is_path(ctx.path, project.wal_records_path):
+            for tag, (lineno, _handlers) in sorted(registry.items()):
+                if tag not in writes:
+                    yield Finding(
+                        self.id, ctx.path, lineno, 0,
+                        f"WAL tag '{tag}' is registered but nothing in "
+                        "the package appends it; dead registry row or "
+                        "missing journal call",
+                    )
+                if tag not in applies:
+                    yield Finding(
+                        self.id, ctx.path, lineno, 0,
+                        f"WAL tag '{tag}' is registered but the replay "
+                        "dispatcher has no kind == branch for it; the "
+                        "record would be written and silently skipped "
+                        "on failover (lost mutation)",
+                    )
+
+        if project.is_path(ctx.path, project.master_path):
+            for tag, lineno in sorted(applies.items()):
+                if tag not in registry:
+                    yield Finding(
+                        self.id, ctx.path, lineno, 0,
+                        f"replay dispatcher handles kind == '{tag}' but "
+                        "the tag has no WAL_RECORDS registry row; "
+                        "declare it so the contract (and the purity "
+                        "walk roots) stay complete",
+                    )
+
+        for tag, sites in sorted(writes.items()):
+            if tag in registry:
+                continue
+            for path, lineno in sites:
+                if project.is_path(ctx.path, path):
+                    yield Finding(
+                        self.id, ctx.path, lineno, 0,
+                        f"journal write appends unregistered WAL tag "
+                        f"'{tag}'; add a WAL_RECORDS row (and a replay "
+                        "branch) or the record is silently dropped on "
+                        "failover",
+                    )
