@@ -1426,6 +1426,224 @@ def section_rescale():
     return out
 
 
+def section_preempt():
+    """Preemption notice vs no-notice for the same kill: two arms.
+
+    Notice arm (the preemption plane): a termination notice arrives
+    while a logical 4-world trains; the real PreemptionCoordinator
+    converts it at the next step boundary into an in-place shrink plan
+    the RescaleEngine applies to the LIVE state — the victim's kill
+    afterwards costs nothing. Steps of work lost: zero (the live state
+    carries across, nothing re-runs) — ``preempt_handled_loss_steps``
+    must stay < 1. The post-transition loss must be bit-identical to
+    the restart-path oracle (same batch, fresh world-3 trainer hydrated
+    from the pre-shrink state). The ledger books the window under the
+    dedicated ``preempt:handled`` cause.
+
+    No-notice arm: the same kill lands unannounced — survivors restart
+    from the last checkpoint in a fresh process (interpreter + imports
+    + rebuild + restore + recompile) and re-run every step since it:
+    the detect+rescale tax the notice arm avoids."""
+    import subprocess
+    import tempfile
+
+    import jax
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.accel import ParallelSpec
+    from dlrover_tpu.accel.accelerate import transfer_state
+    from dlrover_tpu.common.constants import RendezvousName
+    from dlrover_tpu.common import messages as msgs
+    from dlrover_tpu.master.preempt import PreemptionCoordinator
+    from dlrover_tpu.master.rendezvous import (
+        ElasticTrainingRendezvousManager,
+    )
+    from dlrover_tpu.master.rescale import RescaleCoordinator
+    from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn
+    from dlrover_tpu.observability.events import EventKind, JobEvent
+    from dlrover_tpu.observability.goodput import GoodputLedger
+    from dlrover_tpu.train.checkpoint import FlashCheckpointer, StorageType
+    from dlrover_tpu.train.elastic_trainer import ElasticTrainer
+    from dlrover_tpu.train.rescale import RescaleEngine
+
+    TRAIN = RendezvousName.TRAINING
+    gb, mb = 16, 4
+    cfg = GPTConfig.tiny()
+    model = GPT(cfg)
+    rng = np.random.default_rng(5)
+
+    def token_loss(module, params, b):
+        return loss_fn(module.apply({"params": params}, b), b)
+
+    def batch(n):
+        return rng.integers(
+            0, cfg.vocab_size, (n, cfg.max_seq_len)
+        ).astype(np.int32)
+
+    out = {"transition": "notice 4->3", "global_batch": gb,
+           "micro_batch": mb}
+    td = tempfile.mkdtemp(prefix="bench_preempt_")
+    try:
+        et = ElasticTrainer(gb, mb, world_size=4, rank=0)
+        result = et.prepare(
+            model, optax.adamw(3e-4), batch(mb), token_loss,
+            spec=ParallelSpec(data=1),
+        )
+        state = result.state
+        state, metrics = result.train_step(state, batch(et.local_batch_size))
+        float(metrics["loss"])
+        result.state = state
+        step0 = int(state["step"])
+        ck = FlashCheckpointer(td)
+        ck.save_checkpoint(step0, state, StorageType.DISK)
+        ck.wait_persisted(step0)
+        ck.close()
+        # Progress past the checkpoint: this is the work the no-notice
+        # arm re-runs and the notice arm keeps.
+        ahead = 3
+        for _ in range(ahead):
+            state, metrics = result.train_step(
+                state, batch(et.local_batch_size)
+            )
+        result.state = state
+        live_step = int(state["step"])
+        saved = jax.tree_util.tree_map(
+            lambda x: np.asarray(x).copy(), state
+        )
+
+        # ---- notice arm: the real coordinator path, live state ----
+        mgr = ElasticTrainingRendezvousManager(TRAIN)
+        mgr.update_rdzv_params(4, 4, waiting_timeout=10)
+        for r in range(4):
+            mgr.join_rendezvous(r, 1)
+        mgr.get_comm_world(0)
+        coord = RescaleCoordinator(rdzv_managers={TRAIN: mgr})
+        coord.set_batch_config(gb, mb)
+        coord.note_step(live_step)
+        for r in (0, 1, 2):
+            coord.set_capable(r)
+        pre = PreemptionCoordinator(
+            rdzv_managers={TRAIN: mgr}, rescale_coordinator=coord,
+        )
+        t_notice = time.time()
+        pre.on_notice(msgs.PreemptionNotice(
+            node_rank=3, deadline_ts=t_notice + 60, grace_s=60.0,
+            source="metadata", reason="bench drill",
+        ))
+        pre.note_step(live_step)  # the step boundary issues the plan
+        plan = coord.get_plan(TRAIN, 0, 1)
+        assert plan.exists, "preemption notice produced no shrink plan"
+        engine = RescaleEngine(et)
+        engine.round = plan.old_round
+        tr = engine.apply(plan, state=state)
+        assert tr.ok, f"in-place preempt shrink failed: {tr.error}"
+        out["preempt_in_place_s"] = round(tr.wall_s, 3)
+        # The kill lands after the shrink: a non-event.
+        assert pre.on_node_removed(3) is True
+        # Zero steps of work lost: the live state carried across.
+        out["preempt_handled_loss_steps"] = live_step - int(tr.state["step"])
+        assert out["preempt_handled_loss_steps"] < 1, out
+
+        # Bit-identity vs the restart-path oracle: same batch, fresh
+        # world-3 trainer hydrated from the pre-shrink state.
+        b8 = batch(et.local_batch_size)
+        s_ip, m_ip = et.result.train_step(tr.state, b8)
+        et_r = ElasticTrainer(gb, mb, world_size=3, rank=0)
+        et_r.prepare(
+            model, optax.adamw(3e-4), batch(mb), token_loss,
+            spec=ParallelSpec(data=1),
+        )
+        rstate = transfer_state(saved, et_r.result.shardings)
+        s_rs, m_rs = et_r.result.train_step(rstate, b8)
+        out["loss_bitwise_identical"] = (
+            float(m_ip["loss"]) == float(m_rs["loss"])
+        )
+        assert out["loss_bitwise_identical"], (
+            float(m_ip["loss"]), float(m_rs["loss"]),
+        )
+
+        # Ledger attribution: the whole window lands under the distinct
+        # preempt:handled cause, closed by the next step — not under
+        # worker-failure/restart and not double-booked as plain rescale.
+        ledger = GoodputLedger(now=t_notice - 1.0)
+        ledger.note_step(live_step, ts=t_notice - 0.5)
+        ledger.ingest(JobEvent(
+            kind=EventKind.PREEMPT_NOTICE, node_id=3, ts=t_notice,
+            args={"source": "metadata"},
+        ))
+        ledger.ingest(JobEvent(
+            kind=EventKind.RESCALE_PLAN, node_id=3, ts=t_notice + 0.01,
+            args={"plan_id": int(plan.plan_id)},
+        ))
+        ledger.ingest(JobEvent(
+            kind=EventKind.PREEMPT_HANDLED, node_id=3,
+            ts=t_notice + 0.01, args={"plan_id": int(plan.plan_id)},
+        ))
+        ledger.note_step(live_step + 1, ts=t_notice + 0.01 + tr.wall_s)
+        s = ledger.summary(now=t_notice + 0.01 + tr.wall_s)
+        assert "preempt:handled" in s["incidents_by_cause"], s
+        assert "rescale" not in s["incidents_by_cause"], s
+        out["goodput_preempt_downtime_s"] = round(
+            s["downtime_by_cause_s"].get("preempt:handled", -1.0), 3
+        )
+
+        # ---- no-notice arm: unannounced kill, full restart tax ----
+        code = (
+            "import numpy as np, jax, optax\n"
+            "from dlrover_tpu.accel import ParallelSpec\n"
+            "from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn\n"
+            "from dlrover_tpu.train.elastic_trainer import ElasticTrainer\n"
+            "from dlrover_tpu.train.checkpoint import FlashCheckpointer\n"
+            "cfg = GPTConfig.tiny(); model = GPT(cfg)\n"
+            f"sample = np.zeros(({mb}, cfg.max_seq_len), dtype=np.int32)\n"
+            "def token_loss(module, params, b):\n"
+            "    return loss_fn(module.apply({'params': params}, b), b)\n"
+            f"et = ElasticTrainer({gb}, {mb}, world_size=3, rank=0)\n"
+            "res = et.prepare(model, optax.adamw(3e-4), sample,\n"
+            "                 token_loss, spec=ParallelSpec(data=1))\n"
+            f"ck = FlashCheckpointer({td!r})\n"
+            "step, state = ck.load_checkpoint(res.state)\n"
+            f"assert step == {step0}, step\n"
+            "b = np.zeros((et.local_batch_size, cfg.max_seq_len),\n"
+            "             dtype=np.int32)\n"
+            f"for _ in range({live_step} - step):\n"
+            "    state, metrics = res.train_step(state, b)\n"
+            "float(metrics['loss'])\n"
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("DLROVER_TPU_MASTER_ADDR", None)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env["PYTHONPATH"] = os.pathsep.join(
+            [repo] + [p for p in env.get("PYTHONPATH", "").split(
+                os.pathsep) if p and "axon" not in p]
+        )
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, timeout=600,
+        )
+        if r.returncode == 0:
+            out["no_notice_restart_s"] = round(
+                time.perf_counter() - t0, 3
+            )
+            out["preempt_no_notice_loss_steps"] = live_step - step0
+            out["notice_speedup_x"] = round(
+                out["no_notice_restart_s"]
+                / max(out["preempt_in_place_s"], 1e-6), 1
+            )
+        else:
+            log(f"bench[preempt]: no-notice arm rc={r.returncode} "
+                f"{r.stderr[-400:]}")
+    finally:
+        import shutil
+
+        shutil.rmtree(td, ignore_errors=True)
+    log(f"bench[preempt]: {out}")
+    return out
+
+
 def goodput_json_main(out_path=None) -> int:
     """``bench.py --goodput-json [PATH]`` — kill-injection drill whose
     artifact is the MASTER's own goodput ledger, not wall-clock ratios.
@@ -1533,10 +1751,10 @@ def main():
     # budget guard sheds the tail sections, not the headline.
     default_sections = (
         "small,large,llama,longctx,goodput,ckpt_io,ckpt_dedup,"
-        "opt_shard,rescale,straggler,master_scale,medium,dtlint"
+        "opt_shard,rescale,preempt,straggler,master_scale,medium,dtlint"
         if on_tpu else
-        "small,goodput,ckpt_io,ckpt_dedup,opt_shard,rescale,straggler,"
-        "master_scale,dtlint"
+        "small,goodput,ckpt_io,ckpt_dedup,opt_shard,rescale,preempt,"
+        "straggler,master_scale,dtlint"
     )
     sections = os.getenv(
         "DLROVER_TPU_BENCH_SECTIONS", default_sections
@@ -1578,6 +1796,8 @@ def main():
                 extra["goodput"] = section_goodput()
             elif name == "rescale":
                 extra["rescale"] = section_rescale()
+            elif name == "preempt":
+                extra["preempt"] = section_preempt()
             elif name == "straggler":
                 extra["straggler"] = section_straggler()
             elif name == "master_scale":

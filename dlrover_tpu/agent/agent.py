@@ -276,6 +276,17 @@ class ElasticTrainingAgent:
             sink=self._note_probe if self._beat_mode else None,
         )
         self._link_probe.start()
+        # Preemption watcher: notice sources -> journaled report + grace
+        # flush, so the master can shrink in place before the kill.
+        from dlrover_tpu.agent.preempt import PreemptionWatcher
+
+        self._preempt_watcher = PreemptionWatcher(
+            client=self._client,
+            node_rank=self._config.node_rank,
+            flush_fn=self._save_shm_to_storage,
+            kill_fn=self._kill_all_workers,
+        )
+        self._preempt_watcher.start()
 
     def run(self) -> int:
         self._start_heartbeats()
@@ -502,6 +513,18 @@ class ElasticTrainingAgent:
 
                 threading.Timer(resume_after, _resume).start()
 
+    def _kill_all_workers(self):
+        """Node-level kill, as the platform delivers it (chaos preempt
+        drills): every live worker group gets SIGKILL at once."""
+        logger.warning("CHAOS: preemption kill of all local workers")
+        for proc in self._workers:
+            if proc.poll() is not None:
+                continue
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
     def _monitor_workers(self, outcome: RendezvousOutcome) -> str:
         while not self._stopped.is_set():
             # Interruptible: stop() wakes the monitor immediately
@@ -515,9 +538,17 @@ class ElasticTrainingAgent:
                     (i, c) for i, c in enumerate(codes) if c not in (None, 0)
                 ]
                 logger.error("worker processes failed: %s", failed)
+                # An exit inside an active preemption window is the
+                # announced kill, not a crash — the ledger/timeline
+                # book it under preempt:handled instead.
+                watcher = getattr(self, "_preempt_watcher", None)
+                cause = (
+                    "preempt" if watcher is not None and watcher.active
+                    else "crash"
+                )
                 emit(
                     EventKind.WORKER_FAIL, codes=failed,
-                    restart=self._restart_count,
+                    restart=self._restart_count, cause=cause,
                 )
                 self._client.report_failure(
                     f"worker exit codes {failed}",
@@ -676,7 +707,8 @@ class ElasticTrainingAgent:
     def stop(self):
         self._stopped.set()
         for attr in ("_heartbeat_task", "_resource_monitor",
-                     "_training_monitor", "_config_tuner", "_link_probe"):
+                     "_training_monitor", "_config_tuner", "_link_probe",
+                     "_preempt_watcher"):
             task = getattr(self, attr, None)
             if task is not None:
                 task.stop()

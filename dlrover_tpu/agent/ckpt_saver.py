@@ -312,8 +312,18 @@ class CommonDirCheckpointSaver:
     # ------------- crash / SIGTERM flush -------------
     def save_shm_to_storage(self, commit_timeout: float = 60.0):
         """Persist the last memory snapshot if it is newer than anything on
-        disk. Called by the agent on worker failure, membership change and
-        SIGTERM (parity: ``ckpt_saver.py:566``)."""
+        disk. Called by the agent on worker failure, membership change,
+        SIGTERM, and proactively inside a preemption grace window
+        (parity: ``ckpt_saver.py:566``). Raises the same ``busy`` signal
+        as the per-step persist path so the LinkProbe skips its samples
+        instead of racing the flush for I/O bandwidth."""
+        self._persisting += 1
+        try:
+            self._save_shm_to_storage(commit_timeout)
+        finally:
+            self._persisting -= 1
+
+    def _save_shm_to_storage(self, commit_timeout: float):
         metas = {
             r: m for r, m in self._local_metas().items() if m.persist
         }

@@ -206,8 +206,10 @@ class LinkProbe:
     never journaled) for the straggler detector's per-worker link
     profile. The probe is rate-limited by construction and *pauses
     under checkpoint pressure*: while the saver has a persist round in
-    flight the sample is skipped, so probe I/O never contends with
-    checkpoint I/O on the same disks and links.
+    flight — a periodic persist or the proactive preemption grace-window
+    flush, both raise the same busy signal — the sample is skipped, so
+    probe I/O never contends with checkpoint I/O on the same disks and
+    links.
 
     The ``probe.link degrade`` chaos site scales measured bandwidth
     down (and inflates RTT) by ``args["factor"]`` — the deterministic

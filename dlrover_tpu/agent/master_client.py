@@ -170,6 +170,21 @@ class MasterClient:
             m.CkptWriterElect(group=group, epoch=epoch, rank=rank)
         )
 
+    # ---------------- preemption plane ----------------
+    def report_preemption_notice(self, node_rank: int, deadline_ts: float,
+                                 grace_s: float, source: str,
+                                 reason: str = "") -> m.Response:
+        """Report a known-ahead termination notice for this node.
+
+        Journaled + deduped on the master: retries and multiple sources
+        firing for the same node collapse to one armed notice."""
+        return self._call(
+            m.PreemptionNotice(
+                node_rank=node_rank, deadline_ts=deadline_ts,
+                grace_s=grace_s, source=source, reason=reason,
+            )
+        )
+
     def report_rdzv_params(self, min_nodes: int, max_nodes: int,
                            waiting_timeout: float, node_unit: int):
         return self._call(

@@ -1,0 +1,238 @@
+"""Agent-side preemption watcher: notice sources -> one armed report.
+
+The infrastructure announces a preemption ahead of the kill — a notice
+file appearing, an env flip, a metadata server flagging the VM, or (in
+drills) the ``preempt.notice`` chaos site. This watcher polls every
+source on one cadence and, the first time any of them fires:
+
+1. reports a journaled ``PreemptionNotice`` RPC to the master (which
+   hands off writer leases and shrinks at the next step boundary);
+2. flushes the shm checkpoint snapshot to storage while the grace clock
+   runs — the proactive twin of the crash flush, and it raises the
+   saver's busy signal so the LinkProbe skips samples instead of racing
+   the grace-window snapshot;
+3. arms an ``active`` flag + deadline the agent monitor reads to
+   classify a worker exit during the window as ``cause="preempt"``
+   rather than a crash.
+
+A notice whose deadline passes with the workers still alive is a false
+alarm: the watcher disarms locally (the master cancels on its own
+clock), so a much later crash is not misclassified as preemption. The
+source that raised the false alarm is latched as *spent* until its
+evidence clears — a notice file that keeps sitting on disk or an env
+flag nobody unset must not re-arm a fresh notice/cancel cycle every
+window; deleting and re-creating the file (a genuinely new notice)
+re-arms.
+"""
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from dlrover_tpu.chaos.injector import fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.log import logger
+
+
+class PreemptionWatcher:
+    """Polls the pluggable notice sources and arms exactly one notice.
+
+    ``metadata_fn`` is the metadata-server shim: any callable returning
+    ``None`` (no notice) or a dict with optional ``deadline_ts``,
+    ``grace_s`` and ``reason`` keys — tests and real cloud metadata
+    pollers plug in the same way. ``kill_fn`` (chaos drills) receives
+    no arguments and must kill the local workers like the platform
+    would.
+    """
+
+    def __init__(
+        self,
+        client=None,
+        node_rank: int = 0,
+        metadata_fn: Optional[Callable[[], Optional[Dict]]] = None,
+        flush_fn: Optional[Callable[[], None]] = None,
+        kill_fn: Optional[Callable[[], None]] = None,
+    ):
+        self._client = client
+        self._node_rank = node_rank
+        self._metadata_fn = metadata_fn
+        self._flush_fn = flush_fn
+        self._kill_fn = kill_fn
+        self._lock = threading.Lock()
+        self._active = False
+        self._deadline_ts = 0.0
+        self._source = ""
+        self._task = None
+        self._kill_timer: Optional[threading.Timer] = None
+        # Sources whose notice already expired as a false alarm and
+        # whose evidence has not cleared since (poll thread only).
+        self._spent = set()
+
+    # ---------------- lifecycle ----------------
+    def start(self):
+        from dlrover_tpu.common.periodic import PeriodicTask
+
+        interval = env_utils.PREEMPT_POLL_INTERVAL_S.get()
+        if not env_utils.PREEMPT.get() or interval <= 0:
+            return
+        self._task = PeriodicTask(
+            self.poll_once, interval, "preempt-watcher"
+        )
+        self._task.start()
+
+    def stop(self):
+        if self._task is not None:
+            self._task.stop()
+            self._task = None
+        if self._kill_timer is not None:
+            self._kill_timer.cancel()
+            self._kill_timer = None
+
+    # ---------------- monitor-facing state ----------------
+    @property
+    def active(self) -> bool:
+        """True while a reported notice's window is open — the agent
+        monitor classifies a worker exit in this state as preemption."""
+        with self._lock:
+            if not self._active:
+                return False
+            slack = env_utils.PREEMPT_FALSE_ALARM_S.get()
+            if (
+                self._deadline_ts > 0
+                and time.time() > self._deadline_ts + slack
+            ):
+                # Deadline long gone, workers still alive: false alarm.
+                # Disarm so a later real crash is not misclassified;
+                # the master cancels on its own clock. Latch the source
+                # as spent so its lingering evidence (a notice file
+                # still on disk, an env flag nobody unset) cannot churn
+                # out a fresh notice/cancel cycle every window.
+                self._active = False
+                self._spent.add(self._source)
+                return False
+            return True
+
+    @property
+    def deadline_ts(self) -> float:
+        with self._lock:
+            return self._deadline_ts
+
+    # ---------------- sources ----------------
+    def _check_file(self) -> Optional[Dict]:
+        path = env_utils.PREEMPT_NOTICE_FILE.get()
+        if not path:
+            return None
+        notice: Dict = {"reason": "notice file"}
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line.startswith("deadline="):
+                        notice["deadline_ts"] = float(
+                            line.split("=", 1)[1]
+                        )
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            logger.warning("unreadable preempt notice file %s: %s", path, e)
+        return notice
+
+    def _check_env(self) -> Optional[Dict]:
+        if env_utils.PREEMPT_NOW.get():
+            return {"reason": "env flip"}
+        return None
+
+    def _check_metadata(self) -> Optional[Dict]:
+        if self._metadata_fn is None:
+            return None
+        try:
+            return self._metadata_fn()
+        except Exception as e:
+            logger.warning("preempt metadata shim failed: %s", e)
+            return None
+
+    def _check_chaos(self) -> Optional[Dict]:
+        ev = fault_hit(
+            ChaosSite.PREEMPT_NOTICE, detail=str(self._node_rank)
+        )
+        if ev is None or ev.kind != "notice":
+            return None
+        notice: Dict = {"reason": "chaos drill"}
+        window = float(ev.args.get("window_s", 0))
+        if window > 0:
+            notice["grace_s"] = window
+        kill_after = ev.args.get("kill_after_s")
+        if kill_after is not None and float(kill_after) >= 0:
+            notice["kill_after_s"] = float(kill_after)
+        return notice
+
+    # ---------------- the poll ----------------
+    def poll_once(self):
+        """One pass over every source; arms at most one notice."""
+        if self.active:
+            return
+        for source, check in (
+            ("file", self._check_file),
+            ("env", self._check_env),
+            ("metadata", self._check_metadata),
+            ("chaos", self._check_chaos),
+        ):
+            notice = check()
+            if notice is None:
+                # Evidence cleared (file deleted, env unset): the next
+                # time this source fires it is a genuinely new notice.
+                self._spent.discard(source)
+            elif source not in self._spent:
+                self._arm(source, notice)
+                return
+
+    def _arm(self, source: str, notice: Dict):
+        kill_after = notice.get("kill_after_s")
+        if kill_after is not None and float(kill_after) <= 0:
+            # Kill-before-window variant: the kill beats the notice, so
+            # there is no window to use and nothing to report — this IS
+            # the ordinary crash path, and nothing double-handles it.
+            if self._kill_fn is not None:
+                self._kill_fn()
+            return
+        grace = float(
+            notice.get("grace_s", env_utils.PREEMPT_GRACE_S.get())
+        )
+        deadline = float(
+            notice.get("deadline_ts", time.time() + grace)
+        )
+        with self._lock:
+            self._active = True
+            self._deadline_ts = deadline
+            self._source = source
+        logger.warning(
+            "preemption notice (%s): %s; deadline in %.1fs",
+            source, notice.get("reason", ""), deadline - time.time(),
+        )
+        if self._client is not None:
+            try:
+                self._client.report_preemption_notice(
+                    node_rank=self._node_rank, deadline_ts=deadline,
+                    grace_s=grace, source=source,
+                    reason=str(notice.get("reason", "")),
+                )
+            except Exception:
+                logger.exception("preemption notice report failed; the "
+                                 "grace flush still runs locally")
+        # The grace-window flush: the victim persists its own shm
+        # snapshot while still alive, so survivors (and its eventual
+        # replacement) restore without data loss even if the kill beats
+        # the next checkpoint. Raises the saver busy signal -> the
+        # LinkProbe skips rather than racing the snapshot.
+        if self._flush_fn is not None:
+            try:
+                self._flush_fn()
+            except Exception:
+                logger.exception("preemption grace flush failed")
+        if kill_after is not None and self._kill_fn is not None:
+            self._kill_timer = threading.Timer(
+                float(kill_after), self._kill_fn
+            )
+            self._kill_timer.daemon = True
+            self._kill_timer.start()

@@ -47,6 +47,12 @@ class ChaosSite:
     #: Agent LinkProbe sample (degrade: scale measured bandwidth down /
     #: inflate RTT by args["factor"]), detail = probe sequence number.
     PROBE_LINK = "probe.link"
+    #: Agent preemption-watcher poll (notice): deliver a termination
+    #: notice with args["window_s"] grace, then kill the workers
+    #: args["kill_after_s"] seconds later (0 = kill before the window
+    #: opens; omit/negative = notice without a kill — false alarm).
+    #: Detail = node rank.
+    PREEMPT_NOTICE = "preempt.notice"
     #: Reserved for unit drills of the injector mechanics themselves
     #: (schedules, journaling): never instrumented in product code.
     TEST_PROBE = "test.probe"

@@ -357,6 +357,37 @@ RESCALE_POLL_INTERVAL_S = ENV.float(
     "Agent/worker poll interval for an active rescale plan after their "
     "round goes stale.")
 
+# ---------------- preemption plane ----------------
+PREEMPT = ENV.bool(
+    "DLROVER_TPU_PREEMPT", True,
+    "Enable the preemption plane: the agent watches notice sources and "
+    "reports a PreemptionNotice so the master can flush, hand off the "
+    "checkpoint writer lease, and shrink in place before the kill lands. "
+    "0/false/off falls back to the reactive detect+rescale path.")
+PREEMPT_NOTICE_FILE = ENV.path(
+    "DLROVER_TPU_PREEMPT_NOTICE_FILE", "",
+    "Path the preemption watcher polls for a termination notice; the "
+    "file appearing (any content; optional 'deadline=<unix_ts>' line) "
+    "counts as a notice for this node. Empty disables the file source.")
+PREEMPT_NOW = ENV.bool(
+    "DLROVER_TPU_PREEMPT_NOW", False,
+    "Env-flip notice source: flipping this to 1 in the agent's "
+    "environment is treated as a preemption notice with the default "
+    "grace window. Meant for drills and operator-initiated drains.")
+PREEMPT_POLL_INTERVAL_S = ENV.float(
+    "DLROVER_TPU_PREEMPT_POLL_INTERVAL_S", 1.0,
+    "Seconds between preemption-watcher polls of the notice sources; "
+    "small because the grace window is short. 0 disables the watcher.")
+PREEMPT_GRACE_S = ENV.float(
+    "DLROVER_TPU_PREEMPT_GRACE_S", 30.0,
+    "Default grace window in seconds assumed when a notice source does "
+    "not announce its own deadline (env flip, bare notice file).")
+PREEMPT_FALSE_ALARM_S = ENV.float(
+    "DLROVER_TPU_PREEMPT_FALSE_ALARM_S", 5.0,
+    "Seconds past a notice's deadline the master waits before declaring "
+    "a false alarm: the node is still alive, so the writer lease "
+    "reverts and the notice cancels with no restart.")
+
 # ---------------- link probe / straggler attribution ----------------
 PROBE_INTERVAL = ENV.float(
     "DLROVER_TPU_PROBE_INTERVAL", 30.0,

@@ -408,6 +408,36 @@ class CkptWriterLease:
         return self.owner_rank >= 0
 
 
+# ---------------- preemption plane ----------------
+
+
+@dataclass
+class PreemptionNotice(BaseRequest):
+    """A known-ahead termination notice for one node.
+
+    The agent's preemption watcher reports this as soon as any notice
+    source fires (notice file, env flip, metadata shim, chaos drill); the
+    deadline is the wall-clock instant the infrastructure promised to
+    kill the node. Journaled — a master failover mid-notice must replay
+    the pending notice exactly once so the proactive shrink and writer
+    handoff are not lost or doubled. Duplicate reports for the same node
+    dedupe inside the coordinator (the first deadline wins).
+    """
+
+    journaled = True
+
+    #: rank of the node the notice targets
+    node_rank: int = -1
+    #: wall-clock deadline (time.time()) the kill was promised for
+    deadline_ts: float = 0.0
+    #: grace window length in seconds, as announced by the source
+    grace_s: float = 0.0
+    #: which watcher source fired: "file" | "env" | "metadata" | "chaos"
+    source: str = ""
+    #: free-form reason string from the notice source
+    reason: str = ""
+
+
 # ---------------- sync service ----------------
 
 

@@ -57,6 +57,17 @@ class KVStoreService:
         with self._lock:
             self._store.pop(key, None)
 
+    def scan(self, prefix: str) -> Dict[str, bytes]:
+        """Snapshot of every entry whose key starts with `prefix`, in
+        sorted key order (deterministic for journal-replayed callers —
+        the preemption plane walks writer leases with it)."""
+        with self._lock:
+            return {
+                k: self._store[k]
+                for k in sorted(self._store)
+                if k.startswith(prefix)
+            }
+
     def clear(self):
         with self._lock:
             self._store.clear()

@@ -35,6 +35,7 @@ from dlrover_tpu.master.rendezvous import (
     DeviceCheckRendezvousManager,
     ElasticTrainingRendezvousManager,
 )
+from dlrover_tpu.master.preempt import PreemptionCoordinator
 from dlrover_tpu.master.rescale import RescaleCoordinator
 from dlrover_tpu.master.servicer import MasterServicer, create_master_service
 from dlrover_tpu.master.shard.task_manager import TaskManager
@@ -130,6 +131,16 @@ class JobMaster:
             rdzv_managers=self.rdzv_managers,
             state_store=self.state_store,
         )
+        # Preemption plane: a known-ahead termination notice becomes a
+        # planned transition — writer-lease handoff on arrival, shrink
+        # at the next step boundary, clean cancel on false alarm.
+        self.preempt = PreemptionCoordinator(
+            rdzv_managers=self.rdzv_managers,
+            kv_store=self.kv_store,
+            job_manager=self.job_manager,
+            rescale_coordinator=self.rescale,
+            state_store=self.state_store,
+        )
         # Per-subsystem mutation shards replace the old global mutation
         # lock; the snapshot quiesce holds ALL of them (in canonical
         # order) so no journal record can land past a rotation it isn't
@@ -148,6 +159,7 @@ class JobMaster:
             state_store=self.state_store,
             observability=self.observability,
             rescale_coordinator=self.rescale,
+            preempt_coordinator=self.preempt,
             mutation_locks=self.mutation_locks,
         )
         self._server = create_master_service(port, self.servicer)
@@ -216,6 +228,7 @@ class JobMaster:
             "speed": self.speed_monitor.checkpoint(),
             "events": self.observability.event_log.export_state(),
             "rescale": self.rescale.checkpoint(),
+            "preempt": self.preempt.checkpoint(),
         }
 
     def _recover_state(self):
@@ -248,6 +261,7 @@ class JobMaster:
                     # ledger rebuilds its incident history too.
                     self.observability.event_log.restore_state(ev_state)
                 self.rescale.restore(state.get("rescale", {}))
+                self.preempt.restore(state.get("preempt", {}))
             for rec in records:
                 try:
                     kind = rec[0]
@@ -283,6 +297,9 @@ class JobMaster:
                     elif kind == "rescale":
                         _, payload, ts = rec
                         self.rescale.replay(payload)
+                    elif kind == "preempt":
+                        _, payload, ts = rec
+                        self.preempt.replay(payload)
                     else:
                         logger.warning("skipping unknown journal record %r",
                                        kind)
@@ -387,6 +404,7 @@ class JobMaster:
                     # re-firing every pass.
                     self.speed_monitor.reset_worker_reports()
                 self.rescale.tick()
+                self.preempt.tick()
                 self.straggler_detector.tick()
                 if self.state_store is not None:
                     self.state_store.maybe_snapshot(self._collect_state)
@@ -429,6 +447,8 @@ class JobMaster:
         self.speed_monitor.remove_worker(node_id)
         self.straggler_detector.remove_worker(node_id)
         self.metric_collector.remove_node(node_id)
+        # An announced departure must not later read as a false alarm.
+        self.preempt.on_node_removed(node_id)
         if node_id in old_world:
             # Survivors of the shrunken world may transition in place
             # instead of restarting (no-op during journal replay and

@@ -55,6 +55,7 @@ LOCK_ORDER: Tuple[Tuple[str, ...], ...] = (
         "master.node_manager",
         "master.kv_store",
         "master.rescale",
+        "master.preempt",
         "master.sync_service",
         "master.straggler",
         "master.job_collector",
@@ -83,6 +84,9 @@ _SHARDS_BY_TYPE: Dict[type, Tuple[str, ...]] = {
     # Failure handling spans the node registry, every rendezvous, task
     # reclaim, and the rescale coordinator (rdzv shard).
     m.NodeFailure: ("tasks", "nodes", "rdzv"),
+    # A preemption notice pre-elects writer leases (kv) and flags the
+    # victim in the node registry (nodes).
+    m.PreemptionNotice: ("kv", "nodes"),
     m.RescaleAck: ("rdzv",),
     m.EventReport: ("events",),
 }

@@ -67,6 +67,7 @@ class JobManager:
     #: inside ours.
     GUARDED_BY = {
         "_nodes": "master.node_manager",
+        "_preempting": "master.node_manager",
         "_event_callbacks": None,
     }
 
@@ -98,6 +99,11 @@ class JobManager:
         self.resource_manager = resource_manager
         self._stopped = False
         self._event_callbacks = []
+        # Node ids with an active preemption notice: their upcoming exit
+        # is planned, so process_error must not treat it as a crash
+        # (no relaunch, no OOM escalation). Set/cleared by the
+        # PreemptionCoordinator.
+        self._preempting: set = set()
         for i in range(node_num):
             node = Node(
                 NodeType.WORKER, i, max_relaunch_count=max_relaunch_count
@@ -180,9 +186,33 @@ class JobManager:
                 if node.status in (NodeStatus.INITIAL, NodeStatus.PENDING):
                     node.update_status(NodeStatus.RUNNING)
 
+    # ---------------- preemption plane ----------------
+    def mark_preempting(self, node_id: int):
+        """Flag a node as under an active preemption notice: its coming
+        exit is a planned departure, not a crash."""
+        with self._lock:
+            self._preempting.add(int(node_id))
+
+    def clear_preempting(self, node_id: int):
+        with self._lock:
+            self._preempting.discard(int(node_id))
+
+    def is_preempting(self, node_id: int) -> bool:
+        with self._lock:
+            return int(node_id) in self._preempting
+
     def process_error(
         self, node_id: int, restart_count: int, error_data: str, level: str
     ) -> bool:
+        if self.is_preempting(node_id):
+            # Planned departure: the infrastructure announced this exit
+            # ahead of time and the preemption plane already flushed and
+            # handed off. No relaunch decision, no OOM escalation —
+            # the node registry just records the preempted status.
+            self.update_node_status(
+                node_id, NodeStatus.FAILED, NodeExitReason.PREEMPTED
+            )
+            return False
         relaunch_node = self._error_monitor.process_error(
             node_id, restart_count, error_data, level
         )
@@ -262,6 +292,7 @@ class JobManager:
                     "relaunch_count": n.relaunch_count,
                     "relaunchable": n.relaunchable,
                     "max_relaunch_count": n.max_relaunch_count,
+                    "preempting": n.id in self._preempting,
                 }
                 for n in self._nodes.values()
             ]
@@ -269,7 +300,10 @@ class JobManager:
     def restore_nodes(self, dumped: List[Dict]):
         with self._lock:
             self._nodes.clear()
+            self._preempting.clear()
             for d in dumped:
+                if d.get("preempting"):
+                    self._preempting.add(int(d["id"]))
                 node = Node(
                     d["type"], d["id"], rank_index=d.get("rank_index"),
                     name=d.get("name", ""),

@@ -1,0 +1,380 @@
+"""Master-side preemption plane: known-ahead failures as planned moves.
+
+Production TPU fleets run predominantly on preemptible capacity, where
+the common failure is not a surprise SIGKILL but a termination notice
+with a 30-120 s grace window. Before this coordinator the framework only
+reacted after death, paying the full detect+rescale tax. The preemption
+plane instead treats the notice as the start of a planned transition:
+
+- the victim's agent reports a journaled
+  :class:`~dlrover_tpu.common.messages.PreemptionNotice` (and flushes its
+  own shm snapshot to storage while the grace clock runs);
+- :meth:`PreemptionCoordinator.on_notice` pre-elects a replacement
+  checkpoint writer for every PR-9 lease the victim owns, so the next
+  checkpoint epoch never blocks on a dead writer;
+- at the next step boundary (:meth:`note_step`) the coordinator removes
+  the victim from the rendezvous and hands the survivors an in-place
+  shrink plan through the rescale coordinator — while the victim is
+  still alive. The eventual kill is a non-event: the node is already
+  out of the world, so the failure report finds nothing left to do.
+
+A notice that expires without a kill (false alarm) cancels cleanly in
+:meth:`tick`: writer leases revert to their prior owners, any still
+in-flight shrink plan is superseded WITHOUT round invalidation, and the
+victim — never restarted — rejoins through the normal grow path.
+
+Durability: the notice itself replays through its journaled RPC record;
+the transitions driven by unjournaled inputs — the writer pre-election
+(the live rendezvous world is not a journal input), the step-boundary
+shrink and the timer-driven cancel — write their own
+``("preempt", payload, ts)`` records.
+"""
+
+import time
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.lockdep import instrumented_lock
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import EventKind, emit
+
+NOTICE_ACTIVE = "active"
+NOTICE_HANDLED = "handled"
+NOTICE_CANCELLED = "cancelled"
+
+#: kv namespace the PR-9 writer election claims leases under
+#: (servicer._ckpt_writer_elect: "ckpt_writer/{epoch}/{group}").
+WRITER_LEASE_PREFIX = "ckpt_writer/"
+
+
+class PreemptionCoordinator:
+    #: dtlint DT009: the notice table (deadlines, handoff backups, plan
+    #: linkage) moves as one unit under the coordinator lock.
+    GUARDED_BY = {
+        "_notices": "master.preempt",
+    }
+
+    """Tracks termination notices and converts them into planned
+    transitions.
+
+    Wiring: the servicer's journaled ``PreemptionNotice`` handler calls
+    :meth:`on_notice`; ``_report_step`` calls :meth:`note_step` (the
+    step boundary is where the proactive shrink issues); the failure /
+    evict paths call :meth:`on_node_removed`; the master's monitor loop
+    calls :meth:`tick` for false-alarm expiry.
+    """
+
+    def __init__(
+        self,
+        rdzv_managers: Optional[Dict[str, Any]] = None,
+        kv_store=None,
+        job_manager=None,
+        rescale_coordinator=None,
+        state_store=None,
+    ):
+        self._lock = instrumented_lock("master.preempt")
+        self._rdzv_managers = rdzv_managers or {}
+        self._kv_store = kv_store
+        self._job_manager = job_manager
+        self._rescale = rescale_coordinator
+        self._store = state_store
+        # node_rank -> {deadline_ts, grace_s, source, reason, status,
+        #               planned, plan_id, leases: [[key, heir, prior]]}
+        self._notices: Dict[int, Dict[str, Any]] = {}
+
+    # ---------------- journal plumbing ----------------
+    @property
+    def _replaying(self) -> bool:
+        return self._store is not None and self._store.replaying
+
+    def _journal(self, payload: Dict[str, Any]):
+        if self._store is not None and not self._store.replaying:
+            self._store.append(("preempt", payload, time.time()))
+
+    # ---------------- notice intake (journaled RPC) ----------------
+    def on_notice(self, req: m.PreemptionNotice) -> m.Response:
+        """Record a termination notice and hand off the victim's
+        checkpoint writer leases.
+
+        Reached via the journaled ``PreemptionNotice`` RPC, so a master
+        failover mid-notice replays it exactly once; duplicate reports
+        (client retries, several sources firing) dedupe here — the
+        first deadline wins.
+        """
+        if not env_utils.PREEMPT.get():  # dtlint: disable=DT011 -- operator kill-switch deliberately read live; with the plane off the notice must be a no-op on replay too
+            return m.Response(success=False, reason="preempt disabled")
+        victim = int(req.node_rank)
+        if victim < 0:
+            return m.Response(success=False, reason="bad node_rank")
+        with self._lock:
+            existing = self._notices.get(victim)
+            if existing is not None and existing["status"] == NOTICE_ACTIVE:
+                # Duplicate notice for an already-armed victim: the
+                # first deadline wins, nothing re-runs.
+                return m.Response(success=True, reason="duplicate")
+            self._notices[victim] = {
+                "deadline_ts": float(req.deadline_ts),
+                "grace_s": float(req.grace_s),
+                "source": req.source,
+                "reason": req.reason,
+                "status": NOTICE_ACTIVE,
+                "planned": False,
+                "plan_id": -1,
+                "leases": [],
+            }
+        handoffs = self._preelect_writers(victim)
+        if handoffs:
+            with self._lock:
+                notice = self._notices.get(victim)
+                if notice is not None:
+                    notice["leases"] = handoffs
+            # The handoff depends on the LIVE rendezvous world (who
+            # survives), which is not reconstructed by the journal —
+            # record the computed result so replay re-applies it
+            # verbatim instead of re-deriving it from divergent state.
+            self._journal({
+                "rec": "leases", "node": victim, "leases": handoffs,
+            })
+        if self._job_manager is not None:
+            self._job_manager.mark_preempting(victim)
+        logger.info(
+            "preempt notice for node %s (source=%s deadline=%.1f "
+            "grace=%.1fs): %d writer lease(s) handed off",
+            victim, req.source, req.deadline_ts, req.grace_s,
+            len(handoffs),
+        )
+        emit(  # dtlint: disable=DT012 -- replay-guarded at the sink: JobMaster._event_sink drops emits while store.replaying
+            EventKind.PREEMPT_NOTICE, _node_id=victim, _role="master",
+            deadline_ts=req.deadline_ts, grace_s=req.grace_s,
+            source=req.source, reason=req.reason,
+            handoffs=[entry[0] for entry in handoffs],
+        )
+        return m.Response(success=True)
+
+    def _preelect_writers(self, victim: int) -> List[List[Any]]:
+        """Move every writer lease the victim owns onto the lowest
+        surviving rank, remembering the prior owner for the false-alarm
+        revert. Deterministic (sorted scan over replayed kv state), so
+        The live rendezvous world is an unjournaled input, so the
+        computed handoffs are journaled as a ``"leases"`` record and
+        this recomputation is skipped on replay."""
+        if self._kv_store is None or self._replaying:
+            return []
+        training = self._rdzv_managers.get(RendezvousName.TRAINING)
+        world = training.current_world() if training is not None else {}
+        survivors = sorted(r for r in world if r != victim)
+        handoffs: List[List[Any]] = []
+        for key, value in self._kv_store.scan(WRITER_LEASE_PREFIX).items():
+            try:
+                owner = int(value.decode())
+            except (ValueError, AttributeError):
+                continue
+            if owner != victim or not survivors:
+                continue
+            heir = survivors[0]
+            self._kv_store.delete(key)
+            self._kv_store.setnx(key, str(heir).encode())
+            handoffs.append([key, heir, owner])
+        return handoffs
+
+    def _revert_leases(self, handoffs: List[List[Any]]):
+        if self._kv_store is None:
+            return
+        for key, _heir, prior in handoffs:
+            self._kv_store.set(key, str(int(prior)).encode())
+
+    # ---------------- step boundary: proactive shrink ----------------
+    def note_step(self, step: int):
+        """Issue the in-place shrink for every active, not-yet-planned
+        notice. Runs at the step boundary (the servicer's step report)
+        so survivors transition between steps, not mid-step."""
+        if self._replaying or not env_utils.PREEMPT.get():
+            return
+        pending: List[int] = []
+        with self._lock:
+            for node in sorted(self._notices):
+                notice = self._notices[node]
+                if notice["status"] == NOTICE_ACTIVE and not notice["planned"]:
+                    pending.append(node)
+        for node in pending:
+            self._plan_shrink(node, step)
+
+    def _plan_shrink(self, victim: int, step: int):
+        training = self._rdzv_managers.get(RendezvousName.TRAINING)
+        old_world = training.current_world() if training is not None else {}
+        plan = None
+        if victim in old_world:
+            # Same sequence as the failure path, just ahead of the kill:
+            # drop the victim from every rendezvous, then give the
+            # rescale coordinator its shot at an in-place plan. When it
+            # declines (no quorum, no batch config) the world has still
+            # shrunk, and the stale-round full-restart fallback takes
+            # over once the kill lands.
+            for mgr in self._rdzv_managers.values():
+                mgr.remove_alive_node(victim)
+            if self._rescale is not None:
+                plan = self._rescale.on_node_removed(victim, old_world)
+        plan_id = plan.plan_id if plan is not None else -1
+        with self._lock:
+            notice = self._notices.get(victim)
+            if notice is None or notice["status"] != NOTICE_ACTIVE:
+                return
+            notice["planned"] = True
+            notice["plan_id"] = plan_id
+        self._journal({"rec": "planned", "node": victim, "plan_id": plan_id})
+        logger.info(
+            "preempt: shrink for node %s issued at step boundary %s "
+            "(plan %s); the coming kill is a non-event",
+            victim, step, plan_id if plan_id >= 0 else "declined",
+        )
+        emit(
+            EventKind.PREEMPT_HANDLED, _node_id=victim, _role="master",
+            step=step, plan_id=plan_id, proactive=True,
+        )
+
+    # ---------------- the kill (or evict) lands ----------------
+    def on_node_removed(self, node_rank: int) -> bool:
+        """The node actually left (failure report or master evict).
+
+        Marks an active notice handled so tick never false-alarms it.
+        Returns whether a notice was active — True means the departure
+        was announced and (if planned) already paid for. Replay-pure:
+        reached from journaled NodeFailure replay and evict records.
+        """
+        with self._lock:
+            notice = self._notices.get(int(node_rank))
+            if notice is None or notice["status"] != NOTICE_ACTIVE:
+                return False
+            notice["status"] = NOTICE_HANDLED
+        return True
+
+    def is_active(self, node_rank: int) -> bool:
+        with self._lock:
+            notice = self._notices.get(int(node_rank))
+            return notice is not None and notice["status"] == NOTICE_ACTIVE
+
+    # ---------------- false-alarm expiry ----------------
+    def tick(self):
+        """Periodic driver (master monitor loop): a notice whose
+        deadline passed with the node still alive is a false alarm —
+        cancel it cleanly."""
+        if self._replaying:
+            return
+        now = time.time()
+        slack = env_utils.PREEMPT_FALSE_ALARM_S.get()
+        expired: List[int] = []
+        with self._lock:
+            for node in sorted(self._notices):
+                notice = self._notices[node]
+                if (
+                    notice["status"] == NOTICE_ACTIVE
+                    and notice["deadline_ts"] > 0
+                    and now > notice["deadline_ts"] + slack
+                ):
+                    expired.append(node)
+        for node in expired:
+            self._cancel(node, reason="deadline passed without a kill")
+
+    def _cancel(self, victim: int, reason: str):
+        with self._lock:
+            notice = self._notices.get(victim)
+            if notice is None or notice["status"] != NOTICE_ACTIVE:
+                return
+            notice["status"] = NOTICE_CANCELLED
+            handoffs = [list(entry) for entry in notice["leases"]]
+            plan_id = notice["plan_id"]
+        self._revert_leases(handoffs)
+        if self._job_manager is not None:
+            self._job_manager.clear_preempting(victim)
+        if plan_id >= 0 and self._rescale is not None:
+            # The proactive shrink is obsolete: the victim stays. Abort
+            # it through supersede semantics — NEVER round invalidation,
+            # which would force-restart a healthy world. Survivors that
+            # already applied keep training; the victim rejoins through
+            # the normal grow path.
+            self._rescale.supersede_plan(plan_id, "preempt-false-alarm")
+        self._journal({"rec": "cancel", "node": victim})
+        logger.info(
+            "preempt notice for node %s cancelled (%s): %d lease(s) "
+            "reverted, no restart", victim, reason, len(handoffs),
+        )
+        emit(
+            EventKind.PREEMPT_CANCEL, _node_id=victim, _role="master",
+            reason=reason, leases_reverted=len(handoffs),
+        )
+
+    # ---------------- durability ----------------
+    def pending(self) -> List[int]:
+        """Node ranks with an active notice (tests + status surfaces)."""
+        with self._lock:
+            return sorted(
+                node for node, notice in self._notices.items()
+                if notice["status"] == NOTICE_ACTIVE
+            )
+
+    def notice_state(self, node_rank: int) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            notice = self._notices.get(int(node_rank))
+            return dict(notice) if notice is not None else None
+
+    def checkpoint(self) -> dict:
+        with self._lock:
+            return {
+                "notices": {
+                    str(node): dict(notice)
+                    for node, notice in self._notices.items()
+                },
+            }
+
+    def restore(self, state: dict):
+        if not state:
+            return
+        with self._lock:
+            for node, notice in state.get("notices", {}).items():
+                restored = dict(notice)
+                restored["leases"] = [
+                    list(entry) for entry in restored.get("leases", [])
+                ]
+                self._notices[int(node)] = restored
+
+    def replay(self, payload: Dict[str, Any]):
+        """Re-apply one journaled ``("preempt", payload, ts)`` record.
+
+        Only the unjournaled-input transitions live here: the notice
+        itself replays through its rpc record, while "leases" re-applies
+        the recorded writer handoff (derived live from the rendezvous
+        world, which the journal does not reconstruct), "planned" is
+        pure bookkeeping and "cancel" re-applies the lease revert.
+        """
+        rec = payload.get("rec")
+        if rec == "leases":
+            victim = int(payload.get("node", -1))
+            handoffs = [list(entry) for entry in payload.get("leases", [])]
+            with self._lock:
+                notice = self._notices.get(victim)
+                if notice is not None:
+                    notice["leases"] = handoffs
+            if self._kv_store is not None:
+                for key, heir, _prior in handoffs:
+                    self._kv_store.set(key, str(int(heir)).encode())
+        elif rec == "planned":
+            with self._lock:
+                notice = self._notices.get(int(payload.get("node", -1)))
+                if notice is not None:
+                    notice["planned"] = True
+                    notice["plan_id"] = int(payload.get("plan_id", -1))
+        elif rec == "cancel":
+            victim = int(payload.get("node", -1))
+            with self._lock:
+                notice = self._notices.get(victim)
+                handoffs = []
+                if notice is not None and notice["status"] == NOTICE_ACTIVE:
+                    notice["status"] = NOTICE_CANCELLED
+                    handoffs = [list(entry) for entry in notice["leases"]]
+            self._revert_leases(handoffs)
+            if self._job_manager is not None:
+                self._job_manager.clear_preempting(victim)
+        else:
+            logger.warning("skipping unknown preempt record %r", rec)

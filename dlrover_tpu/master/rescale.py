@@ -383,6 +383,34 @@ class RescaleCoordinator:
             )
         return True
 
+    def supersede_plan(self, plan_id: int, reason: str) -> bool:
+        """Abort an in-flight plan WITHOUT invalidating its round.
+
+        The preemption plane's false-alarm cancel: the shrink plan it
+        issued proactively is obsolete because the victim stays, and
+        fencing the live round would force-restart a healthy world.
+        Survivors that already applied keep training; a settled plan
+        (complete or already aborted) is left untouched.
+        """
+        with self._lock:
+            plan = self._plans.get(plan_id)
+            if plan is None or plan.status != PLAN_ISSUED:
+                return False
+            plan.status = PLAN_ABORTED
+            self._deadlines.pop(plan_id, None)
+        self._journal({
+            "rec": "abort", "plan_id": plan_id, "reason": reason,
+        })
+        logger.info(
+            "rescale plan %s superseded (%s); round left valid",
+            plan_id, reason,
+        )
+        emit(
+            EventKind.RESCALE_ABORT, _role="master",
+            plan_id=plan_id, reason=reason,
+        )
+        return True
+
     def tick(self):
         """Periodic driver (master monitor loop): abort plans whose
         survivors did not all ack within the apply timeout."""

@@ -45,6 +45,9 @@ _JOURNALED = (
     # them replays the race in the original order, so a recovered master
     # answers with the same owner it already promised.
     m.CkptWriterElect,
+    # A preemption notice arms the proactive shrink and hands off writer
+    # leases; a master failover mid-notice must replay it exactly once.
+    m.PreemptionNotice,
 )
 
 #: Mutating messages journaled AFTER their handler runs: the record must
@@ -81,6 +84,7 @@ class MasterServicer:
         state_store=None,
         observability=None,
         rescale_coordinator=None,
+        preempt_coordinator=None,
         mutation_locks=None,
     ):
         self._rdzv_managers = rdzv_managers
@@ -93,6 +97,7 @@ class MasterServicer:
         self._state_store = state_store
         self._observability = observability
         self._rescale = rescale_coordinator
+        self._preempt = preempt_coordinator
         self._locks = mutation_locks or MutationLocks()
         # Bulk-lane load probe, wired by attach_server: drives the
         # EventReport telemetry-shedding backpressure below.
@@ -280,6 +285,12 @@ class MasterServicer:
             group=req.group, epoch=req.epoch, owner_rank=int(won.decode())
         )
 
+    # ---------------- preemption plane ----------------
+    def _preempt_notice(self, req: m.PreemptionNotice):
+        if self._preempt is None:
+            return m.Response(success=False, reason="preempt disabled")
+        return self._preempt.on_notice(req)
+
     # ---------------- data sharding ----------------
     def _new_dataset(self, req: m.DatasetShardParams):
         self._task_manager.new_dataset(
@@ -333,6 +344,10 @@ class MasterServicer:
             # Freshness fence for plan snapshots: per-step shm snapshots
             # mean the newest one trails this by at most one step.
             self._rescale.note_step(req.step)
+        if self._preempt is not None:
+            # Step boundary: issue the proactive shrink for any armed
+            # preemption notice while the victim is still alive.
+            self._preempt.note_step(req.step)
         if self._metric_collector:
             # Training-speed history feeds the Brain's completion-time
             # prediction (brain/algorithms.py::completion_time).
@@ -382,9 +397,14 @@ class MasterServicer:
         # Master-visible detection point: the node drops out of every
         # rendezvous below. (The agent's own worker.fail event arrives
         # async via EventReport; the ledger folds both into one incident.)
+        announced = (
+            self._preempt is not None
+            and self._preempt.is_active(req.node_id)
+        )
         emit(  # dtlint: disable=DT012 -- replay-guarded at the sink: JobMaster._event_sink drops emits while store.replaying
             EventKind.NODE_EVICT, _node_id=req.node_id, _role="master",
             reason=req.level, restart_count=req.restart_count,
+            cause="preempt" if announced else "crash",
         )
         if self._job_manager:
             self._job_manager.process_error(
@@ -396,6 +416,13 @@ class MasterServicer:
             mgr.remove_alive_node(req.node_id)
         if self._task_manager:
             self._task_manager.recover_worker_tasks(req.node_id)
+        if self._preempt is not None:
+            # An announced departure: mark the notice handled so the
+            # false-alarm timer never fires for a node that really died.
+            # When the proactive shrink already ran, the victim is out
+            # of old_world and the rescale trigger below is a no-op —
+            # the kill is the non-event the notice paid for.
+            self._preempt.on_node_removed(req.node_id)
         if self._rescale is not None and req.node_id in old_world:
             # This path bypasses the master's _evict_node (the agent
             # reported the failure directly): give the coordinator the
@@ -517,6 +544,7 @@ MasterServicer._HANDLERS = {
     m.KVStoreMultiGet: MasterServicer._kv_multi_get,
     m.KVStoreDelete: MasterServicer._kv_delete,
     m.CkptWriterElect: MasterServicer._ckpt_writer_elect,
+    m.PreemptionNotice: MasterServicer._preempt_notice,
     m.DatasetShardParams: MasterServicer._new_dataset,
     m.TaskRequest: MasterServicer._get_task,
     m.TaskReport: MasterServicer._report_task,

@@ -53,6 +53,11 @@ def should_relaunch(node, flow: NodeStateFlow, relaunch_on_worker_failure: int =
         return False
     if node.exit_reason == NodeExitReason.FATAL_ERROR:
         return False
+    if node.exit_reason == NodeExitReason.PREEMPTED:
+        # Planned departure announced by the preemption plane; the
+        # survivors already transitioned in place — relaunching the
+        # victim would fight the shrink plan it was removed by.
+        return False
     if node.relaunch_count >= min(node.max_relaunch_count, relaunch_on_worker_failure):
         return False
     return True

@@ -50,4 +50,9 @@ WAL_RECORDS: Dict[str, Tuple[str, ...]] = {
     # ("rescale", payload, ts) — rescale coordinator journal
     # (set-union/overwrite semantics, replay-idempotent).
     "rescale": ("RescaleCoordinator.replay",),
+    # ("preempt", payload, ts) — preemption coordinator journal: only
+    # the unjournaled-input transitions (writer-lease handoff computed
+    # from the live rendezvous world, step-boundary shrink mark,
+    # false-alarm cancel); the notice itself replays via its rpc record.
+    "preempt": ("PreemptionCoordinator.replay",),
 }

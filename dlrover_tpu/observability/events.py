@@ -74,6 +74,14 @@ class EventKind:
     RESCALE_APPLY = "rescale.apply"
     RESCALE_COMPLETE = "rescale.complete"
     RESCALE_ABORT = "rescale.abort"
+    # Preemption plane: a known-ahead termination notice arrived for a
+    # node (context), the master converted it into a planned in-place
+    # transition (detection — opens the preempt:handled incident), or the
+    # deadline passed with the node still alive and the notice cancelled
+    # cleanly (context; leases reverted, nothing restarted).
+    PREEMPT_NOTICE = "preempt.notice"
+    PREEMPT_HANDLED = "preempt.handled"
+    PREEMPT_CANCEL = "preempt.cancel"
 
 
 @dataclass

@@ -42,6 +42,7 @@ _OPENING = {
     EventKind.NODE_HANG: "hang",
     EventKind.RDZV_INVALIDATED: "round-invalidated",
     EventKind.RESCALE_PLAN: "rescale",
+    EventKind.PREEMPT_HANDLED: "preempt:handled",
 }
 #: Master-visible detection events (stamp detect_ts).
 _DETECT = (
@@ -49,6 +50,7 @@ _DETECT = (
     EventKind.NODE_EVICT,
     EventKind.NODE_HANG,
     EventKind.RESCALE_PLAN,
+    EventKind.PREEMPT_HANDLED,
 )
 #: Context events worth attaching to an open incident's trail.
 _CONTEXT = (
@@ -59,6 +61,8 @@ _CONTEXT = (
     EventKind.RESCALE_APPLY,
     EventKind.RESCALE_COMPLETE,
     EventKind.RESCALE_ABORT,
+    EventKind.PREEMPT_NOTICE,
+    EventKind.PREEMPT_CANCEL,
 )
 
 
@@ -166,6 +170,13 @@ class GoodputLedger:
         cause = _OPENING[ev.kind]
         if ev.kind == EventKind.CHAOS_INJECT:
             cause = f"chaos.{ev.args.get('kind', 'fault')}"
+        elif ev.kind in (
+            EventKind.WORKER_FAIL, EventKind.NODE_EVICT
+        ) and ev.args.get("cause") == "preempt":
+            # Announced departure: the agent/master classified this exit
+            # as the kill a preemption notice already paid for — book it
+            # apart from crash recovery so the bench can compare arms.
+            cause = "preempt:handled"
         with self._lock:
             self._incident_during_gap = True
             self._t0 = min(self._t0, ev.ts)
@@ -186,6 +197,14 @@ class GoodputLedger:
                 # An in-place plan re-causes the incident: the window
                 # that follows is the transition, not a restart — so
                 # summary() separates rescale cost from restart cost.
+                # Never stomp a planned preemption: its shrink plan is
+                # part of the handled transition, not a new cause.
+                if inc.cause != "preempt:handled":
+                    inc.cause = cause
+            elif ev.kind == EventKind.PREEMPT_HANDLED and not inc.injected:
+                # The proactive shrink re-causes whatever opened first
+                # (usually its own RESCALE_PLAN an instant earlier):
+                # this window is a planned transition.
                 inc.cause = cause
             if ev.kind in _DETECT and inc.detect_ts is None:
                 inc.detect_ts = ev.ts

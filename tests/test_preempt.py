@@ -1,0 +1,560 @@
+"""Preemption plane (ISSUE 13): known-ahead failures as planned moves.
+
+Covers the master-side :class:`PreemptionCoordinator` (notice intake,
+writer-lease pre-election, step-boundary proactive shrink, false-alarm
+cancel through supersede semantics), the journaled RPC surface incl.
+master failover mid-notice, the agent-side :class:`PreemptionWatcher`
+notice sources and chaos variants, and the goodput ledger's distinct
+``preempt:handled`` cause.
+"""
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.preempt import PreemptionWatcher
+from dlrover_tpu.chaos import FaultEvent, FaultInjector, FaultPlan
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import (
+    NodeExitReason,
+    NodeStatus,
+    RendezvousName,
+)
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.preempt import (
+    NOTICE_ACTIVE,
+    NOTICE_CANCELLED,
+    NOTICE_HANDLED,
+    PreemptionCoordinator,
+)
+from dlrover_tpu.master.rescale import PLAN_ABORTED, PLAN_ISSUED
+from dlrover_tpu.observability.events import EventKind, JobEvent
+from dlrover_tpu.observability.goodput import GoodputLedger
+
+from tests.test_chaos import arm, chaos_clean  # noqa: F401  (fixture)
+from tests.test_rescale import TRAIN, formed_world, make_coordinator
+from tests.test_state_store import crash_master
+
+
+class FakeJobManager:
+    """Just the preempting-marker contract the coordinator drives."""
+
+    def __init__(self):
+        self.preempting = set()
+
+    def mark_preempting(self, node_id):
+        self.preempting.add(node_id)
+
+    def clear_preempting(self, node_id):
+        self.preempting.discard(node_id)
+
+
+def make_preempt(mgr, rescale=None, kv=None, jm=None, store=None):
+    return PreemptionCoordinator(
+        rdzv_managers={TRAIN: mgr}, kv_store=kv, job_manager=jm,
+        rescale_coordinator=rescale, state_store=store,
+    )
+
+
+def notice_req(victim=3, deadline=None, grace=30.0, source="file"):
+    return m.PreemptionNotice(
+        node_rank=victim,
+        deadline_ts=deadline if deadline is not None else time.time() + 60,
+        grace_s=grace, source=source, reason="test",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Master-side coordinator
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptionCoordinator:
+    def test_notice_dedup_first_deadline_wins(self):
+        mgr, _, _ = formed_world(4)
+        pre = make_preempt(mgr)
+        first = notice_req(3, deadline=1000.0)
+        assert pre.on_notice(first).success
+        dup = pre.on_notice(notice_req(3, deadline=2000.0))
+        assert dup.success and dup.reason == "duplicate"
+        assert pre.pending() == [3]
+        assert pre.notice_state(3)["deadline_ts"] == 1000.0
+
+    def test_disabled_is_a_noop(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_PREEMPT", "0")
+        mgr, _, _ = formed_world(4)
+        pre = make_preempt(mgr)
+        resp = pre.on_notice(notice_req(3))
+        assert not resp.success
+        assert pre.pending() == []
+
+    def test_notice_preelects_writer_leases(self):
+        """Every lease the victim owns moves to the lowest surviving
+        rank before the victim dies — the next checkpoint epoch never
+        blocks on a dead writer."""
+        mgr, _, _ = formed_world(4)
+        kv = KVStoreService()
+        kv.setnx("ckpt_writer/0/ck:shard0", b"3")
+        kv.setnx("ckpt_writer/0/ck:shard1", b"1")
+        jm = FakeJobManager()
+        pre = make_preempt(mgr, kv=kv, jm=jm)
+        assert pre.on_notice(notice_req(3)).success
+        # Victim-owned lease handed to rank 0; others untouched.
+        assert kv.get("ckpt_writer/0/ck:shard0") == b"0"
+        assert kv.get("ckpt_writer/0/ck:shard1") == b"1"
+        assert pre.notice_state(3)["leases"] == [
+            ["ckpt_writer/0/ck:shard0", 0, 3]
+        ]
+        assert 3 in jm.preempting
+
+    def test_step_boundary_issues_proactive_shrink(self):
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        pre = make_preempt(mgr, rescale=coord)
+        assert pre.on_notice(notice_req(3)).success
+        # Nothing happens until a step boundary arrives.
+        assert mgr.current_world() == world
+        pre.note_step(6)
+        state = pre.notice_state(3)
+        assert state["planned"] and state["plan_id"] >= 0
+        plan = coord.get_plan(TRAIN, 0, round_)
+        assert plan.exists and plan.status == PLAN_ISSUED
+        assert sorted(plan.new_world) == [0, 1, 2]
+        # The victim is already out of the world, pre-kill.
+        assert 3 not in mgr.current_world()
+        # A later step boundary does not re-plan.
+        pre.note_step(7)
+        assert pre.notice_state(3)["plan_id"] == plan.plan_id
+
+    def test_eventual_kill_is_marked_handled(self):
+        mgr, _, _ = formed_world(4)
+        coord = make_coordinator(mgr)
+        pre = make_preempt(mgr, rescale=coord)
+        pre.on_notice(notice_req(3, deadline=time.time() - 100))
+        pre.note_step(6)
+        assert pre.on_node_removed(3) is True
+        assert pre.notice_state(3)["status"] == NOTICE_HANDLED
+        # The deadline is long past, but the node really died: tick must
+        # NOT cancel a handled notice (no lease revert, no cancel event).
+        pre.tick()
+        assert pre.notice_state(3)["status"] == NOTICE_HANDLED
+        # And a second removal report finds nothing left to do.
+        assert pre.on_node_removed(3) is False
+
+    def test_false_alarm_cancels_cleanly(self, monkeypatch):
+        """Deadline passes, node still alive: leases revert, the shrink
+        plan is superseded WITHOUT round invalidation, nothing restarts."""
+        monkeypatch.setenv("DLROVER_TPU_PREEMPT_FALSE_ALARM_S", "0")
+        mgr, round_, world = formed_world(4)
+        coord = make_coordinator(mgr)
+        kv = KVStoreService()
+        kv.setnx("ckpt_writer/0/ck:shard0", b"3")
+        jm = FakeJobManager()
+        pre = make_preempt(mgr, rescale=coord, kv=kv, jm=jm)
+        pre.on_notice(notice_req(3, deadline=time.time() - 1))
+        pre.note_step(6)
+        plan = coord.get_plan(TRAIN, 0, round_)
+        assert plan.exists
+        pre.tick()
+        state = pre.notice_state(3)
+        assert state["status"] == NOTICE_CANCELLED
+        # Lease back with its prior owner, marker cleared, plan aborted.
+        assert kv.get("ckpt_writer/0/ck:shard0") == b"3"
+        assert 3 not in jm.preempting
+        assert plan.status == PLAN_ABORTED
+        # Supersede, not invalidation: the shrunk round stays live —
+        # survivors keep training and the victim regrows normally.
+        assert not mgr.world_stale(plan.new_round)
+        # A node death long after the cancel is an ordinary crash.
+        assert pre.on_node_removed(3) is False
+
+    def test_false_alarm_before_any_step_reverts_without_plan(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("DLROVER_TPU_PREEMPT_FALSE_ALARM_S", "0")
+        mgr, _, world = formed_world(4)
+        kv = KVStoreService()
+        kv.setnx("ckpt_writer/0/ck:shard0", b"3")
+        pre = make_preempt(mgr, kv=kv)
+        pre.on_notice(notice_req(3, deadline=time.time() - 1))
+        pre.tick()
+        assert pre.notice_state(3)["status"] == NOTICE_CANCELLED
+        assert kv.get("ckpt_writer/0/ck:shard0") == b"3"
+        # No plan was ever issued and the world never shrank.
+        assert mgr.current_world() == world
+
+
+class TestKvScan:
+    def test_scan_returns_sorted_prefix_slice(self):
+        kv = KVStoreService()
+        kv.set("ckpt_writer/0/a", b"1")
+        kv.set("ckpt_writer/1/a", b"2")
+        kv.set("other/x", b"3")
+        got = kv.scan("ckpt_writer/")
+        assert list(got) == ["ckpt_writer/0/a", "ckpt_writer/1/a"]
+        assert kv.scan("nope/") == {}
+
+
+# ---------------------------------------------------------------------------
+# RPC surface + failover
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptionRpc:
+    def _join_world(self, master, clients):
+        for r, c in enumerate(clients):
+            c.join_rendezvous(TRAIN, r, 1)
+        round_, _, world = clients[0].get_comm_world(TRAIN, 0)
+        clients[0].report_model_info(
+            0, 0.0, batch_size=16,
+            extra={"global_batch": 16, "micro_batch": 4},
+        )
+        for r in (0, 1, 2):
+            clients[r].report_model_info(
+                0, 0.0, extra={"rescale_capable": True}
+            )
+        return round_, world
+
+    def test_notice_to_shrink_to_nonevent_kill(self):
+        master = JobMaster(port=0, node_num=4, job_name="preempt-rpc")
+        master.prepare()
+        clients = [MasterClient(master.addr, node_id=r) for r in range(4)]
+        try:
+            round_, world = self._join_world(master, clients)
+            resp = clients[3].report_preemption_notice(
+                node_rank=3, deadline_ts=time.time() + 60,
+                grace_s=60.0, source="file",
+            )
+            assert resp.success
+            assert master.preempt.pending() == [3]
+            # Retry/duplicate report: absorbed, not re-run.
+            dup = clients[3].report_preemption_notice(
+                node_rank=3, deadline_ts=time.time() + 90,
+                grace_s=90.0, source="env",
+            )
+            assert dup.success and dup.reason == "duplicate"
+            # The next step boundary converts the notice into a plan
+            # (the step report rides the bulk lane, so poll briefly).
+            clients[0].report_global_step(7, time.time())
+            deadline = time.monotonic() + 5
+            got = m.RescalePlan()
+            while time.monotonic() < deadline and not got.exists:
+                got = clients[0].get_rescale_plan(TRAIN, 0, round_)
+                time.sleep(0.05)
+            assert got.exists and sorted(got.new_world) == [0, 1, 2]
+            assert master.preempt.notice_state(3)["planned"]
+            # The kill lands: the victim is already out of the world, so
+            # the failure report must not issue a second plan.
+            clients[3].report_failure("SIGTERM", level="node_error")
+            assert master.preempt.notice_state(3)["status"] == NOTICE_HANDLED
+            newer = clients[0].get_rescale_plan(TRAIN, 0, got.new_round)
+            assert not newer.exists
+        finally:
+            for c in clients:
+                c.close()
+            master.stop()
+
+    def test_failover_mid_notice_replays_exactly_once(self, tmp_path):
+        """Master dies with a pending notice: WAL replay reproduces it —
+        same deadline, same writer-lease handoff — exactly once."""
+        state_dir = str(tmp_path / "mstate")
+        deadline = time.time() + 3600
+        m1 = JobMaster(
+            port=0, node_num=4, job_name="preempt-fo", state_dir=state_dir
+        )
+        m1.prepare()
+        clients = [MasterClient(m1.addr, node_id=r) for r in range(4)]
+        try:
+            self._join_world(m1, clients)
+            # The victim owns a journaled writer lease before the notice.
+            lease = clients[3].elect_ckpt_writer("ck:shard0", 0, 3)
+            assert lease.exists and lease.owner_rank == 3
+            resp = clients[3].report_preemption_notice(
+                node_rank=3, deadline_ts=deadline, grace_s=60.0,
+                source="metadata", reason="maintenance",
+            )
+            assert resp.success
+            assert m1.kv_store.get("ckpt_writer/0/ck:shard0") == b"0"
+        finally:
+            for c in clients:
+                c.close()
+            crash_master(m1)
+
+        m2 = JobMaster(
+            port=0, node_num=4, job_name="preempt-fo", state_dir=state_dir
+        )
+        m2.prepare()
+        try:
+            # Exactly one pending notice, byte-for-byte the one reported.
+            assert m2.preempt.pending() == [3]
+            state = m2.preempt.notice_state(3)
+            assert state["status"] == NOTICE_ACTIVE
+            assert state["deadline_ts"] == pytest.approx(deadline)
+            assert state["source"] == "metadata"
+            # The replayed pre-election reproduces the identical handoff.
+            assert m2.kv_store.get("ckpt_writer/0/ck:shard0") == b"0"
+            assert state["leases"] == [["ckpt_writer/0/ck:shard0", 0, 3]]
+            # And a client retry against the new master still dedupes.
+            client = MasterClient(m2.addr, node_id=3)
+            try:
+                dup = client.report_preemption_notice(
+                    node_rank=3, deadline_ts=deadline, grace_s=60.0,
+                    source="metadata",
+                )
+                assert dup.success and dup.reason == "duplicate"
+            finally:
+                client.close()
+        finally:
+            m2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Node bookkeeping: preempted exits never relaunch
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptedNodeFlow:
+    def test_process_error_during_notice_is_preempted_not_crash(self):
+        from dlrover_tpu.master.node_manager import JobManager
+
+        nm = JobManager(node_num=2)
+        nm.mark_preempting(1)
+        relaunch = nm.process_error(1, 0, "SIGTERM", "node_error")
+        assert relaunch is False
+        node = nm.get_node(1)
+        assert node.status == NodeStatus.FAILED
+        assert node.exit_reason == NodeExitReason.PREEMPTED
+        # An unannounced failure on another node keeps the crash path.
+        assert nm.is_preempting(0) is False
+
+    def test_export_restore_round_trips_preempting_marker(self):
+        from dlrover_tpu.master.node_manager import JobManager
+
+        nm = JobManager(node_num=2)
+        nm.mark_preempting(1)
+        state = nm.export_nodes()
+        nm2 = JobManager(node_num=2)
+        nm2.restore_nodes(state)
+        assert nm2.is_preempting(1) and not nm2.is_preempting(0)
+
+    def test_should_relaunch_excludes_preempted(self):
+        from dlrover_tpu.common.constants import NodeType
+        from dlrover_tpu.common.node import Node
+        from dlrover_tpu.master.status_flow import (
+            get_node_state_flow,
+            should_relaunch,
+        )
+
+        flow = get_node_state_flow(NodeStatus.RUNNING, NodeStatus.FAILED)
+        assert flow.should_relaunch
+        node = Node(NodeType.WORKER, 0, max_relaunch_count=3)
+        node.exit_reason = NodeExitReason.PREEMPTED
+        assert should_relaunch(node, flow) is False
+        # Same flow without the preempted reason would relaunch.
+        node.exit_reason = ""
+        assert should_relaunch(node, flow) is True
+
+
+# ---------------------------------------------------------------------------
+# Agent-side watcher
+# ---------------------------------------------------------------------------
+
+
+class FakeClient:
+    def __init__(self):
+        self.reports = []
+
+    def report_preemption_notice(self, **kw):
+        self.reports.append(kw)
+        return m.Response(success=True)
+
+
+def make_watcher(metadata_fn=None):
+    client = FakeClient()
+    flushed = []
+    killed = threading.Event()
+    watcher = PreemptionWatcher(
+        client=client, node_rank=2, metadata_fn=metadata_fn,
+        flush_fn=lambda: flushed.append(True), kill_fn=killed.set,
+    )
+    return watcher, client, flushed, killed
+
+
+class TestPreemptionWatcher:
+    def test_file_source_arms_reports_and_flushes(
+        self, tmp_path, monkeypatch
+    ):
+        path = tmp_path / "notice"
+        deadline = time.time() + 45
+        path.write_text(f"deadline={deadline}\n")
+        monkeypatch.setenv("DLROVER_TPU_PREEMPT_NOTICE_FILE", str(path))
+        watcher, client, flushed, killed = make_watcher()
+        watcher.poll_once()
+        assert watcher.active
+        assert watcher.deadline_ts == pytest.approx(deadline)
+        assert len(client.reports) == 1
+        assert client.reports[0]["source"] == "file"
+        assert flushed == [True]
+        assert not killed.is_set()
+        # Armed is a latch: further polls do not re-report.
+        watcher.poll_once()
+        assert len(client.reports) == 1
+
+    def test_env_flip_source(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_PREEMPT_NOW", "1")
+        monkeypatch.setenv("DLROVER_TPU_PREEMPT_GRACE_S", "40")
+        watcher, client, flushed, _ = make_watcher()
+        watcher.poll_once()
+        assert watcher.active
+        assert client.reports[0]["source"] == "env"
+        assert client.reports[0]["grace_s"] == 40.0
+
+    def test_metadata_shim_source(self):
+        deadline = time.time() + 33
+        watcher, client, flushed, _ = make_watcher(
+            metadata_fn=lambda: {
+                "deadline_ts": deadline, "grace_s": 33.0,
+                "reason": "maintenance",
+            }
+        )
+        watcher.poll_once()
+        assert watcher.active
+        assert client.reports[0]["source"] == "metadata"
+        assert client.reports[0]["deadline_ts"] == pytest.approx(deadline)
+        assert client.reports[0]["reason"] == "maintenance"
+
+    def test_metadata_none_means_no_notice(self):
+        watcher, client, _, _ = make_watcher(metadata_fn=lambda: None)
+        watcher.poll_once()
+        assert not watcher.active and client.reports == []
+
+    def test_chaos_kill_after_window(self, monkeypatch, chaos_clean):
+        arm(monkeypatch, FaultPlan(seed=1, events=[
+            FaultEvent(site="preempt.notice", kind="notice", every=1,
+                       max_fires=1, match="2",
+                       args={"window_s": 5.0, "kill_after_s": 0.05}),
+        ]))
+        watcher, client, flushed, killed = make_watcher()
+        watcher.poll_once()
+        assert watcher.active
+        assert client.reports[0]["source"] == "chaos"
+        assert flushed == [True]
+        assert killed.wait(2.0)
+        watcher.stop()
+
+    def test_chaos_kill_before_window_is_plain_crash(
+        self, monkeypatch, chaos_clean
+    ):
+        """kill_after_s=0: the kill beats the notice — no report, no
+        armed window, so nothing double-handles the ordinary crash."""
+        arm(monkeypatch, FaultPlan(seed=1, events=[
+            FaultEvent(site="preempt.notice", kind="notice", every=1,
+                       max_fires=1,
+                       args={"window_s": 5.0, "kill_after_s": 0}),
+        ]))
+        watcher, client, flushed, killed = make_watcher()
+        watcher.poll_once()
+        assert killed.is_set()
+        assert not watcher.active
+        assert client.reports == [] and flushed == []
+
+    def test_expired_window_disarms(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_PREEMPT_FALSE_ALARM_S", "0")
+        watcher, client, _, _ = make_watcher(
+            metadata_fn=lambda: {"deadline_ts": time.time() - 1}
+        )
+        watcher.poll_once()
+        assert len(client.reports) == 1
+        # Deadline long gone with the workers alive: false alarm — a
+        # later real crash must not be classified as preemption.
+        assert not watcher.active
+
+    def test_stale_evidence_does_not_rearm(self, tmp_path, monkeypatch):
+        """A notice file that keeps sitting on disk after its window
+        expired as a false alarm must not churn out a fresh
+        notice/cancel cycle every window; deleting and re-creating it
+        (a genuinely new notice) re-arms."""
+        monkeypatch.setenv("DLROVER_TPU_PREEMPT_FALSE_ALARM_S", "0")
+        path = tmp_path / "notice"
+        path.write_text(f"deadline={time.time() - 1}\n")
+        monkeypatch.setenv("DLROVER_TPU_PREEMPT_NOTICE_FILE", str(path))
+        watcher, client, _, _ = make_watcher()
+        watcher.poll_once()
+        assert len(client.reports) == 1
+        assert not watcher.active  # expired -> false alarm, source spent
+        watcher.poll_once()
+        watcher.poll_once()
+        assert len(client.reports) == 1  # stale file stays latched
+        # Evidence cleared, then a new notice lands: re-arm.
+        path.unlink()
+        watcher.poll_once()
+        path.write_text(f"deadline={time.time() + 60}\n")
+        watcher.poll_once()
+        assert len(client.reports) == 2
+        assert watcher.active
+
+    def test_disabled_never_starts(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_PREEMPT", "0")
+        watcher, _, _, _ = make_watcher()
+        watcher.start()
+        assert watcher._task is None
+
+
+# ---------------------------------------------------------------------------
+# Goodput: the distinct preempt:handled cause
+# ---------------------------------------------------------------------------
+
+
+def ev(kind, node=3, ts=0.0, **args):
+    return JobEvent(kind=kind, node_id=node, ts=ts, args=args)
+
+
+class TestPreemptGoodput:
+    def test_handled_books_apart_from_crash(self):
+        led = GoodputLedger(now=0.0)
+        led.ingest(ev(EventKind.PREEMPT_NOTICE, ts=1.0))
+        led.ingest(ev(EventKind.RESCALE_PLAN, ts=2.0, plan_id=1))
+        led.ingest(ev(EventKind.PREEMPT_HANDLED, ts=2.0, plan_id=1))
+        led.note_step(8, ts=2.5)
+        led.ingest(ev(EventKind.WORKER_FAIL, node=1, ts=10.0,
+                      cause="crash"))
+        led.note_step(9, ts=12.0)
+        s = led.summary(now=20.0)
+        assert s["incidents_by_cause"]["preempt:handled"] == 1
+        assert s["incidents_by_cause"]["worker-failure"] == 1
+        assert "rescale" not in s["incidents_by_cause"]
+        assert s["open_incidents"] == 0
+
+    def test_announced_exit_lands_under_handled(self):
+        """WORKER_FAIL / NODE_EVICT carrying cause="preempt" (the agent
+        monitor's classification during an active window) open the
+        handled incident, not a crash one."""
+        led = GoodputLedger(now=0.0)
+        led.ingest(ev(EventKind.WORKER_FAIL, ts=1.0, cause="preempt"))
+        led.ingest(ev(EventKind.NODE_EVICT, ts=1.1, cause="preempt"))
+        led.note_step(5, ts=2.0)
+        s = led.summary(now=3.0)
+        assert s["incidents_by_cause"] == {"preempt:handled": 1}
+
+    def test_rescale_plan_never_stomps_handled(self):
+        led = GoodputLedger(now=0.0)
+        led.ingest(ev(EventKind.PREEMPT_HANDLED, ts=1.0))
+        led.ingest(ev(EventKind.RESCALE_PLAN, ts=1.1, plan_id=1))
+        (inc,) = led.incidents()
+        assert inc.cause == "preempt:handled"
+
+    def test_notice_without_kill_opens_nothing(self):
+        """False alarm end-to-end in the ledger: notice + cancel are
+        context, not faults — zero incidents, zero downtime."""
+        led = GoodputLedger(now=0.0)
+        led.note_step(1, ts=0.5)
+        led.ingest(ev(EventKind.PREEMPT_NOTICE, ts=1.0))
+        led.ingest(ev(EventKind.PREEMPT_CANCEL, ts=6.0))
+        led.note_step(2, ts=6.5)
+        s = led.summary(now=7.0)
+        assert s["incidents"] == []
+        assert s["open_incidents"] == 0
+        assert s["downtime_s"] == 0.0

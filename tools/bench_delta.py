@@ -35,7 +35,7 @@ REGRESSION_PCT = 5.0
 _INTERESTING = re.compile(
     r"(tokens_per_s|goodput_.*_pct|mbps|speedup|mfu_pct|step_time_ms"
     r"|_save_s|restore_ms|overhead|wall_.*_s|blocking_save"
-    r"|_gb$|_bytes|_cut_x|rescale|detect_latency|attribution"
+    r"|_gb$|_bytes|_cut_x|rescale|preempt|detect_latency|attribution"
     r"|agents_sustained|beats_per_s|fsyncs_per_mutation|rpc_p99)", re.I,
 )
 
@@ -51,10 +51,15 @@ _INTERESTING = re.compile(
 #: Master-scale: ``fsyncs_per_mutation`` wants to shrink (group commit
 #: batches appends); ``rpc_p99_ms`` already matches ``_ms$`` and
 #: ``beats_per_s``/``agents_sustained`` stay higher-is-better (the
-#: ``(?<!per)`` lookbehind exempts ``_per_s`` rates).
+#: ``(?<!per)`` lookbehind exempts ``_per_s`` rates). Preempt:
+#: ``*_loss_steps`` (steps of work re-run after a kill) wants to
+#: shrink; its wall-second keys (``preempt_in_place_s``,
+#: ``no_notice_restart_s``) already match ``_s$``, and
+#: ``notice_speedup_x`` stays higher-is-better via ``speedup``.
 _LOWER_BETTER = re.compile(
     r"(_ms$|(?<!per)_s$|_s_per_gb$|wall|overhead|step_time|compile"
-    r"|_gb$|_bytes(?!_per_s|_cut)|detect_latency|fsyncs_per_mutation)",
+    r"|_gb$|_bytes(?!_per_s|_cut)|detect_latency|fsyncs_per_mutation"
+    r"|_loss_steps)",
     re.I,
 )
 
