@@ -1276,6 +1276,63 @@ def section_master_scale():
     return out
 
 
+def section_data_plane():
+    """Shard data-plane drill: lease arm vs per-call baseline through
+    the same REAL in-process master, driven by multi-PROCESS lease
+    workers (``tools/fleet_sim --procs``; a single generator process is
+    GIL-bound far below the plane's throughput).
+
+    Acceptance (ISSUE: tiered shard-lease data plane): the lease arm
+    sustains >= 100k shard completions/s with < 0.02 master RPCs per
+    shard (per-call baseline: 2.0), and its fetch p99 stays flat
+    (< 2x) from 100 to 2000 workers.
+    """
+    from tools.fleet_sim import run_lease_fleet
+
+    procs = int(os.getenv("DLROVER_TPU_BENCH_PLANE_PROCS", "4"))
+    duration = float(os.getenv("DLROVER_TPU_BENCH_PLANE_DURATION_S", "6"))
+    lease_small = run_lease_fleet(
+        workers=100, duration_s=duration, procs=procs, mode="lease",
+    )
+    lease_big = run_lease_fleet(
+        workers=2000, duration_s=duration, procs=procs, mode="lease",
+    )
+    per_call = run_lease_fleet(
+        workers=100, duration_s=max(3.0, duration / 2), procs=procs,
+        mode="per_call",
+    )
+    ratio = 0.0
+    if lease_small["fetch_p99_ms"] > 0:
+        ratio = round(
+            lease_big["fetch_p99_ms"] / lease_small["fetch_p99_ms"], 2
+        )
+    out = {
+        "completions_per_s": lease_big["completions_per_s"],
+        "leases_per_s": lease_big["leases_per_s"],
+        "master_rpcs_per_shard": lease_big["master_rpcs_per_shard"],
+        "fetch_p50_ms": lease_big["fetch_p50_ms"],
+        "fetch_p99_ms": lease_big["fetch_p99_ms"],
+        "workers": lease_big["workers"],
+        "rpc_errors": lease_big["rpc_errors"],
+        "fetch_p99_ms_100w": lease_small["fetch_p99_ms"],
+        "fetch_p99_ratio_100_to_2000w": ratio,
+        "per_call_arm": {
+            "completions_per_s": per_call["completions_per_s"],
+            "master_rpcs_per_shard": per_call["master_rpcs_per_shard"],
+            "fetch_p99_ms": per_call["fetch_p99_ms"],
+        },
+        "protocol": (
+            f"bulk-lease workers over {procs} generator processes vs a "
+            "real in-process master (LeaseRequest grant + batched "
+            "LeaseReport acks, group-commit WAL); arms = 100 and 2000 "
+            "workers (p99 flatness) and a per-call TaskRequest/"
+            "TaskReport baseline (2.0 RPCs/shard)"
+        ),
+    }
+    log(f"bench[data_plane]: {out}")
+    return out
+
+
 def section_rescale():
     """In-place rescale vs full restart for the same 4->3 transition.
 
@@ -1751,10 +1808,11 @@ def main():
     # budget guard sheds the tail sections, not the headline.
     default_sections = (
         "small,large,llama,longctx,goodput,ckpt_io,ckpt_dedup,"
-        "opt_shard,rescale,preempt,straggler,master_scale,medium,dtlint"
+        "opt_shard,rescale,preempt,straggler,master_scale,data_plane,"
+        "medium,dtlint"
         if on_tpu else
         "small,goodput,ckpt_io,ckpt_dedup,opt_shard,rescale,preempt,"
-        "straggler,master_scale,dtlint"
+        "straggler,master_scale,data_plane,dtlint"
     )
     sections = os.getenv(
         "DLROVER_TPU_BENCH_SECTIONS", default_sections
@@ -1802,6 +1860,8 @@ def main():
                 extra["straggler"] = section_straggler()
             elif name == "master_scale":
                 extra["master_scale"] = section_master_scale()
+            elif name == "data_plane":
+                extra["data_plane"] = section_data_plane()
             elif name == "dtlint":
                 extra["dtlint"] = section_dtlint()
         except Exception as e:

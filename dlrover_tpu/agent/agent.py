@@ -276,6 +276,23 @@ class ElasticTrainingAgent:
             sink=self._note_probe if self._beat_mode else None,
         )
         self._link_probe.start()
+        # Shard-lease broker (DLROVER_TPU_SHARD_LEASE_PLANE): sub-leases
+        # bulk shard grants to this node's workers over shm, so the
+        # steady-state data path makes zero per-worker master RPCs.
+        self._shard_broker = None
+        plane_cfg = env_utils.SHARD_LEASE_PLANE.get()
+        if plane_cfg:
+            from dlrover_tpu.agent.shard_broker import ShardLeaseBroker
+
+            # "auto" = a per-node name; anything else is used verbatim
+            # (shared-host test jobs must not collide on the segment).
+            plane_name = (
+                f"shard_plane_{self._config.job_name}"
+                f"_n{self._config.node_rank}"
+                if plane_cfg == "auto" else plane_cfg
+            )
+            self._shard_broker = ShardLeaseBroker(self._client, plane_name)
+            self._shard_broker.start()
         # Preemption watcher: notice sources -> journaled report + grace
         # flush, so the master can shrink in place before the kill.
         from dlrover_tpu.agent.preempt import PreemptionWatcher
@@ -374,6 +391,12 @@ class ElasticTrainingAgent:
             env[ConfigPath.ENV_PARAL_CONFIG] = self._config_tuner.path
         if getattr(self, "_metrics_path", ""):
             env[ConfigPath.ENV_RUNTIME_METRICS] = self._metrics_path
+        if getattr(self, "_shard_broker", None) is not None:
+            # Workers' ShardingClients attach to this node's sub-lease
+            # plane instead of fetching shards over RPC.
+            env[env_utils.SHARD_LEASE_PLANE.name] = (
+                self._shard_broker.plane_name
+            )
         env.update(
             {
                 NodeEnv.JOB_NAME: self._config.job_name,
@@ -708,7 +731,7 @@ class ElasticTrainingAgent:
         self._stopped.set()
         for attr in ("_heartbeat_task", "_resource_monitor",
                      "_training_monitor", "_config_tuner", "_link_probe",
-                     "_preempt_watcher"):
+                     "_preempt_watcher", "_shard_broker"):
             task = getattr(self, attr, None)
             if task is not None:
                 task.stop()

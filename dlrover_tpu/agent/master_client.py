@@ -283,6 +283,29 @@ class MasterClient:
             self._inflight_tasks.pop((dataset_name, task_id), None)
         return resp
 
+    def request_lease(self, dataset_name: str,
+                      max_shards: int = 0) -> m.ShardLease:
+        """Bulk-lease up to `max_shards` shards (0 = the master's
+        per-dataset target). The agent broker's refill path — NOT
+        tracked in _inflight_tasks: lease recovery is the master's TTL
+        plus the broker re-leasing after an unknown-lease answer, not
+        the per-task hold-report fencing."""
+        return self._call(
+            m.LeaseRequest(dataset_name=dataset_name, max_shards=max_shards)
+        )
+
+    def report_lease(self, dataset_name: str, lease_id: int, done_ids,
+                     failed_ids=(), release: bool = False) -> m.Response:
+        """Batched completion/failure acks for one lease; also the
+        renewal (any report renews the TTL) and the release."""
+        return self._call(
+            m.LeaseReport(
+                dataset_name=dataset_name, lease_id=lease_id,
+                done_ids=list(done_ids), failed_ids=list(failed_ids),
+                release=release,
+            )
+        )
+
     def get_shard_checkpoint(self, dataset_name: str) -> str:
         resp: m.ShardCheckpoint = self._call(
             m.ShardCheckpointRequest(dataset_name=dataset_name)

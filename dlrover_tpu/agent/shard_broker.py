@@ -1,0 +1,334 @@
+"""Agent-side sub-lease broker: the only process that talks shards to
+the master.
+
+The broker turns the master's bulk leases into an agent-local data
+plane: it keeps the shm fetch ring (see
+:mod:`dlrover_tpu.common.shard_plane`) topped up with sub-leased
+:class:`~dlrover_tpu.common.messages.ShardTask` frames, drains the
+completion ring, and folds the acks into batched
+:class:`~dlrover_tpu.common.messages.LeaseReport` RPCs on the coalesced
+beat cadence. Steady state from the master's point of view: one
+``LeaseRequest`` per a few hundred shards plus one ``LeaseReport`` per
+batch — ~0.01 RPCs per shard instead of 2.
+
+Failure shapes:
+
+- *broker/agent dies*: the lease stops renewing, the master's TTL sweep
+  re-dispatches every outstanding shard (at-least-once; frames stranded
+  in the dead segment are re-trained elsewhere).
+- *master fails over*: replayed grant records reproduce the lease table
+  (see ``master/shard/lease_service.py``); the broker just keeps
+  reporting. An ``unknown lease`` answer (expired or genuinely lost)
+  means the master already requeued the remainder — the broker drops
+  its local bookkeeping and leases afresh.
+- *rescale requeue*: workers hand unprocessed shards back through the
+  completion ring (``REQUEUE`` frames) and the broker re-offers them on
+  the fetch ring — sub-leased shards return to the *agent*, never to
+  the master (``ShardingClient.requeue_pending`` contract).
+"""
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.lockdep import instrumented_lock
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.shard_plane import (
+    FRAME_DONE,
+    FRAME_REQUEUE,
+    FRAME_SUBSCRIBE,
+    ShardPlane,
+)
+
+
+class _LeaseState:
+    """One live lease: outstanding ids + the unflushed ack buffers."""
+
+    def __init__(self, lease_id: int, dataset: str, ttl_s: float,
+                 task_ids: Set[int]):
+        self.lease_id = lease_id
+        self.dataset = dataset
+        self.ttl_s = ttl_s
+        self.outstanding = set(task_ids)
+        self.done: List[int] = []
+        self.failed: List[int] = []
+        self.last_report = time.monotonic()
+
+
+class ShardLeaseBroker:
+    """The agent's shard sub-lease loop (one background thread)."""
+
+    #: dtlint DT009: lease table, per-dataset registry and the
+    #: task->lease index all move together under the broker lock; the
+    #: counters are single-writer stats (the loop thread), torn reads
+    #: harmless.
+    GUARDED_BY = {
+        "_leases": "agent.shard_broker",
+        "_datasets": "agent.shard_broker",
+        "_task_lease": "agent.shard_broker",
+        "leases_taken": None,
+        "completions_flushed": None,
+        "requeues": None,
+    }
+
+    def __init__(self, client, plane_name: str,
+                 size_mb: Optional[int] = None,
+                 batch: Optional[int] = None,
+                 flush_s: Optional[float] = None,
+                 low_water: Optional[int] = None,
+                 poll_s: float = 0.02):
+        self._client = client
+        self._plane = ShardPlane(
+            plane_name, create=True,
+            size_mb=size_mb or env_utils.SHARD_LEASE_PLANE_MB.get(),
+        )
+        self._batch = batch or env_utils.SHARD_LEASE_BATCH.get()
+        self._flush_s = (
+            flush_s if flush_s is not None
+            else env_utils.SHARD_LEASE_FLUSH_S.get()
+        )
+        self._low_water = (
+            low_water if low_water is not None
+            else env_utils.SHARD_LEASE_LOW_WATER.get()
+        )
+        self._poll_s = poll_s
+        self._lock = instrumented_lock("agent.shard_broker")
+        self._leases: Dict[int, _LeaseState] = {}
+        # dataset -> {"finished": bool, "registered": params or None}
+        self._datasets: Dict[str, Dict[str, Any]] = {}
+        self._task_lease: Dict[Tuple[str, int], int] = {}
+        self.leases_taken = 0
+        self.completions_flushed = 0
+        self.requeues = 0
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def plane_name(self) -> str:
+        return self._plane.name
+
+    # ---------------- lifecycle ----------------
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="shard-broker",
+        )
+        self._thread.start()
+
+    def stop(self):
+        """Release every lease (hand outstanding shards back to the
+        master for immediate re-dispatch) and tear the plane down."""
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.release()
+        self._plane.unlink()
+
+    def add_dataset(self, name: str, register_params: Optional[dict] = None):
+        """Start sub-leasing `name`. Normally self-discovered from
+        worker SUBSCRIBE frames; explicit registration is for agents
+        that know their datasets up front."""
+        with self._lock:
+            if name not in self._datasets:
+                self._datasets[name] = {
+                    "finished": False, "params": register_params,
+                }
+
+    def release(self) -> int:
+        """Flush every buffered ack with ``release=True``: the master
+        requeues whatever is still outstanding (shutdown / rescale
+        teardown). Returns the number of leases released."""
+        with self._lock:
+            leases = list(self._leases.values())
+            self._leases.clear()
+            self._task_lease.clear()
+        for lease in leases:
+            try:
+                self._client.report_lease(
+                    lease.dataset, lease.lease_id, lease.done,
+                    failed_ids=lease.failed, release=True,
+                )
+            except Exception as e:
+                # The TTL sweep re-dispatches it anyway; release just
+                # makes the handback prompt.
+                logger.warning("lease %s release failed: %s",
+                               lease.lease_id, e)
+        return len(leases)
+
+    # ---------------- the loop ----------------
+    def _loop(self):
+        while not self._stopped.wait(self._poll_s):
+            try:
+                self.pump()
+            except Exception:
+                logger.exception("shard broker iteration failed")
+
+    def pump(self):
+        """One broker iteration: drain acks, flush/renew, refill.
+        Public so tests (and a future inline mode) can drive the broker
+        without the thread."""
+        self._drain()
+        self._flush(force=False)
+        self._refill()
+
+    def _drain(self):
+        for kind, data in self._plane.drain_completions():
+            if kind == FRAME_SUBSCRIBE:
+                name, params = data
+                self.add_dataset(name, params)
+            elif kind == FRAME_DONE:
+                dataset, task_id, success = data
+                with self._lock:
+                    lid = self._task_lease.pop((dataset, task_id), None)
+                    lease = self._leases.get(lid) if lid is not None else None
+                    if lease is None:
+                        # Its lease expired or was dropped: the master
+                        # already requeued the shard, someone else will
+                        # train it again (at-least-once, never lost).
+                        continue
+                    (lease.done if success else lease.failed).append(task_id)
+                    lease.outstanding.discard(task_id)
+            elif kind == FRAME_REQUEUE:
+                task = data
+                # Local re-dispatch: back onto the fetch ring, the
+                # master never hears about it. The shard stays in its
+                # lease's outstanding set, so TTL/agent-failure recovery
+                # still covers it.
+                self.requeues += 1
+                if not self._plane.push_task(task):
+                    # Ring full: fail it upward instead — the master
+                    # requeues it for any worker.
+                    with self._lock:
+                        lid = self._task_lease.pop(
+                            (task.dataset_name, task.task_id), None
+                        )
+                        lease = (
+                            self._leases.get(lid) if lid is not None else None
+                        )
+                        if lease is not None:
+                            lease.failed.append(task.task_id)
+                            lease.outstanding.discard(task.task_id)
+
+    def _flush(self, force: bool):
+        now = time.monotonic()
+        to_send: List[_LeaseState] = []
+        with self._lock:
+            for lease in self._leases.values():
+                pending = len(lease.done) + len(lease.failed)
+                renewal_due = (
+                    lease.ttl_s > 0
+                    and lease.outstanding
+                    and now - lease.last_report > lease.ttl_s / 3
+                )
+                if (
+                    force or pending >= self._batch
+                    or (pending and now - lease.last_report > self._flush_s)
+                    or renewal_due
+                ):
+                    to_send.append(lease)
+        for lease in to_send:
+            with self._lock:
+                done, lease.done = lease.done, []
+                failed, lease.failed = lease.failed, []
+                lease.last_report = now
+                empty = not lease.outstanding and not done and not failed
+            if empty:
+                with self._lock:
+                    self._leases.pop(lease.lease_id, None)
+                continue
+            try:
+                resp = self._client.report_lease(
+                    lease.dataset, lease.lease_id, done, failed_ids=failed
+                )
+            except Exception as e:
+                # Put the acks back; LeaseReport is journaled+deduped on
+                # the master, so the retry lands exactly once.
+                logger.warning("lease %s report failed, will retry: %s",
+                               lease.lease_id, e)
+                with self._lock:
+                    lease.done = done + lease.done
+                    lease.failed = failed + lease.failed
+                continue
+            self.completions_flushed += len(done) + len(failed)
+            with self._lock:
+                if resp is not None and not resp.success:
+                    # Unknown lease: expired or lost — the master already
+                    # requeued the remainder. Drop local bookkeeping;
+                    # frames still in the ring ack into the void (their
+                    # shards get re-trained elsewhere: at-least-once).
+                    self._drop_lease(lease)
+                elif not lease.outstanding and not lease.done \
+                        and not lease.failed:
+                    self._leases.pop(lease.lease_id, None)
+
+    def _drop_lease(self, lease: _LeaseState):  # dtlint: holds(agent.shard_broker)
+        self._leases.pop(lease.lease_id, None)
+        for tid in lease.outstanding:
+            self._task_lease.pop((lease.dataset, tid), None)
+        lease.outstanding.clear()
+
+    def _refill(self):
+        if self._plane.task_backlog() >= self._low_water:
+            return
+        with self._lock:
+            wanted = [
+                (name, st) for name, st in self._datasets.items()
+                if not st["finished"]
+            ]
+        for name, st in wanted:
+            if st["params"] and not st.get("registered"):
+                # Worker shipped the registration params through the
+                # ring (fully RPC-free workers): register on its behalf.
+                try:
+                    self._client.report_dataset_shard_params(**st["params"])
+                    st["registered"] = True
+                except Exception as e:
+                    logger.warning("dataset %s registration failed: %s",
+                                   name, e)
+                    continue
+            try:
+                lease = self._client.request_lease(name)
+            except Exception as e:
+                logger.warning("lease request for %s failed: %s", name, e)
+                continue
+            if lease is None:
+                continue
+            if lease.exists:
+                state = _LeaseState(
+                    lease.lease_id, name, lease.ttl_s,
+                    {t.task_id for t in lease.tasks},
+                )
+                with self._lock:
+                    self._leases[lease.lease_id] = state
+                    for t in lease.tasks:
+                        self._task_lease[(name, t.task_id)] = lease.lease_id
+                self.leases_taken += 1
+                for t in lease.tasks:
+                    if not self._plane.push_task(t):
+                        # Ring full mid-lease: hand the rest back now
+                        # rather than strand it until the TTL.
+                        with self._lock:
+                            state.failed.append(t.task_id)
+                            state.outstanding.discard(t.task_id)
+                            self._task_lease.pop((name, t.task_id), None)
+            elif lease.finished:
+                with self._lock:
+                    st["finished"] = True
+                    all_done = all(
+                        d["finished"] for d in self._datasets.values()
+                    ) and not self._leases
+                if all_done:
+                    self._plane.set_finished()
+
+    # ---------------- introspection ----------------
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "live_leases": len(self._leases),
+                "outstanding": sum(
+                    len(x.outstanding) for x in self._leases.values()
+                ),
+                "leases_taken": self.leases_taken,
+                "completions_flushed": self.completions_flushed,
+                "requeues": self.requeues,
+            }

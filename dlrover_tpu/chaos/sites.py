@@ -53,6 +53,13 @@ class ChaosSite:
     #: opens; omit/negative = notice without a kill — false alarm).
     #: Detail = node rank.
     PREEMPT_NOTICE = "preempt.notice"
+    #: ShardLeaseService.grant, before any shard is popped (drop: the
+    #: grant answers empty and the client retries; delay: sleep
+    #: args["delay_s"] first), detail = dataset name.
+    SHARD_LEASE_DELIVER = "shard.lease.deliver"
+    #: ShardLeaseService.tick expiry sweep: force-expire a live lease
+    #: as if its TTL lapsed (whole-lease re-dispatch), detail = lease id.
+    SHARD_LEASE_EXPIRE = "shard.lease.expire"
     #: Reserved for unit drills of the injector mechanics themselves
     #: (schedules, journaling): never instrumented in product code.
     TEST_PROBE = "test.probe"

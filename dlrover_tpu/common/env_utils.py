@@ -261,10 +261,61 @@ STATE_SNAPSHOT_SECS = ENV.float(
     "DLROVER_TPU_STATE_SNAPSHOT_SECS", 30.0,
     "Seconds between periodic master state-store snapshots (journal "
     "rotation).")
+STATE_SNAPSHOT_RECORDS = ENV.int(
+    "DLROVER_TPU_STATE_SNAPSHOT_RECORDS", 2048,
+    "Journal-record backstop forcing a snapshot between the periodic "
+    "ones. A snapshot quiesces every mutation shard while it pickles "
+    "the task table, so at lease data-plane rates (each grant/report "
+    "is one record) the default can convoy the whole plane — raise it "
+    "for shard-heavy jobs; replay time is the trade.")
 SHARD_TIMEOUT = ENV.float(
     "DLROVER_TPU_SHARD_TIMEOUT", 300.0,
     "Seconds a dispatched data shard may stay unacked before the master "
     "reclaims it into todo.")
+SHARD_LEASE_SHARDS = ENV.int(
+    "DLROVER_TPU_SHARD_LEASE_SHARDS", 256,
+    "Default shards per bulk lease grant (LeaseRequest.max_shards=0 "
+    "falls back to it). Sized so one grant RPC covers seconds of a "
+    "host's consumption; the 1/lease + 1/batch RPC amortization is the "
+    "whole point of the lease plane.")
+SHARD_LEASE_TTL_S = ENV.float(
+    "DLROVER_TPU_SHARD_LEASE_TTL_S", 300.0,
+    "Lease time-to-live: a lease not renewed (any LeaseReport renews) "
+    "within this window is expired wholesale — every still-outstanding "
+    "shard re-enters todo under fresh ids, exactly the doing-timeout "
+    "contract at lease granularity.")
+SHARD_LEASE_BATCH = ENV.int(
+    "DLROVER_TPU_SHARD_LEASE_BATCH", 256,
+    "Completion ids the agent broker buffers before flushing a "
+    "LeaseReport to the master (the batch threshold; the flush "
+    "interval below bounds latency when consumption is slow).")
+SHARD_LEASE_FLUSH_S = ENV.float(
+    "DLROVER_TPU_SHARD_LEASE_FLUSH_S", 2.0,
+    "Max seconds the agent broker may hold buffered shard completions "
+    "before flushing them, batch full or not — the beat-cadence bound "
+    "on how much re-training a broker crash can cost.")
+SHARD_LEASE_PLANE = ENV.str(
+    "DLROVER_TPU_SHARD_LEASE_PLANE", "",
+    "Name of the shm shard plane workers attach to. Exported by an "
+    "agent running a shard-lease broker; when set, ShardingClient "
+    "fetches shards and reports completions over shm with zero master "
+    "RPCs in steady state. Empty = legacy per-call RPC path.")
+SHARD_LEASE_PLANE_MB = ENV.int(
+    "DLROVER_TPU_SHARD_LEASE_PLANE_MB", 4,
+    "Size of the shm shard-plane segment in MiB (fetch ring + "
+    "completion ring).")
+SHARD_LEASE_LOW_WATER = ENV.int(
+    "DLROVER_TPU_SHARD_LEASE_LOW_WATER", 128,
+    "The agent broker requests a fresh lease when the shards it holds "
+    "locally (sub-leased but unacked) drop below this count.")
+SHARD_LEASE_READAHEAD = ENV.int(
+    "DLROVER_TPU_SHARD_LEASE_READAHEAD", 0,
+    "Shards the dataloader's readahead cache preloads ahead of "
+    "consumption (keyed by shard id); 0 disables readahead.")
+SHARD_LEASE_MIX_POLL_S = ENV.float(
+    "DLROVER_TPU_SHARD_LEASE_MIX_POLL_S", 5.0,
+    "Seconds between mixture-weight refreshes from the master kv store "
+    "(the live-tunable weighted-sampling knob of the data plane).")
 HANG_DETECTION_SECS = ENV.float(
     "DLROVER_TPU_HANG_DETECTION_SECS", 1800.0,
     "No step progress for this long marks the job hung.")

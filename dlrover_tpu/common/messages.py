@@ -218,6 +218,73 @@ class TaskHoldReport(BaseRequest):
 
 
 @dataclass
+class LeaseRequest(BaseRequest):
+    """Bulk shard lease: hundreds of contiguous shards in one RPC.
+
+    The data-plane amortization lever — one grant covers seconds of a
+    host's consumption, so the master sees O(1/lease) RPCs instead of
+    O(1/shard). Logged after dispatch like :class:`TaskRequest` (the
+    record must carry the shard ids the handler chose); see
+    ``servicer._APPLY_THEN_LOG``.
+    """
+
+    journaled = "apply-then-log"
+
+    dataset_name: str = ""
+    #: max shards wanted; 0 = the master's per-dataset target
+    #: (DLROVER_TPU_SHARD_LEASE_SHARDS).
+    max_shards: int = 0
+
+
+@dataclass
+class ShardLease:
+    """A granted lease: a batch of shard tasks owned by one agent.
+
+    Every task is simultaneously a ``doing`` entry in the TaskManager
+    (worker_id = the leasing agent), so worker-failure recovery and the
+    doing-timeout keep working unchanged underneath the lease."""
+
+    lease_id: int = -1
+    dataset_name: str = ""
+    tasks: List[ShardTask] = field(default_factory=list)
+    #: seconds the holder has to renew (any LeaseReport renews) before
+    #: the whole lease is re-dispatched.
+    ttl_s: float = 0.0
+    #: mirrors ShardTask.finished/unknown for empty answers.
+    finished: bool = False
+    unknown: bool = False
+
+    @property
+    def exists(self) -> bool:
+        return self.lease_id >= 0
+
+
+@dataclass
+class LeaseReport(BaseRequest):
+    """Batched completion/renewal/release for a held lease.
+
+    Journaled + request-id-deduped like every mutating RPC, so a retried
+    completion batch lands exactly once — the at-least-once shard
+    contract survives both client retries and master failover replay.
+    ``success=False`` in the answer means the master no longer knows the
+    lease (expired or lost): its shards were already re-dispatched, so
+    the broker must drop its local copies and lease afresh.
+    """
+
+    journaled = True
+
+    dataset_name: str = ""
+    lease_id: int = -1
+    #: task ids whose records were trained (acked exactly once each).
+    done_ids: List[int] = field(default_factory=list)
+    #: task ids handed back for immediate re-dispatch.
+    failed_ids: List[int] = field(default_factory=list)
+    #: True: release the lease — every still-outstanding shard returns
+    #: to todo (agent shutdown / rescale handback).
+    release: bool = False
+
+
+@dataclass
 class ShardCheckpointRequest(BaseRequest):
     dataset_name: str = ""
 

@@ -1,0 +1,315 @@
+"""The agent-local shard plane: shm rings between broker and workers.
+
+One shared-memory segment per agent carries the whole steady-state data
+path, so a worker fetching a shard or acking a completion never makes an
+RPC — the broker is the only process that ever talks to the master:
+
+- the **fetch ring** (broker -> workers): the broker pushes sub-leased
+  :class:`~dlrover_tpu.common.messages.ShardTask` frames; any worker
+  pops the next one (work-stealing order, like the master's todo deque);
+- the **completion ring** (workers -> broker): workers push DONE/FAIL
+  acks and REQUEUE handbacks; the broker drains them into batched
+  :class:`~dlrover_tpu.common.messages.LeaseReport` RPCs.
+
+Both rings are classic single-region byte rings of length-prefixed
+pickled frames with a wrap marker (``0xFFFFFFFF``) padding the tail gap.
+Mutual exclusion is ``flock`` on the segment's backing file — taken on
+an fd each :class:`ShardPlane` instance opens for itself, so the lock is
+held per open-file-description and therefore excludes across processes
+AND across instances in one process; a per-instance ``threading.Lock``
+covers threads sharing a single instance. The plane carries *leased*
+work only: if the segment dies with the agent, the master's lease TTL
+re-dispatches everything in it (at-least-once, never lost).
+"""
+
+import errno
+import fcntl
+import os
+import pickle
+import struct
+import threading
+import time
+from typing import Any, List, Optional, Tuple
+
+from dlrover_tpu.common import env_utils, shared_memory
+from dlrover_tpu.common.shared_memory import SharedMemory
+
+_MAGIC = 0x53484152445F504C  # "SHARD_PL"
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+_WRAP = 0xFFFFFFFF
+
+# Header slots (u64 each).
+_H_MAGIC = 0
+_H_FETCH_HEAD = 1
+_H_FETCH_TAIL = 2
+_H_COMP_HEAD = 3
+_H_COMP_TAIL = 4
+_H_PUSHED = 5
+_H_POPPED = 6
+_H_FLAGS = 7
+_HEADER = 8 * 8
+
+_FLAG_FINISHED = 1
+
+#: Frame types.
+FRAME_TASK = 1
+FRAME_DONE = 2
+FRAME_REQUEUE = 3
+FRAME_SUBSCRIBE = 4
+
+
+class _Ring:
+    """One byte ring inside the segment: [start, start+size)."""
+
+    def __init__(self, start: int, size: int, head_slot: int, tail_slot: int):
+        self.start = start
+        self.size = size
+        self.head_slot = head_slot
+        self.tail_slot = tail_slot
+
+
+class ShardPlane:
+    """One endpoint (broker or worker) of the agent's shard segment."""
+
+    #: dtlint DT009: ring pointers live in the shm header and are only
+    #: touched under the cross-process flock; the instance lock below
+    #: serializes threads sharing this endpoint's fd.
+    GUARDED_BY = {
+        "_shm": None,
+        "_lock_fd": None,
+    }
+
+    def __init__(self, name: str, create: bool = False, size_mb: int = 4):
+        self.name = name
+        if create:
+            SharedMemory.remove(name)  # stale segment from a dead agent
+            self._shm = SharedMemory(name, create=True,
+                                     size=max(1, size_mb) << 20)
+        else:
+            self._shm = SharedMemory(name)
+        body = self._shm.size - _HEADER
+        fetch_size = body * 3 // 4
+        self._fetch = _Ring(_HEADER, fetch_size,
+                            _H_FETCH_HEAD, _H_FETCH_TAIL)
+        self._comp = _Ring(_HEADER + fetch_size, body - fetch_size,
+                           _H_COMP_HEAD, _H_COMP_TAIL)
+        # flock is per open-file-description: a private fd per endpoint
+        # makes the lock exclude other processes and other endpoints in
+        # this process alike; _lock covers threads sharing THIS endpoint.
+        self._lock_fd = os.open(shared_memory._path(name), os.O_RDWR)
+        self._lock = threading.Lock()
+        if create:
+            buf = self._shm.buf
+            buf[:_HEADER] = b"\x00" * _HEADER
+            self._put_u64(_H_MAGIC, _MAGIC)
+        elif self._get_u64(_H_MAGIC) != _MAGIC:
+            raise ValueError(f"{name} is not a shard plane segment")
+
+    # ---------------- header accessors ----------------
+    def _get_u64(self, slot: int) -> int:
+        off = slot * 8
+        return _U64.unpack_from(self._shm.buf, off)[0]
+
+    def _put_u64(self, slot: int, value: int):
+        _U64.pack_into(self._shm.buf, slot * 8, value)
+
+    # ---------------- locked region ----------------
+    def _excl(self):
+        return _PlaneLock(self)
+
+    # ---------------- ring mechanics (call under _excl) ----------------
+    def _free(self, ring: _Ring) -> int:
+        head = self._get_u64(ring.head_slot)
+        tail = self._get_u64(ring.tail_slot)
+        return (head - tail - 1) % ring.size
+
+    def _push(self, ring: _Ring, payload: bytes) -> bool:
+        need = 4 + len(payload)
+        if need + 4 > ring.size:
+            raise ValueError(
+                f"frame of {len(payload)} bytes exceeds ring capacity "
+                f"{ring.size}; raise {env_utils.SHARD_LEASE_PLANE_MB.name}"
+            )
+        buf = self._shm.buf
+        tail = self._get_u64(ring.tail_slot)
+        free = self._free(ring)
+        room_to_end = ring.size - tail
+        if room_to_end < need:
+            # Wrapping burns the whole tail gap as padding — count it
+            # against free space or the wrapped write overruns unread
+            # frames at the region start.
+            if free < room_to_end + need:
+                return False
+            if room_to_end >= 4:
+                _U32.pack_into(buf, ring.start + tail, _WRAP)
+            # A gap of < 4 bytes can't hold a marker; the reader treats
+            # it as an implicit wrap.
+            tail = 0
+        elif free < need:
+            return False
+        off = ring.start + tail
+        _U32.pack_into(buf, off, len(payload))
+        buf[off + 4:off + 4 + len(payload)] = payload
+        self._put_u64(ring.tail_slot, (tail + need) % ring.size)
+        return True
+
+    def _pop(self, ring: _Ring) -> Optional[bytes]:
+        head = self._get_u64(ring.head_slot)
+        tail = self._get_u64(ring.tail_slot)
+        if head == tail:
+            return None
+        buf = self._shm.buf
+        if ring.size - head < 4:
+            head = 0
+            if head == tail:
+                return None
+        length = _U32.unpack_from(buf, ring.start + head)[0]
+        if length == _WRAP:
+            head = 0
+            if head == tail:
+                return None
+            length = _U32.unpack_from(buf, ring.start + head)[0]
+        off = ring.start + head + 4
+        payload = bytes(buf[off:off + length])
+        self._put_u64(ring.head_slot, (head + 4 + length) % ring.size)
+        return payload
+
+    # ---------------- fetch ring (broker pushes, workers pop) ----------
+    def push_task(self, task) -> bool:
+        """Broker side: offer one sub-leased task; False when full."""
+        frame = pickle.dumps((FRAME_TASK, task), pickle.HIGHEST_PROTOCOL)
+        with self._excl():
+            if not self._push(self._fetch, frame):
+                return False
+            self._put_u64(_H_PUSHED, self._get_u64(_H_PUSHED) + 1)
+            return True
+
+    def pop_task(self, timeout: float = 0.0):
+        """Worker side: take the next task, polling up to `timeout`.
+        Returns None when empty (check :attr:`finished` to distinguish
+        end-of-data from a momentarily dry ring)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._excl():
+                frame = self._pop(self._fetch)
+                if frame is not None:
+                    self._put_u64(_H_POPPED, self._get_u64(_H_POPPED) + 1)
+            if frame is not None:
+                kind, task = pickle.loads(frame)
+                return task
+            if self.finished or time.monotonic() >= deadline:
+                return None
+            time.sleep(0.002)  # dtlint: disable=DT003 -- shm ring poll: the broker refills on a ms cadence, backoff would only add fetch latency; the outer deadline bounds the spin
+
+    def task_backlog(self) -> int:
+        """Sub-leased tasks sitting unfetched in the ring (the broker's
+        low-water refill probe)."""
+        with self._excl():
+            return self._get_u64(_H_PUSHED) - self._get_u64(_H_POPPED)
+
+    # ---------------- completion ring (workers push, broker drains) ----
+    def push_done(self, dataset_name: str, task_id: int,
+                  success: bool = True, timeout: float = 5.0) -> bool:
+        """Worker side: ack one shard. Spins while the ring is full —
+        the broker drains on its flush cadence, so a full ring resolves
+        in milliseconds; False only past `timeout` (broker gone; the
+        lease TTL then re-dispatches, at-least-once preserved)."""
+        frame = pickle.dumps(
+            (FRAME_DONE, (dataset_name, task_id, success)),
+            pickle.HIGHEST_PROTOCOL,
+        )
+        return self._push_completion(frame, timeout)
+
+    def push_requeue(self, task, timeout: float = 5.0) -> bool:
+        """Worker side: hand an unprocessed task back to the broker
+        (rescale requeue) instead of to the master."""
+        frame = pickle.dumps((FRAME_REQUEUE, task), pickle.HIGHEST_PROTOCOL)
+        return self._push_completion(frame, timeout)
+
+    def subscribe(self, dataset_name: str, register_params=None,
+                  timeout: float = 5.0) -> bool:
+        """Worker side: announce a dataset to the broker (with the
+        registration params when the worker has no master client of its
+        own — the broker then registers on its behalf)."""
+        frame = pickle.dumps(
+            (FRAME_SUBSCRIBE, (dataset_name, register_params)),
+            pickle.HIGHEST_PROTOCOL,
+        )
+        return self._push_completion(frame, timeout)
+
+    def _push_completion(self, frame: bytes, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._excl():
+                if self._push(self._comp, frame):
+                    return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)  # dtlint: disable=DT003 -- full completion ring drains on the broker's flush cadence (ms); fixed 2ms recheck is the latency floor, deadline bounds it
+
+    def drain_completions(self, max_frames: int = 4096) -> List[Tuple[int, Any]]:
+        """Broker side: pop every pending completion frame (bounded)."""
+        out: List[Tuple[int, Any]] = []
+        with self._excl():
+            while len(out) < max_frames:
+                frame = self._pop(self._comp)
+                if frame is None:
+                    break
+                out.append(pickle.loads(frame))
+        return out
+
+    # ---------------- end-of-data flag ----------------
+    @property
+    def finished(self) -> bool:
+        return bool(self._get_u64(_H_FLAGS) & _FLAG_FINISHED)
+
+    def set_finished(self, value: bool = True):
+        with self._excl():
+            flags = self._get_u64(_H_FLAGS)
+            flags = flags | _FLAG_FINISHED if value else flags & ~_FLAG_FINISHED
+            self._put_u64(_H_FLAGS, flags)
+
+    # ---------------- lifecycle ----------------
+    def close(self):
+        if self._lock_fd is not None:
+            try:
+                os.close(self._lock_fd)
+            except OSError:
+                pass
+            self._lock_fd = None
+        self._shm.close()
+
+    def unlink(self):
+        if self._lock_fd is not None:
+            try:
+                os.close(self._lock_fd)
+            except OSError:
+                pass
+            self._lock_fd = None
+        self._shm.unlink()
+
+
+class _PlaneLock:
+    """Thread lock + cross-process flock, as one context manager."""
+
+    def __init__(self, plane: ShardPlane):
+        self._plane = plane
+
+    def __enter__(self):
+        self._plane._lock.acquire()
+        while True:
+            try:
+                fcntl.flock(self._plane._lock_fd, fcntl.LOCK_EX)
+                return self
+            except OSError as e:  # EINTR under signal storms
+                if e.errno != errno.EINTR:
+                    self._plane._lock.release()
+                    raise
+
+    def __exit__(self, *exc):
+        try:
+            fcntl.flock(self._plane._lock_fd, fcntl.LOCK_UN)
+        finally:
+            self._plane._lock.release()
+        return False

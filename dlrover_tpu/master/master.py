@@ -38,6 +38,7 @@ from dlrover_tpu.master.rendezvous import (
 from dlrover_tpu.master.preempt import PreemptionCoordinator
 from dlrover_tpu.master.rescale import RescaleCoordinator
 from dlrover_tpu.master.servicer import MasterServicer, create_master_service
+from dlrover_tpu.master.shard.lease_service import ShardLeaseService
 from dlrover_tpu.master.shard.task_manager import TaskManager
 from dlrover_tpu.master.state_store import MasterStateStore
 from dlrover_tpu.master.stats import JobMetricCollector
@@ -141,6 +142,12 @@ class JobMaster:
             rescale_coordinator=self.rescale,
             state_store=self.state_store,
         )
+        # Shard-lease data plane: bulk dispatch to agent brokers so the
+        # per-shard traffic never reaches the master in steady state.
+        self.shard_lease = ShardLeaseService(
+            self.task_manager, state_store=self.state_store
+        )
+        self.observability.attach(shard_lease=self.shard_lease)
         # Per-subsystem mutation shards replace the old global mutation
         # lock; the snapshot quiesce holds ALL of them (in canonical
         # order) so no journal record can land past a rotation it isn't
@@ -161,6 +168,7 @@ class JobMaster:
             rescale_coordinator=self.rescale,
             preempt_coordinator=self.preempt,
             mutation_locks=self.mutation_locks,
+            shard_lease=self.shard_lease,
         )
         self._server = create_master_service(port, self.servicer)
         self.port = self._server.port
@@ -229,6 +237,7 @@ class JobMaster:
             "events": self.observability.event_log.export_state(),
             "rescale": self.rescale.checkpoint(),
             "preempt": self.preempt.checkpoint(),
+            "shard_lease": self.shard_lease.checkpoint(),
         }
 
     def _recover_state(self):
@@ -262,6 +271,7 @@ class JobMaster:
                     self.observability.event_log.restore_state(ev_state)
                 self.rescale.restore(state.get("rescale", {}))
                 self.preempt.restore(state.get("preempt", {}))
+                self.shard_lease.restore(state.get("shard_lease", {}))
             for rec in records:
                 try:
                     kind = rec[0]
@@ -300,6 +310,11 @@ class JobMaster:
                     elif kind == "preempt":
                         _, payload, ts = rec
                         self.preempt.replay(payload)
+                    elif kind == "lease":
+                        _, req_id, payload, ts = rec
+                        resp = self.shard_lease.replay(payload)
+                        if req_id and resp is not None and now - ts < DEDUP_TTL:
+                            seeds.append((req_id, resp))
                     else:
                         logger.warning("skipping unknown journal record %r",
                                        kind)
@@ -405,6 +420,7 @@ class JobMaster:
                     self.speed_monitor.reset_worker_reports()
                 self.rescale.tick()
                 self.preempt.tick()
+                self.shard_lease.tick()
                 self.straggler_detector.tick()
                 if self.state_store is not None:
                     self.state_store.maybe_snapshot(self._collect_state)
@@ -444,6 +460,9 @@ class JobMaster:
         for mgr in self.rdzv_managers.values():
             mgr.remove_alive_node(node_id)
         self.task_manager.recover_worker_tasks(node_id)
+        # Leased shards re-entered todo just now; drop the lease
+        # bookkeeping so expiry cannot requeue them twice.
+        self.shard_lease.drop_agent(node_id)
         self.speed_monitor.remove_worker(node_id)
         self.straggler_detector.remove_worker(node_id)
         self.metric_collector.remove_node(node_id)

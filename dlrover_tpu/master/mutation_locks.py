@@ -56,6 +56,7 @@ LOCK_ORDER: Tuple[Tuple[str, ...], ...] = (
         "master.kv_store",
         "master.rescale",
         "master.preempt",
+        "master.shard_lease",
         "master.sync_service",
         "master.straggler",
         "master.job_collector",
@@ -78,6 +79,10 @@ _SHARDS_BY_TYPE: Dict[type, Tuple[str, ...]] = {
     m.DatasetShardParams: ("tasks",),
     m.TaskRequest: ("tasks",),
     m.TaskReport: ("tasks",),
+    # The lease plane is bulk dispatch/ack over the same todo/doing
+    # queues the per-call path mutates.
+    m.LeaseRequest: ("tasks",),
+    m.LeaseReport: ("tasks",),
     m.TaskHoldReport: ("tasks",),
     # Status changes also reclaim the node's in-flight shards.
     m.NodeStatusReport: ("tasks", "nodes"),

@@ -48,6 +48,9 @@ _JOURNALED = (
     # A preemption notice arms the proactive shrink and hands off writer
     # leases; a master failover mid-notice must replay it exactly once.
     m.PreemptionNotice,
+    # Batched lease completions: a retried batch must land exactly once
+    # (the dedup cache absorbs it live; replay re-derives the acks).
+    m.LeaseReport,
 )
 
 #: Mutating messages journaled AFTER their handler runs: the record must
@@ -56,6 +59,10 @@ _JOURNALED = (
 #: fencing protocol (clients re-report held tasks on incarnation change).
 _APPLY_THEN_LOG = (
     m.TaskRequest,
+    # Bulk grants: the record must carry the shard ids the service
+    # chose; _handle special-cases the journal payload (a "lease"
+    # record, not a "dispatch" one).
+    m.LeaseRequest,
 )
 
 
@@ -86,6 +93,7 @@ class MasterServicer:
         rescale_coordinator=None,
         preempt_coordinator=None,
         mutation_locks=None,
+        shard_lease=None,
     ):
         self._rdzv_managers = rdzv_managers
         self._kv_store = kv_store
@@ -98,6 +106,15 @@ class MasterServicer:
         self._observability = observability
         self._rescale = rescale_coordinator
         self._preempt = preempt_coordinator
+        if shard_lease is None:
+            from dlrover_tpu.master.shard.lease_service import (
+                ShardLeaseService,
+            )
+
+            shard_lease = ShardLeaseService(
+                task_manager, state_store=state_store
+            )
+        self._shard_lease = shard_lease
         self._locks = mutation_locks or MutationLocks()
         # Bulk-lane load probe, wired by attach_server: drives the
         # EventReport telemetry-shedding backpressure below.
@@ -151,6 +168,21 @@ class MasterServicer:
             raise ValueError(f"unknown control message {type(request).__name__}")
         if replaying or store is None:
             return handler(self, request)
+        if isinstance(request, m.LeaseRequest):
+            # Bulk grants are apply-then-log like TaskRequest, but under
+            # their own "lease" tag: the record carries the granted ids
+            # (not ranges — replay re-pops them from the reproduced todo)
+            # plus the lease bookkeeping. Empty answers journal nothing.
+            seq = None
+            with self._locks.for_message(request):
+                lease = handler(self, request)
+                payload = self._shard_lease.grant_payload(request, lease)
+                if payload is not None:
+                    seq = store.append(
+                        ("lease", current_request_id(), payload, time.time())  # dtlint: disable=DT011 -- write-path timestamp recorded INTO the lease record; during replay append is a no-op and the value is discarded
+                    )
+            store.wait_durable(seq)
+            return lease
         if isinstance(request, _APPLY_THEN_LOG):
             # Dispatch is journaled AFTER the handler (apply-then-log):
             # the record must carry the chosen shard's exact range, and
@@ -324,6 +356,12 @@ class MasterServicer:
         )
         return m.Response(success=ok)
 
+    def _lease_request(self, req: m.LeaseRequest):
+        return self._shard_lease.grant(req)
+
+    def _lease_report(self, req: m.LeaseReport):
+        return self._shard_lease.report(req)
+
     def _get_shard_checkpoint(self, req: m.ShardCheckpointRequest):
         return m.ShardCheckpoint(content=self._task_manager.checkpoint())
 
@@ -416,6 +454,9 @@ class MasterServicer:
             mgr.remove_alive_node(req.node_id)
         if self._task_manager:
             self._task_manager.recover_worker_tasks(req.node_id)
+            # Leased shards were just requeued as doing entries; drop the
+            # lease bookkeeping so expiry cannot requeue them twice.
+            self._shard_lease.drop_agent(req.node_id)
         if self._preempt is not None:
             # An announced departure: mark the notice handled so the
             # false-alarm timer never fires for a node that really died.
@@ -486,6 +527,7 @@ class MasterServicer:
             )
         if self._task_manager and req.status in ("failed", "deleted"):
             self._task_manager.recover_worker_tasks(req.node_id)
+            self._shard_lease.drop_agent(req.node_id)
         return m.Response()
 
     # ---------------- sync ----------------
@@ -549,6 +591,8 @@ MasterServicer._HANDLERS = {
     m.TaskRequest: MasterServicer._get_task,
     m.TaskReport: MasterServicer._report_task,
     m.TaskHoldReport: MasterServicer._report_task_hold,
+    m.LeaseRequest: MasterServicer._lease_request,
+    m.LeaseReport: MasterServicer._lease_report,
     m.ShardCheckpointRequest: MasterServicer._get_shard_checkpoint,
     m.DatasetEpochRequest: MasterServicer._get_dataset_epoch,
     m.GlobalStep: MasterServicer._report_step,
@@ -579,6 +623,11 @@ _BULK_CLASSES = (
     m.NodeHeartbeat,
     m.AgentBeat,
     m.ModelInfo,
+    # The lease data plane: amortized but high-volume at fleet scale —
+    # keep the grants/completion batches off the control lane so a data
+    # storm can never queue ahead of a rescale ack.
+    m.LeaseRequest,
+    m.LeaseReport,
 )
 
 

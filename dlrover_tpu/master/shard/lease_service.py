@@ -1,0 +1,316 @@
+"""Master-side shard-lease plane: bulk dispatch without a hot path.
+
+The per-call data path (one ``TaskRequest`` + one ``TaskReport`` per
+shard) costs the master 2 RPCs per shard — fine for hundreds of shards
+per second, ruinous at 100k+. The lease plane amortizes the same
+todo/doing bookkeeping the TaskManager already owns:
+
+- :meth:`grant` bulk-pops hundreds of shards into ``doing`` (worker_id
+  = the leasing agent) and answers one :class:`~dlrover_tpu.common.
+  messages.ShardLease`; the agent's broker sub-leases them to its
+  workers over shm, so steady state costs the master ~1/lease + 1/batch
+  RPCs instead of 2/shard.
+- :meth:`report` applies a batched completion/renewal/release. It is a
+  journaled, deduped RPC, so a retried batch lands exactly once.
+- :meth:`tick` expires unrenewed leases exactly like the doing-timeout:
+  the WHOLE lease re-enters todo under fresh ids (at-least-once
+  preserved; a late ack for a re-dispatched id finds no doing entry and
+  is ignored, same as today).
+
+Durability: grants are apply-then-log (the record must carry the shard
+ids the handler chose) as ``("lease", req_id, payload, ts)`` records;
+replay re-marks the ids as doing, reinstalls the lease table entry and
+hands the rebuilt ShardLease back for dedup seeding, so a client retry
+of the granted request is answered, not re-applied. Tick expiries write
+their own ``("lease", "", payload, ts)`` record (tick is not an RPC).
+Reports replay through their ordinary journaled-RPC record. Because
+every leased shard is simultaneously a ``doing`` entry, agent failure
+recovery (``recover_worker_tasks``) requeues leased shards with zero
+new machinery — :meth:`drop_agent` only clears the bookkeeping so a
+later expiry cannot double-requeue.
+"""
+
+import time
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.chaos.injector import fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.lockdep import instrumented_lock
+from dlrover_tpu.common.log import logger
+
+
+class ShardLeaseService:
+    #: dtlint DT009: the lease table and its id counter move together
+    #: under the service lock; the counters are monotonic stats read
+    #: without the lock by the metrics exporter (single-writer, and a
+    #: torn read of a gauge is harmless).
+    GUARDED_BY = {
+        "_leases": "master.shard_lease",
+        "_next_lease_id": "master.shard_lease",
+        "granted_shards": None,
+        "completed_shards": None,
+        "expired_leases": None,
+    }
+
+    def __init__(self, task_manager, state_store=None):
+        self._lock = instrumented_lock("master.shard_lease")
+        self._tm = task_manager
+        self._store = state_store
+        # lease_id -> {agent, dataset, outstanding: set[int],
+        #              expire_ts, ttl}
+        self._leases: Dict[int, Dict[str, Any]] = {}
+        self._next_lease_id = 0
+        self.granted_shards = 0
+        self.completed_shards = 0
+        self.expired_leases = 0
+
+    # ---------------- journal plumbing ----------------
+    @property
+    def _replaying(self) -> bool:
+        return self._store is not None and self._store.replaying
+
+    def _journal(self, payload: Dict[str, Any]):
+        if self._store is not None and not self._store.replaying:
+            self._store.append(("lease", "", payload, time.time()))
+
+    # ---------------- grant (apply-then-log RPC) ----------------
+    def grant(self, req: m.LeaseRequest) -> m.ShardLease:
+        """Bulk-dispatch up to ``max_shards`` shards as one lease.
+
+        Live-only (apply-then-log records replay via :meth:`replay`,
+        never through this handler). The chaos gate sits BEFORE any
+        state moves: a dropped delivery answers empty with nothing
+        mutated, so the client's retry is an ordinary fresh grant.
+        """
+        ev = fault_hit(ChaosSite.SHARD_LEASE_DELIVER, detail=req.dataset_name)
+        if ev is not None:
+            if ev.kind == "delay":
+                time.sleep(ev.delay_s)
+            elif ev.kind == "drop":
+                return m.ShardLease(dataset_name=req.dataset_name)
+        n = req.max_shards or env_utils.SHARD_LEASE_SHARDS.get()
+        ttl = env_utils.SHARD_LEASE_TTL_S.get()
+        with self._lock:
+            tasks, finished, unknown = self._tm.lease_tasks(
+                req.node_id, req.dataset_name, max(1, int(n))
+            )
+            if unknown:
+                return m.ShardLease(
+                    dataset_name=req.dataset_name, unknown=True
+                )
+            if not tasks:
+                return m.ShardLease(
+                    dataset_name=req.dataset_name, finished=finished
+                )
+            lease_id = self._next_lease_id
+            self._next_lease_id += 1
+            self._leases[lease_id] = {
+                "agent": req.node_id,
+                "dataset": req.dataset_name,
+                "outstanding": {t.task_id for t in tasks},
+                "expire_ts": time.time() + ttl,
+                "ttl": ttl,
+            }
+            self.granted_shards += len(tasks)
+        return m.ShardLease(
+            lease_id=lease_id, dataset_name=req.dataset_name,
+            tasks=tasks, ttl_s=ttl,
+        )
+
+    def grant_payload(self, req: m.LeaseRequest,
+                      lease: m.ShardLease) -> Optional[Dict[str, Any]]:
+        """The apply-then-log record body for a grant the servicer is
+        about to journal; None for empty answers (nothing moved). Only
+        the ids ride in the record: the todo state at this journal
+        position is reproduced by the shards/dispatch records before
+        it, so replay re-pops the same tasks by id."""
+        if not lease.exists:
+            return None
+        return {
+            "rec": "grant",
+            "lease_id": lease.lease_id,
+            "agent": req.node_id,
+            "dataset": lease.dataset_name,
+            "task_ids": [t.task_id for t in lease.tasks],
+            "ttl": lease.ttl_s,
+        }
+
+    # ---------------- report (journaled RPC, replayed) ----------------
+    def report(self, req: m.LeaseReport) -> m.Response:
+        """Apply a batched completion/renewal/release.
+
+        Replay-pure: reached live AND from the journaled rpc record. An
+        unknown lease (expired, released, lost with a pre-journal crash)
+        answers ``success=False`` — its shards were already requeued, so
+        the holder must drop local copies and lease afresh; the retrain
+        this can cost is exactly the at-least-once contract.
+        """
+        with self._lock:
+            lease = self._leases.get(req.lease_id)
+            if lease is None or lease["dataset"] != req.dataset_name:
+                return m.Response(success=False, reason="unknown lease")
+            acked = self._tm.report_tasks(
+                req.dataset_name, req.done_ids, req.failed_ids
+            )
+            self.completed_shards += acked
+            lease["outstanding"] -= set(req.done_ids)
+            lease["outstanding"] -= set(req.failed_ids)
+            if req.release and lease["outstanding"]:
+                # Handback: the still-outstanding rest re-enters todo
+                # under fresh ids (same requeue the doing-timeout uses).
+                self._tm.reclaim_tasks(
+                    req.dataset_name, sorted(lease["outstanding"])
+                )
+                lease["outstanding"].clear()
+            if req.release or not lease["outstanding"]:
+                del self._leases[req.lease_id]
+            else:
+                lease["expire_ts"] = time.time() + lease["ttl"]  # dtlint: disable=DT011 -- lease-renewal liveness clock, deliberately re-stamped on replay: expiry timers are process-local, not journaled state
+        return m.Response(success=True)
+
+    # ---------------- expiry sweep (monitor loop) ----------------
+    def tick(self):
+        """Expire unrenewed leases: whole-lease re-dispatch, journaled
+        as a ``("lease", ...)`` expire record (tick has no RPC record of
+        its own, mirroring the task manager's reclaim records)."""
+        if self._replaying:
+            return
+        now = time.time()
+        with self._lock:
+            expired = [
+                lid for lid, lease in self._leases.items()
+                if now > lease["expire_ts"]
+            ]
+            for lid in self._leases:
+                if lid in expired:
+                    continue
+                if fault_hit(ChaosSite.SHARD_LEASE_EXPIRE, detail=str(lid)):
+                    expired.append(lid)
+            for lid in expired:
+                lease = self._leases.pop(lid)
+                ids = sorted(lease["outstanding"])
+                self._journal({
+                    "rec": "expire", "lease_id": lid,
+                    "dataset": lease["dataset"], "task_ids": ids,
+                })
+                if ids:
+                    self._tm.reclaim_tasks(lease["dataset"], ids)
+                self.expired_leases += 1
+                logger.warning(
+                    "lease %s of agent %s expired; re-dispatching %s "
+                    "outstanding shard(s) of %s",
+                    lid, lease["agent"], len(ids), lease["dataset"],
+                )
+
+    # ---------------- failure plumbing ----------------
+    def drop_agent(self, node_id: int):
+        """Clear a failed agent's leases. The shards themselves are
+        requeued by ``recover_worker_tasks`` (every leased shard is a
+        doing entry under this worker id); dropping the bookkeeping here
+        keeps a later tick from double-requeuing ids that are already
+        back in todo. Deterministic, so the evict/failure records that
+        drive it replay identically."""
+        with self._lock:
+            stale = [
+                lid for lid, lease in self._leases.items()
+                if lease["agent"] == node_id
+            ]
+            for lid in stale:
+                del self._leases[lid]
+        if stale:
+            logger.info(
+                "dropped %s lease(s) of failed agent %s", len(stale), node_id
+            )
+
+    # ---------------- journal replay + snapshots ----------------
+    def replay(self, payload: Dict[str, Any]) -> Optional[m.ShardLease]:
+        """Apply one ``("lease", ...)`` record; returns the rebuilt
+        ShardLease for grant records so the caller can seed the RPC
+        dedup cache (a retried LeaseRequest is answered, not re-run)."""
+        rec = payload.get("rec")
+        if rec == "grant":
+            with self._lock:
+                lid = int(payload["lease_id"])
+                self._next_lease_id = max(self._next_lease_id, lid + 1)
+                ttl = float(payload.get("ttl", 0.0))
+                if lid in self._leases:  # duplicated record
+                    lease = self._leases[lid]
+                    tasks = self._tm.dispatch_exact(
+                        lease["agent"], lease["dataset"],
+                        sorted(lease["outstanding"]),
+                    )
+                else:
+                    tasks = self._tm.dispatch_exact(
+                        payload["agent"], payload["dataset"],
+                        payload["task_ids"],
+                    )
+                    self._leases[lid] = {
+                        "agent": payload["agent"],
+                        "dataset": payload["dataset"],
+                        "outstanding": {t.task_id for t in tasks},
+                        "expire_ts": time.time() + ttl,  # dtlint: disable=DT011 -- lease-expiry liveness clock, deliberately re-stamped on replay: the holder may be riding out the master outage and gets a full window
+                        "ttl": ttl,
+                    }
+                    self.granted_shards += len(tasks)
+            return m.ShardLease(
+                lease_id=lid, dataset_name=payload["dataset"],
+                tasks=tasks, ttl_s=ttl,
+            )
+        if rec == "expire":
+            with self._lock:
+                self._leases.pop(int(payload["lease_id"]), None)
+                self._tm.reclaim_tasks(
+                    payload["dataset"], payload.get("task_ids", [])
+                )
+                self.expired_leases += 1
+        return None
+
+    def checkpoint(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "next_lease_id": self._next_lease_id,
+                "leases": [
+                    {
+                        "lease_id": lid,
+                        "agent": lease["agent"],
+                        "dataset": lease["dataset"],
+                        "outstanding": sorted(lease["outstanding"]),
+                        "ttl": lease["ttl"],
+                    }
+                    for lid, lease in self._leases.items()
+                ],
+            }
+
+    def restore(self, state: Dict[str, Any]):
+        if not state:
+            return
+        with self._lock:
+            self._leases.clear()
+            self._next_lease_id = int(state.get("next_lease_id", 0))
+            for item in state.get("leases", []):
+                # The holder may still be alive and riding out the
+                # master outage; a full fresh TTL window mirrors the
+                # doing-restore start_time=now convention.
+                self._leases[int(item["lease_id"])] = {
+                    "agent": item["agent"],
+                    "dataset": item["dataset"],
+                    "outstanding": set(item["outstanding"]),
+                    "expire_ts": time.time() + float(item["ttl"]),
+                    "ttl": float(item["ttl"]),
+                }
+
+    # ---------------- metrics ----------------
+    def lease_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "live_leases": len(self._leases),
+                "outstanding_shards": sum(
+                    len(lease["outstanding"])
+                    for lease in self._leases.values()
+                ),
+                "granted_shards": self.granted_shards,
+                "completed_shards": self.completed_shards,
+                "expired_leases": self.expired_leases,
+            }

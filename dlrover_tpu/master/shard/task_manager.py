@@ -101,21 +101,37 @@ class DatasetManager:
         self._reclaim_stale()
         if self.todo or self.splitter.epoch_finished():
             return
+        pre_split = self.splitter.checkpoint()
+        first_id = self._task_id
         created = []
         for shard in self.splitter.create_shards():
             task = self._new_task(shard)
             self.todo.append(task)
             created.append(task)
         if created and self.journal is not None:
-            # Shuffling splitters draw from the global RNG, so a replay
-            # cannot re-split identically — journal the exact ranges and
-            # the splitter cursor AFTER the split instead.
-            self.journal(
-                ("shards", self.splitter.dataset_name, {
-                    "splitter": self.splitter.checkpoint(),
-                    "tasks": [self._task_dict(t) for t in created],
-                }, time.time())
-            )
+            if getattr(self.splitter, "shuffle", False):
+                # Shuffling splitters draw from the global RNG, so a
+                # replay cannot re-split identically — journal the exact
+                # ranges and the splitter cursor AFTER the split.
+                self.journal(
+                    ("shards", self.splitter.dataset_name, {
+                        "splitter": self.splitter.checkpoint(),
+                        "tasks": [self._task_dict(t) for t in created],
+                    }, time.time())
+                )
+            else:
+                # Deterministic splitters re-split identically from the
+                # pre-split cursor, so an O(1) record replaces the
+                # per-shard range list — at lease-plane rates an epoch
+                # is hundreds of thousands of shards, and the exact
+                # record would dominate the journal.
+                self.journal(
+                    ("shards", self.splitter.dataset_name, {
+                        "resplit": pre_split,
+                        "first_task_id": first_id,
+                        "count": len(created),
+                    }, time.time())
+                )
 
     @staticmethod
     def _task_dict(task: ShardTask) -> dict:
@@ -171,6 +187,67 @@ class DatasetManager:
             self._requeue(doing.task)
         return True
 
+    # ------------- bulk lease plumbing -------------
+    def get_tasks(self, worker_id: int, n: int):  # dtlint: holds(master.task_manager)
+        """Bulk get_task: up to `n` shards in one critical section.
+        Returns (tasks, finished) — finished only meaningful when the
+        answer came up short."""
+        tasks = []
+        finished = False
+        # One stale sweep per LEASE, then straight deque pops: the
+        # per-call path's sweep-per-get is O(doing) and at data-plane
+        # rates (thousands of leased shards in `doing`) turns a bulk
+        # grant quadratic — 100x slower than the pops themselves.
+        self._refill()
+        now = time.time()
+        while len(tasks) < n:
+            if not self.todo:
+                self._refill()
+                if not self.todo:
+                    finished = self.completed()
+                    break
+            task = self.todo.popleft()
+            self.doing[task.task_id] = DoingTask(task, worker_id, now)
+            tasks.append(task)
+        return tasks, finished
+
+    def report_tasks(self, done_ids, failed_ids) -> int:  # dtlint: holds(master.task_manager)
+        """Bulk report_task; returns how many acks landed (ids with no
+        doing entry — already acked, or reclaimed and re-dispatched
+        under fresh ids — are ignored, same as the per-call path)."""
+        acked = 0
+        for tid in done_ids:
+            if self.report_task(tid, True):
+                acked += 1
+        for tid in failed_ids:
+            self.report_task(tid, False)
+        return acked
+
+    def dispatch_exact(self, worker_id: int, task_ids):  # dtlint: holds(master.task_manager)
+        """Replay a bulk grant: move exactly these ids from todo to
+        doing. Ids already doing are kept (duplicated record); ids
+        nowhere (acked by a later replayed report) are skipped — the
+        journal suffix settles them."""
+        wanted = set(task_ids)
+        found = {t.task_id: t for t in self.todo if t.task_id in wanted}
+        if found:
+            remaining = [t for t in self.todo if t.task_id not in found]
+            self.todo.clear()
+            self.todo.extend(remaining)
+        tasks = []
+        for tid in task_ids:
+            doing = self.doing.get(tid)
+            if doing is not None:
+                tasks.append(doing.task)
+                continue
+            task = found.get(tid)
+            if task is None:
+                continue
+            self.doing[tid] = DoingTask(task, worker_id, time.time())  # dtlint: disable=DT011 -- dispatch-time liveness clock, deliberately re-stamped on replay: staleness reclaim timers are process-local, not journaled state
+            self._task_id = max(self._task_id, tid + 1)
+            tasks.append(task)
+        return tasks
+
     def recover_worker_tasks(self, worker_id: int) -> int:  # dtlint: holds(master.task_manager)
         """Return a failed worker's in-flight shards to the todo queue."""
         stale = [tid for tid, d in self.doing.items() if d.worker_id == worker_id]
@@ -180,9 +257,21 @@ class DatasetManager:
 
     # ------------- journal replay + fencing reclaim -------------
     def replay_shards(self, state: dict):  # dtlint: holds(master.task_manager)
-        """Re-apply a journaled split: exact ranges, exact ids."""
-        self.splitter.restore(state.get("splitter", {}))
+        """Re-apply a journaled split: exact ranges (shuffle) or a
+        deterministic re-split from the recorded pre-split cursor."""
         known = {t.task_id for t in self.todo} | set(self.doing)
+        if "resplit" in state:
+            first = int(state["first_task_id"])
+            count = int(state["count"])
+            self.splitter.restore(state["resplit"])
+            self._task_id = first
+            for shard in self.splitter.create_shards():
+                task = self._new_task(shard)  # consumes the id even if known
+                if task.task_id not in known:
+                    self.todo.append(task)
+            self._task_id = max(self._task_id, first + count)
+            return
+        self.splitter.restore(state.get("splitter", {}))
         for d in state.get("tasks", []):
             if d["task_id"] in known:
                 continue
@@ -410,6 +499,37 @@ class TaskManager:
         with self._lock:
             ds = self._datasets.get(dataset_name)
             return ds.report_task(task_id, success) if ds else False
+
+    # ------------- bulk lease plumbing (ShardLeaseService) -------------
+    def lease_tasks(self, worker_id: int, dataset_name: str, n: int):
+        """Bulk dispatch for a lease grant. Returns (tasks, finished,
+        unknown) — one critical section for hundreds of shards instead
+        of one lock round-trip each."""
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return [], False, True
+            self._worker_last_task[worker_id] = time.time()
+            tasks, finished = ds.get_tasks(worker_id, n)
+            return tasks, finished, False
+
+    def report_tasks(self, dataset_name: str, done_ids, failed_ids=()) -> int:
+        """Bulk completion/failure ack; returns the landed-ack count."""
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            return ds.report_tasks(done_ids, failed_ids) if ds else 0
+
+    def dispatch_exact(self, worker_id: int, dataset_name: str, task_ids):
+        """Replay a bulk grant by id; see DatasetManager.dispatch_exact."""
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            return ds.dispatch_exact(worker_id, task_ids) if ds else []
+
+    def reclaim_tasks(self, dataset_name: str, task_ids):
+        """Pop the given doing entries and requeue under fresh ids. No
+        journal record of its own — callers (lease expiry/release)
+        journal their own reason and replay through here again."""
+        self.replay_reclaim(dataset_name, task_ids)
 
     # ------------- journal replay + fencing reclaim -------------
     def replay_shards(self, dataset_name: str, state: dict):

@@ -160,7 +160,7 @@ class MasterStateStore:
         self,
         state_dir: str,
         snapshot_interval: Optional[float] = None,
-        snapshot_every_records: int = DEFAULT_SNAPSHOT_EVERY_RECORDS,
+        snapshot_every_records: Optional[int] = None,
         keep_generations: int = 3,
         sync_policy: Optional[str] = None,
     ):
@@ -179,6 +179,10 @@ class MasterStateStore:
                 default=DEFAULT_SNAPSHOT_INTERVAL
             )
         self._snapshot_interval = snapshot_interval
+        if snapshot_every_records is None:
+            snapshot_every_records = env_utils.STATE_SNAPSHOT_RECORDS.get(
+                default=DEFAULT_SNAPSHOT_EVERY_RECORDS
+            )
         self._snapshot_every_records = snapshot_every_records
         self._keep_generations = max(1, keep_generations)
         #: True while recovery replays the journal: mutation paths that

@@ -50,6 +50,13 @@ WAL_RECORDS: Dict[str, Tuple[str, ...]] = {
     # ("rescale", payload, ts) — rescale coordinator journal
     # (set-union/overwrite semantics, replay-idempotent).
     "rescale": ("RescaleCoordinator.replay",),
+    # ("lease", request_id, payload, ts) — shard-lease plane records:
+    # apply-then-log grants (request_id set; replay re-marks the
+    # recorded ids as doing and re-seeds the RPC dedup cache with the
+    # rebuilt ShardLease) and tick expiries (request_id ""; replay
+    # requeues the outstanding ids). Lease completion batches replay
+    # through their ordinary "rpc" record (LeaseReport is journaled).
+    "lease": ("ShardLeaseService.replay",),
     # ("preempt", payload, ts) — preemption coordinator journal: only
     # the unjournaled-input transitions (writer-lease handoff computed
     # from the live rendezvous world, step-boundary shrink mark,

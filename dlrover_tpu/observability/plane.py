@@ -49,6 +49,7 @@ class ObservabilityPlane:
         self._job_manager = None
         self._task_manager = None
         self._straggler_detector = None
+        self._shard_lease = None
         # Native histograms: master RPC handle latency per message type
         # (servicer.handle) and state-store WAL write/fsync durations
         # (ROADMAP item 4). Lock-cheap — safe to call on the hot path.
@@ -60,7 +61,8 @@ class ObservabilityPlane:
         self.shed_events = 0
 
     def attach(self, speed_monitor=None, job_manager=None,
-               task_manager=None, straggler_detector=None):
+               task_manager=None, straggler_detector=None,
+               shard_lease=None):
         """Late-bind the metric sources the exporter reads from."""
         if speed_monitor is not None:
             self._speed_monitor = speed_monitor
@@ -70,6 +72,8 @@ class ObservabilityPlane:
             self._task_manager = task_manager
         if straggler_detector is not None:
             self._straggler_detector = straggler_detector
+        if shard_lease is not None:
+            self._shard_lease = shard_lease
 
     # ------------- intake -------------
     def ingest_report(self, events: List[JobEvent]):
@@ -271,6 +275,15 @@ class ObservabilityPlane:
                     "dlrover_tpu_shard_queue_depth", "gauge",
                     "Shard tasks per dataset queue.", samples,
                 ))
+        if self._shard_lease is not None:
+            stats = self._shard_lease.lease_stats()
+            metrics.append((
+                "dlrover_tpu_shard_lease", "gauge",
+                "Shard-lease data plane: live leases, shards outstanding"
+                " under leases, and cumulative granted/completed/expired"
+                " counts.",
+                [({"stat": k}, v) for k, v in sorted(stats.items())],
+            ))
         if self._straggler_detector is not None:
             metrics.extend(self._straggler_detector.metrics())
         if self.rpc_hist.total_count:

@@ -15,6 +15,8 @@ from dlrover_tpu.train.data.data_service import (
 )
 from dlrover_tpu.train.data.dataloader import ElasticDataLoader
 from dlrover_tpu.train.data.device_prefetch import DevicePrefetchIterator
+from dlrover_tpu.train.data.mixture import MixtureWeights, WeightedShardMixer
+from dlrover_tpu.train.data.readahead import ShardReadaheadCache
 from dlrover_tpu.train.data.sampler import ElasticSampler
 from dlrover_tpu.train.data.sharding_client import (
     IndexShardingClient,
@@ -29,5 +31,8 @@ __all__ = [
     "ElasticDataLoader",
     "ElasticSampler",
     "IndexShardingClient",
+    "MixtureWeights",
+    "ShardReadaheadCache",
     "ShardingClient",
+    "WeightedShardMixer",
 ]

@@ -56,6 +56,7 @@ class ElasticDataLoader:
         collate_fn: Optional[Callable] = None,
         drop_last: bool = False,
         prefetch: int = 0,
+        readahead_shards: int = 0,
         config_file: Optional[str] = None,
     ):
         self.dataset = dataset
@@ -79,6 +80,20 @@ class ElasticDataLoader:
         self.collate_fn = collate_fn or _default_collate
         self.drop_last = drop_last
         self.prefetch = prefetch
+        self._readahead = None
+        if readahead_shards > 0:
+            from dlrover_tpu.train.data.readahead import ShardReadaheadCache
+
+            self._readahead = ShardReadaheadCache(
+                lambda i: self.dataset[i], depth=readahead_shards,
+            )
+            if (
+                sharding_client is not None
+                and sharding_client._shard_listener is None
+            ):
+                # Load each shard's records the moment it is fetched,
+                # overlapping I/O with the batches still training.
+                sharding_client._shard_listener = self._readahead.on_shard
         self._config_file = (
             config_file
             if config_file is not None
@@ -163,7 +178,10 @@ class ElasticDataLoader:
                     batch = []
                     self.load_config()
                 continue
-            batch.append(self.dataset[idx])
+            batch.append(
+                self._readahead.get(idx) if self._readahead is not None
+                else self.dataset[idx]
+            )
             if len(batch) >= self.batch_size:
                 yield self.collate_fn(batch), len(batch)
                 batch = []
@@ -174,6 +192,8 @@ class ElasticDataLoader:
     def _report(self, n: int):
         if self.sharding_client is not None:
             self.sharding_client.report_records(n)
+        if self._readahead is not None:
+            self._readahead.gc_consumed()
 
     def __iter__(self) -> Iterator[Any]:
         if self.prefetch <= 0:

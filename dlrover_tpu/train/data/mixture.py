@@ -1,0 +1,159 @@
+"""Weighted sampling across heterogeneous shard sources, live-tunable.
+
+A pretraining job rarely reads one corpus: it mixes sources (web, code,
+books, ...) at ratios that operators tune *while the job runs*. This
+module layers that on the shard plane:
+
+- :class:`MixtureWeights` — the control half. Weights live in the
+  master's kv store under ``hyperparams/mixture/<name>`` as JSON;
+  :meth:`MixtureWeights.publish` (any client — a notebook, the tuner)
+  updates them, :meth:`MixtureWeights.get` polls them on the
+  ``DLROVER_TPU_SHARD_LEASE_MIX_POLL_S`` cadence so a thousand trainers
+  converge on new ratios within seconds without a restart.
+- :class:`WeightedShardMixer` — the data half. One
+  :class:`~dlrover_tpu.train.data.sharding_client.ShardingClient` per
+  source; every fetch draws the source from the current weights with a
+  *seeded* generator, so a restarted worker replays the same source
+  sequence (elastic restarts stay reproducible). A source that runs dry
+  drops out and the remaining weights renormalize — the mix degrades
+  gracefully instead of stalling on its slowest corpus.
+"""
+
+import json
+import random
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.log import logger
+
+_KV_PREFIX = "hyperparams/mixture/"
+
+
+class MixtureWeights:
+    """Live mixture ratios, backed by the master kv store."""
+
+    def __init__(self, client, name: str,
+                 defaults: Dict[str, float],
+                 poll_s: Optional[float] = None):
+        self._client = client
+        self._key = _KV_PREFIX + name
+        self._weights = dict(defaults)
+        self._poll_s = (
+            poll_s if poll_s is not None
+            else env_utils.SHARD_LEASE_MIX_POLL_S.get()
+        )
+        self._last_poll = 0.0
+        self.version = 0
+
+    @staticmethod
+    def publish(client, name: str, weights: Dict[str, float]):
+        """Write new ratios for every trainer polling ``name``."""
+        client.kv_store_set(
+            _KV_PREFIX + name,
+            json.dumps(weights, sort_keys=True).encode(),
+        )
+
+    def get(self) -> Dict[str, float]:
+        """Current ratios; re-reads the kv store at most once per poll
+        interval. A missing/garbled key keeps the last good value —
+        tuning must never take the input pipeline down."""
+        now = time.monotonic()
+        if self._client is None or now - self._last_poll < self._poll_s:
+            return self._weights
+        self._last_poll = now
+        try:
+            raw = self._client.kv_store_get(self._key)
+            if raw:
+                fresh = {
+                    str(k): float(v) for k, v in json.loads(raw).items()
+                }
+                if fresh != self._weights:
+                    self.version += 1
+                    logger.info(
+                        "mixture %s -> %s (update %s)",
+                        self._key, fresh, self.version,
+                    )
+                    self._weights = fresh
+        except Exception:
+            logger.warning("mixture poll of %s failed; keeping %s",
+                           self._key, self._weights)
+        return self._weights
+
+
+class WeightedShardMixer:
+    """Draw shards from several sources at the current mixture ratio."""
+
+    def __init__(self, sources: Dict[str, object],
+                 weights: MixtureWeights,
+                 seed: int = 0):
+        if not sources:
+            raise ValueError("mixer needs at least one source")
+        self._sources = dict(sources)  # name -> ShardingClient
+        self._weights = weights
+        self._rng = random.Random(seed)
+        self._task_source: Dict[int, str] = {}
+        self.draws: Dict[str, int] = {name: 0 for name in sources}
+
+    def _pick(self) -> Optional[str]:
+        live = [
+            name for name, sc in self._sources.items()
+            if not sc.dataset_finished
+        ]
+        if not live:
+            return None
+        weights = self._weights.get()
+        # Exhausted sources drop out; the rest renormalize implicitly by
+        # drawing only over the live names. Unlisted sources weigh 0
+        # (but if the ratios cover no live source, fall back to uniform
+        # rather than spinning forever on an empty draw).
+        w = [max(0.0, float(weights.get(name, 0.0))) for name in live]
+        if sum(w) <= 0:
+            w = [1.0] * len(live)
+        return self._rng.choices(live, weights=w, k=1)[0]
+
+    def fetch_shard(self, retry_interval: float = 0.2,
+                    max_wait: Optional[float] = None, stop=None):
+        """Next shard from a weighted draw over the live sources.
+
+        A dry-but-unfinished source (broker refilling) passes its turn:
+        the miss re-draws over the others so the mix keeps moving."""
+        deadline = (
+            time.monotonic() + max_wait if max_wait is not None else None
+        )
+        while True:
+            name = self._pick()
+            if name is None:
+                return None  # every source exhausted
+            remaining = retry_interval
+            if deadline is not None:
+                remaining = min(remaining, deadline - time.monotonic())
+                if remaining <= 0:
+                    return None
+            task = self._sources[name].fetch_shard(
+                retry_interval=retry_interval, max_wait=remaining,
+                stop=stop,
+            )
+            if task is not None:
+                self.draws[name] += 1
+                self._task_source[task.task_id] = name
+                return task
+            if stop is not None and stop():
+                return None
+
+    def report_batch_done(self, task_id: int, success: bool = True) -> bool:
+        name = self._task_source.pop(task_id, None)
+        if name is None:
+            return False
+        return self._sources[name].report_batch_done(task_id, success)
+
+    def requeue_pending(self) -> int:
+        self._task_source.clear()
+        return sum(sc.requeue_pending() for sc in self._sources.values())
+
+    @property
+    def dataset_finished(self) -> bool:
+        return all(sc.dataset_finished for sc in self._sources.values())
+
+    def stats(self) -> Dict[str, int]:
+        return dict(self.draws)

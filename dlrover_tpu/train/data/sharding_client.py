@@ -20,9 +20,10 @@ covered by re-dispatch.
 import threading
 import time
 from collections import deque
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu.agent.master_client import MasterClient, build_master_client
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.messages import ShardTask
@@ -42,6 +43,14 @@ class ShardingClient:
       ``dataset_finished`` tells the two ends apart);
     - ``report_batch_done()`` acks the *oldest* outstanding shard — an
       unacked shard is re-dispatched by the master if this worker dies.
+
+    **Lease-plane mode** (``lease_plane`` set, or the
+    ``DLROVER_TPU_SHARD_LEASE_PLANE`` env the agent exports): the same
+    API is served by the agent's shm sub-lease broker — ``fetch_shard``
+    pops frames off the fetch ring, ``report_batch_done`` pushes acks
+    onto the completion ring, and ``requeue_pending`` hands shards back
+    to the *broker*, never the master. Zero worker RPCs in steady state;
+    a master client is optional (registration rides a SUBSCRIBE frame).
     """
 
     def __init__(
@@ -53,10 +62,23 @@ class ShardingClient:
         shuffle: bool = False,
         storage_type: str = "table",
         client: Optional[MasterClient] = None,
+        lease_plane: Optional[str] = None,
+        shard_listener: Optional[Callable[[ShardTask], None]] = None,
     ):
         self.dataset_name = dataset_name
-        self._client = client or build_master_client()
+        if lease_plane is None:
+            lease_plane = env_utils.SHARD_LEASE_PLANE.get()
+        self._plane = None
+        if lease_plane:
+            from dlrover_tpu.common.shard_plane import ShardPlane
+
+            self._plane = ShardPlane(lease_plane)
+        self._client = client or (
+            None if self._plane is not None else build_master_client()
+        )
         self._pending: deque = deque()  # fetched, not yet acked task ids
+        self._pending_tasks: Dict[int, ShardTask] = {}  # plane requeue
+        self._shard_listener = shard_listener
         self._lock = threading.Lock()
         self._fetched = 0
         self._reported = 0
@@ -72,6 +94,11 @@ class ShardingClient:
         self._register()
 
     def _register(self):
+        if self._plane is not None:
+            # The broker registers on our behalf (idempotent on the
+            # master) and starts keeping the fetch ring topped up.
+            self._plane.subscribe(self.dataset_name, self._register_params)
+            return
         self._client.report_dataset_shard_params(**self._register_params)
 
     @property
@@ -97,6 +124,8 @@ class ShardingClient:
         deadline = (
             None if max_wait is None else time.monotonic() + max_wait
         )
+        if self._plane is not None:
+            return self._fetch_shard_plane(retry_interval, deadline, stop)
         backoff = ExponentialBackoff(
             initial=retry_interval, max_delay=retry_interval * 4
         )
@@ -105,7 +134,10 @@ class ShardingClient:
             if task.exists:
                 with self._lock:
                     self._pending.append(task.task_id)
+                    self._pending_tasks[task.task_id] = task
                     self._fetched += 1
+                if self._shard_listener is not None:
+                    self._shard_listener(task)
                 return task
             if task.unknown:
                 # Restarted master lost the registration; re-register and
@@ -126,6 +158,33 @@ class ShardingClient:
                 None if deadline is None else deadline - time.monotonic()
             )
 
+    def _fetch_shard_plane(self, retry_interval, deadline, stop):
+        """Pop the next sub-leased shard off the agent's fetch ring.
+        No RPC: an empty ring means the broker is refilling (or every
+        dataset is finished — the plane's FINISHED flag tells which)."""
+        while True:
+            task = self._plane.pop_task(timeout=retry_interval)
+            if task is not None:
+                if task.dataset_name != self.dataset_name:
+                    # Another dataset's frame (shared ring): hand it
+                    # back to the broker for re-offer and keep looking.
+                    self._plane.push_requeue(task)
+                    continue
+                with self._lock:
+                    self._pending.append(task.task_id)
+                    self._pending_tasks[task.task_id] = task
+                    self._fetched += 1
+                if self._shard_listener is not None:
+                    self._shard_listener(task)
+                return task
+            if self._plane.finished:
+                self._finished = True
+                return None
+            if stop is not None and stop():
+                return None
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+
     def report_batch_done(self, task_id: Optional[int] = None,
                           success: bool = True) -> bool:
         with self._lock:
@@ -138,7 +197,13 @@ class ShardingClient:
                     self._pending.remove(task_id)
                 except ValueError:
                     pass
+            self._pending_tasks.pop(task_id, None)
             self._reported += 1
+        if self._plane is not None:
+            # Ack over shm; the broker batches it into a LeaseReport.
+            return self._plane.push_done(
+                self.dataset_name, task_id, success
+            )
         return bool(
             self._client.report_task(self.dataset_name, task_id, success)
         )
@@ -160,6 +225,23 @@ class ShardingClient:
         with self._lock:
             pending = list(self._pending)
             self._pending.clear()
+            pending_tasks = dict(self._pending_tasks)
+            self._pending_tasks.clear()
+        if self._plane is not None:
+            # Lease-plane contract: sub-leased shards go back to the
+            # AGENT BROKER (REQUEUE frames it re-offers locally), never
+            # to the master — the lease stays intact and no master RPC
+            # happens on the rescale path.
+            for tid in pending:
+                task = pending_tasks.get(tid)
+                if task is not None:
+                    self._plane.push_requeue(task)
+            if pending:
+                logger.info(
+                    "rescale: handed %s unacked shard(s) of %s back to "
+                    "the agent broker", len(pending), self.dataset_name,
+                )
+            return len(pending)
         for tid in pending:
             try:
                 self._client.report_task(self.dataset_name, tid, False)

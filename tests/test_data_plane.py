@@ -1,0 +1,694 @@
+"""Tiered shard-lease data plane: master lease service, agent broker,
+shm rings, trainer-side readahead/mixture, and the failover drills.
+
+Fast tier-1 coverage of ISSUE 15: bulk leases journal/replay like any
+mutation (exactly-once accounting across master failover), the agent's
+shm sub-lease plane keeps workers RPC-free in steady state, rescale
+requeue hands shards back to the *broker* (never the master), and a
+real SIGKILL drill proves the at-least-once contract — no shard lost,
+none double-trained, leases reproduced by WAL replay.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.shard_broker import ShardLeaseBroker
+from dlrover_tpu.chaos import (
+    CHAOS_ENV,
+    CHAOS_LOG_ENV,
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+)
+from dlrover_tpu.common import env_utils, messages as m
+from dlrover_tpu.common.shard_plane import ShardPlane
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.state_store import read_journal_records
+from dlrover_tpu.train.data.mixture import MixtureWeights, WeightedShardMixer
+from dlrover_tpu.train.data.readahead import ShardReadaheadCache
+from dlrover_tpu.train.data.sharding_client import ShardingClient
+
+from tests.conftest import cpu_subprocess_env
+
+
+@pytest.fixture(autouse=True)
+def chaos_clean(monkeypatch):
+    monkeypatch.delenv(CHAOS_ENV, raising=False)
+    monkeypatch.delenv(CHAOS_LOG_ENV, raising=False)
+    FaultInjector.reset()
+    yield
+    FaultInjector.reset()
+
+
+def crash_master(master):
+    """Sever the sockets without the graceful stop()/final-snapshot
+    path: recovery must come from the WAL, like a real process death."""
+    master._stopped.set()
+    master._server.stop()
+
+
+def _plane_name():
+    return f"tdp_{uuid.uuid4().hex[:10]}"
+
+
+# ---------------------------------------------------------------------------
+# Master lease service over real RPC
+# ---------------------------------------------------------------------------
+
+
+class TestLeaseService:
+    def test_lease_roundtrip_to_finished(self):
+        master = JobMaster(port=0, node_num=1, job_name="lease-rt")
+        master.prepare()
+        client = MasterClient(master.addr, node_id=0)
+        try:
+            client.report_dataset_shard_params("ds", 40, 10)
+            lease = client.request_lease("ds", max_shards=3)
+            assert lease.exists and len(lease.tasks) == 3
+            assert lease.ttl_s > 0
+            resp = client.report_lease(
+                "ds", lease.lease_id, [t.task_id for t in lease.tasks]
+            )
+            assert resp.success
+            rest = client.request_lease("ds", max_shards=8)
+            assert rest.exists and len(rest.tasks) == 1
+            assert client.report_lease(
+                "ds", rest.lease_id, [rest.tasks[0].task_id]
+            ).success
+            empty = client.request_lease("ds")
+            assert not empty.exists and empty.finished
+            stats = master.shard_lease.lease_stats()
+            assert stats["granted_shards"] == 4
+            assert stats["completed_shards"] == 4
+            assert stats["live_leases"] == 0
+        finally:
+            master.stop()
+            client.close()
+
+    def test_lease_unknown_dataset(self):
+        master = JobMaster(port=0, node_num=1, job_name="lease-unk")
+        master.prepare()
+        client = MasterClient(master.addr, node_id=0)
+        try:
+            lease = client.request_lease("nope")
+            assert not lease.exists and lease.unknown
+        finally:
+            master.stop()
+            client.close()
+
+    def test_release_requeues_remainder_under_fresh_ids(self):
+        master = JobMaster(port=0, node_num=1, job_name="lease-rel")
+        master.prepare()
+        client = MasterClient(master.addr, node_id=0)
+        try:
+            client.report_dataset_shard_params("ds", 40, 10)
+            lease = client.request_lease("ds", max_shards=4)
+            ids = [t.task_id for t in lease.tasks]
+            assert client.report_lease(
+                "ds", lease.lease_id, ids[:1], release=True
+            ).success
+            # The 3 unacked shards re-enter todo under fresh ids.
+            again = client.request_lease("ds", max_shards=8)
+            assert len(again.tasks) == 3
+            assert set(t.task_id for t in again.tasks).isdisjoint(ids)
+            assert client.report_lease(
+                "ds", again.lease_id, [t.task_id for t in again.tasks]
+            ).success
+            assert client.request_lease("ds").finished
+        finally:
+            master.stop()
+            client.close()
+
+    def test_expiry_redispatches_whole_lease_and_refuses_late_report(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv(env_utils.SHARD_LEASE_TTL_S.name, "0.05")
+        master = JobMaster(port=0, node_num=1, job_name="lease-exp")
+        master.prepare()
+        client = MasterClient(master.addr, node_id=0)
+        try:
+            client.report_dataset_shard_params("ds", 20, 10)
+            lease = client.request_lease("ds", max_shards=2)
+            ids = [t.task_id for t in lease.tasks]
+            time.sleep(0.1)
+            master.shard_lease.tick()
+            assert master.shard_lease.lease_stats()["expired_leases"] == 1
+            # A late ack for the expired lease is refused: its shards
+            # were already requeued (at-least-once, never double-acked).
+            late = client.report_lease("ds", lease.lease_id, ids[:1])
+            assert not late.success
+            monkeypatch.setenv(env_utils.SHARD_LEASE_TTL_S.name, "300")
+            again = client.request_lease("ds", max_shards=4)
+            assert len(again.tasks) == 2
+            assert set(t.task_id for t in again.tasks).isdisjoint(ids)
+        finally:
+            master.stop()
+            client.close()
+
+    def test_chaos_sites_deliver_drop_and_forced_expiry(self, monkeypatch):
+        """shard.lease.deliver drops a grant with nothing mutated;
+        shard.lease.expire force-expires a healthy lease on tick."""
+        plan = FaultPlan(seed=11, events=[
+            FaultEvent(site="shard.lease.deliver", kind="drop",
+                       every=1, max_fires=1),
+            FaultEvent(site="shard.lease.expire", kind="drop",
+                       every=1, max_fires=1),
+        ])
+        monkeypatch.setenv(CHAOS_ENV, plan.to_json())
+        FaultInjector.reset()
+        master = JobMaster(port=0, node_num=1, job_name="lease-chaos")
+        master.prepare()
+        client = MasterClient(master.addr, node_id=0)
+        try:
+            client.report_dataset_shard_params("ds", 20, 10)
+            dropped = client.request_lease("ds", max_shards=2)
+            assert not dropped.exists and not dropped.finished
+            assert master.shard_lease.lease_stats()["granted_shards"] == 0
+            # The retry is an ordinary fresh grant...
+            lease = client.request_lease("ds", max_shards=2)
+            assert lease.exists and len(lease.tasks) == 2
+            # ...and the expire site re-dispatches it on the next tick
+            # despite a fresh TTL.
+            master.shard_lease.tick()
+            assert master.shard_lease.lease_stats()["expired_leases"] == 1
+            assert not client.report_lease(
+                "ds", lease.lease_id, [lease.tasks[0].task_id]
+            ).success
+        finally:
+            master.stop()
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# The shm rings
+# ---------------------------------------------------------------------------
+
+
+class TestShardPlaneRings:
+    def test_fetch_ring_wraparound_preserves_order(self):
+        plane = ShardPlane(_plane_name(), create=True, size_mb=1)
+        try:
+            sent = popped = 0
+            for _ in range(40):
+                for _ in range(120):
+                    assert plane.push_task(m.ShardTask(
+                        task_id=sent, dataset_name="ds",
+                        shard_name=f"s{sent}", start=sent, end=sent + 1,
+                    ))
+                    sent += 1
+                for _ in range(120):
+                    task = plane.pop_task()
+                    assert task is not None and task.task_id == popped
+                    popped += 1
+            assert plane.task_backlog() == 0
+        finally:
+            plane.unlink()
+
+    def test_completion_ring_wraparound(self):
+        plane = ShardPlane(_plane_name(), create=True, size_mb=1)
+        try:
+            seen = []
+            n = 0
+            for _ in range(40):
+                for _ in range(80):
+                    assert plane.push_done("ds", n, success=(n % 3 != 0),
+                                           timeout=0.1)
+                    n += 1
+                for kind, data in plane.drain_completions():
+                    seen.append(data)
+            assert [d[1] for d in seen] == list(range(n))
+            assert all(d[2] == (d[1] % 3 != 0) for d in seen)
+        finally:
+            plane.unlink()
+
+    def test_full_ring_rejects_then_recovers(self):
+        plane = ShardPlane(_plane_name(), create=True, size_mb=1)
+        try:
+            pushed = 0
+            while plane.push_task(m.ShardTask(
+                task_id=pushed, dataset_name="ds",
+                start=pushed, end=pushed + 1,
+            )):
+                pushed += 1
+                assert pushed < 100_000  # ring must be bounded
+            # A wrapping push also burns the tail gap as padding, so one
+            # freed frame is not always enough — drain a few.
+            for i in range(20):
+                assert plane.pop_task().task_id == i
+            assert plane.push_task(m.ShardTask(
+                task_id=pushed, dataset_name="ds",
+                start=pushed, end=pushed + 1,
+            ))
+            drained = 20
+            while plane.pop_task() is not None:
+                drained += 1
+            assert drained == pushed + 1
+        finally:
+            plane.unlink()
+
+
+# ---------------------------------------------------------------------------
+# Broker end to end (pump-driven) + requeue-to-broker contract
+# ---------------------------------------------------------------------------
+
+
+class TestBrokerEndToEnd:
+    def _run(self, master, broker, worker, train):
+        deadline = time.monotonic() + 20
+        while not worker.dataset_finished and time.monotonic() < deadline:
+            broker.pump()
+            task = worker.fetch_shard(retry_interval=0.01, max_wait=0.03)
+            if task is not None:
+                train(task)
+        broker.pump()
+        assert worker.dataset_finished, broker.stats()
+
+    def test_worker_trains_whole_dataset_rpc_free(self):
+        master = JobMaster(port=0, node_num=1, job_name="broker-e2e")
+        master.prepare()
+        client = MasterClient(master.addr, node_id=0)
+        broker = ShardLeaseBroker(client, _plane_name(), batch=4,
+                                  flush_s=0.0, low_water=64)
+        worker = ShardingClient("dsb", 12, 2, client=None,
+                                lease_plane=broker.plane_name)
+        try:
+            trained = []
+
+            def train(task):
+                trained.append((task.start, task.end))
+                assert worker.report_batch_done(task.task_id)
+
+            self._run(master, broker, worker, train)
+            # Every record exactly once, the whole steady state over shm:
+            # the worker never built a master client at all.
+            assert worker._client is None
+            covered = sorted(i for s, e in trained for i in range(s, e))
+            assert covered == list(range(12))
+            stats = master.shard_lease.lease_stats()
+            assert stats["completed_shards"] == 6
+            assert stats["live_leases"] == 0
+            assert broker.stats()["completions_flushed"] == 6
+        finally:
+            worker._plane.close()
+            broker.stop()
+            master.stop()
+            client.close()
+
+    def test_requeue_pending_returns_shards_to_broker_not_master(self):
+        master = JobMaster(port=0, node_num=1, job_name="broker-rq")
+        master.prepare()
+        client = MasterClient(master.addr, node_id=0)
+        broker = ShardLeaseBroker(client, _plane_name(), batch=4,
+                                  flush_s=0.0, low_water=64)
+        worker = ShardingClient("dsr", 12, 2, client=None,
+                                lease_plane=broker.plane_name)
+        try:
+            broker.pump()  # SUBSCRIBE -> register -> lease -> fill ring
+            held = [worker.fetch_shard(max_wait=2.0) for _ in range(2)]
+            assert all(t is not None for t in held)
+            # Rescale handback: sub-leased shards return to the AGENT
+            # broker over the completion ring — zero master RPCs.
+            assert worker.requeue_pending() == 2
+            broker.pump()
+            assert broker.requeues == 2
+            trained = []
+
+            def train(task):
+                trained.append(task.task_id)
+                assert worker.report_batch_done(task.task_id)
+
+            self._run(master, broker, worker, train)
+            # The requeued shards were re-offered locally: the master
+            # granted each shard exactly once and saw every ack.
+            stats = master.shard_lease.lease_stats()
+            assert stats["granted_shards"] == 6
+            assert stats["completed_shards"] == 6
+            assert {t.task_id for t in held} <= set(trained)
+        finally:
+            worker._plane.close()
+            broker.stop()
+            master.stop()
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# Re-registration / failover races on the per-call path (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class TestFailoverRaces:
+    def test_failover_between_fetch_and_report_acks_exactly_once(
+        self, tmp_path
+    ):
+        """Master dies between fetch_shard and report_batch_done: the
+        journaled grant replays the shard into doing, the ack lands on
+        the new incarnation exactly once, nothing is re-dispatched."""
+        state_dir = str(tmp_path / "state")
+        m1 = JobMaster(port=0, node_num=1, job_name="race",
+                       state_dir=state_dir)
+        m1.prepare()
+        port = m1.port
+        client = MasterClient(m1.addr, node_id=0)
+        worker = ShardingClient("ds", 8, 2, client=client, lease_plane="")
+        held = worker.fetch_shard()
+        assert held is not None
+        crash_master(m1)
+
+        m2 = JobMaster(port=port, node_num=1, job_name="race",
+                       state_dir=state_dir)
+        m2.prepare()
+        try:
+            ds = m2.task_manager._datasets["ds"]
+            # Deterministic replay reproduced the in-flight dispatch.
+            assert held.task_id in ds.doing
+            assert worker.report_batch_done(held.task_id)
+            assert ds._completed_tasks == 1
+            done = 1
+            while True:
+                task = worker.fetch_shard(retry_interval=0.05, max_wait=5.0)
+                if task is None:
+                    break
+                assert task.task_id != held.task_id
+                worker.report_batch_done(task.task_id)
+                done += 1
+            assert worker.dataset_finished
+            assert done == 4
+            assert ds._completed_tasks == 4 and not ds.doing
+        finally:
+            m2.stop()
+            client.close()
+
+    def test_fresh_master_answers_unknown_and_client_reregisters(self):
+        """Failover to a master with NO recovered state: the stale ack
+        lands in the void, get_task answers unknown, and the client's
+        automatic re-registration completes the dataset."""
+        m1 = JobMaster(port=0, node_num=1, job_name="race-unk")
+        m1.prepare()
+        port = m1.port
+        client = MasterClient(m1.addr, node_id=0)
+        worker = ShardingClient("dsu", 8, 2, client=client, lease_plane="")
+        held = worker.fetch_shard()
+        assert held is not None
+        crash_master(m1)
+
+        m2 = JobMaster(port=port, node_num=1, job_name="race-unk")
+        m2.prepare()
+        try:
+            # The stale ack is ignored (no dataset, no doing entry).
+            worker.report_batch_done(held.task_id)
+            done = 0
+            while True:
+                task = worker.fetch_shard(retry_interval=0.05, max_wait=5.0)
+                if task is None:
+                    break
+                worker.report_batch_done(task.task_id)
+                done += 1
+            assert worker.dataset_finished
+            assert done == 4  # the fresh epoch, complete
+            assert m2.task_manager._datasets["dsu"]._completed_tasks == 4
+        finally:
+            m2.stop()
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL drill (satellite 3): master dies mid-lease, pre-journal report
+# ---------------------------------------------------------------------------
+
+
+class TestMasterSigkillMidLease:
+    @staticmethod
+    def _start_master(job, port_file, state_dir, log_path, port=0,
+                      extra_env=None):
+        args = [
+            sys.executable, "-m", "dlrover_tpu.master.main",
+            "--node_num", "1", "--job_name", job,
+            "--state_dir", state_dir,
+        ]
+        if port:
+            args += ["--port", str(port)]
+        else:
+            args += ["--port_file", port_file]
+        env = {
+            # The drill asserts exactly-once accounting: no snapshot
+            # rotation mid-run, no TTL/doing reclaims during the outage,
+            # and no monitor tick aborting the agent-less job.
+            "DLROVER_TPU_STATE_SNAPSHOT_SECS": "300",
+            "DLROVER_TPU_SHARD_TIMEOUT": "300",
+            "DLROVER_TPU_NODE_MONITOR_INTERVAL": "300",
+        }
+        env.update(extra_env or {})
+        log = open(log_path, "ab")
+        return subprocess.Popen(
+            args, env=cpu_subprocess_env(env), stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+
+    def test_kill_mid_lease_loses_no_shard_double_trains_none(
+        self, tmp_path
+    ):
+        """Chaos SIGKILLs the master the instant the first LeaseReport
+        arrives — after the grant was journaled, before the report is.
+        The relaunched master must reproduce the lease table from WAL
+        replay, apply the client's retried batch exactly once, and
+        account every shard exactly once end to end."""
+        job = f"lkill-{uuid.uuid4().hex[:6]}"
+        port_file = str(tmp_path / "port")
+        state_dir = str(tmp_path / "master-state")
+        mlog = str(tmp_path / "master.log")
+        plan = FaultPlan(seed=5, events=[
+            FaultEvent(site="master.crash", kind="kill", every=1,
+                       max_fires=1, match="LeaseReport"),
+        ])
+        master = self._start_master(
+            job, port_file, state_dir, mlog,
+            extra_env={CHAOS_ENV: plan.to_json()},
+        )
+        master2 = None
+        client = None
+        try:
+            deadline = time.monotonic() + 30
+            while not os.path.exists(port_file):
+                assert time.monotonic() < deadline, "master never started"
+                time.sleep(0.05)
+            port = int(open(port_file).read().strip())
+            client = MasterClient(f"127.0.0.1:{port}", node_id=0)
+            client.report_dataset_shard_params("ds", 24, 2)
+            lease = client.request_lease("ds", max_shards=5)
+            assert len(lease.tasks) == 5
+            ranges = {t.task_id: (t.start, t.end) for t in lease.tasks}
+            trained = []  # (start, end) per acked shard
+
+            first = [t.task_id for t in lease.tasks[:2]]
+            result = {}
+
+            def report_first():
+                result["resp"] = client.report_lease(
+                    "ds", lease.lease_id, first
+                )
+
+            t = threading.Thread(target=report_first)
+            t.start()
+            master.wait(timeout=60)
+            assert master.returncode == -9, (
+                f"chaos kill never fired (exit {master.returncode})"
+            )
+            master2 = self._start_master(
+                job, port_file, state_dir, mlog, port=port
+            )
+            t.join(timeout=150)
+            # The retry landed on the new incarnation, which knows the
+            # lease purely from WAL replay of the grant record.
+            assert result["resp"].success
+            trained += [ranges[tid] for tid in first]
+            rest = [t.task_id for t in lease.tasks[2:]]
+            assert client.report_lease("ds", lease.lease_id, rest).success
+            trained += [ranges[tid] for tid in rest]
+            while True:
+                nxt = client.request_lease("ds", max_shards=5)
+                if not nxt.exists:
+                    assert nxt.finished
+                    break
+                ids = [t.task_id for t in nxt.tasks]
+                assert client.report_lease("ds", nxt.lease_id, ids).success
+                trained += [(t.start, t.end) for t in nxt.tasks]
+
+            # No shard lost, none double-trained.
+            counts = {}
+            for s, e in trained:
+                for i in range(s, e):
+                    counts[i] = counts.get(i, 0) + 1
+            assert sorted(counts) == list(range(24)), "records lost"
+            assert all(c == 1 for c in counts.values()), (
+                f"records double-trained: "
+                f"{[i for i, c in counts.items() if c > 1]}"
+            )
+
+            # Journal accounting: with request-id dedup, every granted
+            # id acked at most once, every ack against a granted id.
+            applied = set()
+            granted, acked = set(), []
+            for _seq, rec in read_journal_records(state_dir):
+                if rec[0] == "lease" and rec[2].get("rec") == "grant":
+                    if rec[1] and rec[1] in applied:
+                        continue
+                    applied.add(rec[1])
+                    granted.update(rec[2]["task_ids"])
+                elif rec[0] == "rpc" and isinstance(rec[2], m.LeaseReport):
+                    if rec[1] in applied:
+                        continue
+                    applied.add(rec[1])
+                    acked.extend(rec[2].done_ids)
+            assert len(acked) == len(set(acked)), "shard acked twice"
+            assert set(acked) <= granted, "ack for a never-granted shard"
+            assert len(acked) == 12
+            assert "recovered master state" in open(
+                mlog, errors="replace"
+            ).read()
+        finally:
+            if client is not None:
+                client.close()
+            for p in (master, master2):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Trainer side: readahead cache + live mixture weights
+# ---------------------------------------------------------------------------
+
+
+class TestReadahead:
+    def test_hits_when_shard_fetched_ahead(self):
+        loads = []
+
+        def load(i):
+            loads.append(i)
+            return ("rec", i)
+
+        cache = ShardReadaheadCache(load, depth=2)
+        try:
+            cache.on_shard(m.ShardTask(task_id=7, dataset_name="ds",
+                                       start=0, end=4))
+            deadline = time.monotonic() + 5
+            while (cache.stats()["cached_records"] < 4
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert cache.stats()["cached_records"] == 4
+            assert [cache.get(i) for i in range(4)] == [
+                ("rec", i) for i in range(4)
+            ]
+            s = cache.stats()
+            assert s["hits"] == 4 and s["misses"] == 0
+            assert loads == [0, 1, 2, 3]  # loaded once, by the loader
+            cache.gc_consumed()
+            assert cache.stats()["cached_shards"] == 0
+        finally:
+            cache.stop()
+
+    def test_inline_consumed_shard_is_never_half_installed(self):
+        cache = ShardReadaheadCache(lambda i: i, depth=2)
+        try:
+            # The consumer got there first: index 10 loads inline...
+            assert cache.get(10) == 10
+            assert cache.stats()["misses"] == 1
+            # ...so the shard covering it must be skipped wholesale when
+            # the loader finishes (all-or-nothing install).
+            cache.on_shard(m.ShardTask(task_id=3, dataset_name="ds",
+                                       start=8, end=12))
+            deadline = time.monotonic() + 5
+            while not cache._queue.empty() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            time.sleep(0.05)  # let the install decision land
+            s = cache.stats()
+            assert s["cached_records"] == 0 and s["cached_shards"] == 0
+            assert cache.get(8) == 8  # inline again, still correct
+        finally:
+            cache.stop()
+
+    def test_drop_shard_forgets_requeued_records(self):
+        cache = ShardReadaheadCache(lambda i: i, depth=2)
+        try:
+            cache.on_shard(m.ShardTask(task_id=9, dataset_name="ds",
+                                       start=0, end=3))
+            deadline = time.monotonic() + 5
+            while (cache.stats()["cached_records"] < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert cache.drop_shard(9) == 3
+            assert cache.stats()["cached_records"] == 0
+        finally:
+            cache.stop()
+
+
+class TestMixture:
+    def test_weights_retune_live_through_kv(self):
+        master = JobMaster(port=0, node_num=1, job_name="mix")
+        master.prepare()
+        client = MasterClient(master.addr, node_id=0)
+        try:
+            src_a = ShardingClient("mixa", 6, 2, client=client,
+                                   lease_plane="")
+            src_b = ShardingClient("mixb", 6, 2, client=client,
+                                   lease_plane="")
+            weights = MixtureWeights(client, "drill",
+                                     {"a": 1.0, "b": 0.0}, poll_s=0.0)
+            mixer = WeightedShardMixer({"a": src_a, "b": src_b},
+                                       weights, seed=3)
+            for _ in range(3):
+                task = mixer.fetch_shard(retry_interval=0.05, max_wait=2.0)
+                assert task is not None and task.dataset_name == "mixa"
+                assert mixer.report_batch_done(task.task_id)
+            assert mixer.stats() == {"a": 3, "b": 0}
+
+            # Operators retune the ratio mid-run; pollers converge
+            # without a restart.
+            MixtureWeights.publish(client, "drill", {"a": 0.0, "b": 1.0})
+            for _ in range(3):
+                task = mixer.fetch_shard(retry_interval=0.05, max_wait=2.0)
+                assert task is not None and task.dataset_name == "mixb"
+                assert mixer.report_batch_done(task.task_id)
+            assert weights.version == 1
+            assert mixer.stats() == {"a": 3, "b": 3}
+            # Both sources drain; zero-weight live sources fall back to
+            # uniform instead of stalling, so the mixer reaches the end.
+            while True:
+                task = mixer.fetch_shard(retry_interval=0.05, max_wait=1.0)
+                if task is None:
+                    break
+                mixer.report_batch_done(task.task_id)
+            assert mixer.dataset_finished
+        finally:
+            master.stop()
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet harness (satellite 1): multi-process lease load generator
+# ---------------------------------------------------------------------------
+
+
+class TestLeaseFleetSmoke:
+    def test_multiprocess_lease_fleet_smoke(self):
+        """Tier-1 smoke of the --procs data-plane generator: two real
+        generator processes drive bulk leases through an in-process
+        master with zero RPC errors and amortized master RPCs."""
+        from tools.fleet_sim import run_lease_fleet
+
+        out = run_lease_fleet(
+            workers=8, duration_s=1.0, procs=2, conns_per_proc=2,
+            shards_per_lease=64, completion_batch=64,
+            dataset_size=20_000, shard_size=1, num_epochs=1,
+        )
+        assert out["rpc_errors"] == 0
+        assert out["completions"] > 0
+        assert out["master_rpcs_per_shard"] < 0.2
+        assert out["procs"] == 2 and out["mode"] == "lease"

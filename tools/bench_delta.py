@@ -36,7 +36,9 @@ _INTERESTING = re.compile(
     r"(tokens_per_s|goodput_.*_pct|mbps|speedup|mfu_pct|step_time_ms"
     r"|_save_s|restore_ms|overhead|wall_.*_s|blocking_save"
     r"|_gb$|_bytes|_cut_x|rescale|preempt|detect_latency|attribution"
-    r"|agents_sustained|beats_per_s|fsyncs_per_mutation|rpc_p99)", re.I,
+    r"|agents_sustained|beats_per_s|fsyncs_per_mutation|rpc_p99"
+    r"|completions_per_s|leases_per_s|master_rpcs_per_shard"
+    r"|fetch_p99)", re.I,
 )
 
 #: Lower-is-better keys: latencies, wall clocks, overheads — and memory
@@ -56,10 +58,15 @@ _INTERESTING = re.compile(
 #: shrink; its wall-second keys (``preempt_in_place_s``,
 #: ``no_notice_restart_s``) already match ``_s$``, and
 #: ``notice_speedup_x`` stays higher-is-better via ``speedup``.
+#: Data-plane: ``master_rpcs_per_shard`` (lease amortisation) and the
+#: ``fetch_p99_ratio`` flatness figure want to shrink;
+#: ``completions_per_s``/``leases_per_s`` stay higher-is-better via the
+#: same ``(?<!per)`` lookbehind, and ``fetch_p99_ms`` already matches
+#: ``_ms$``.
 _LOWER_BETTER = re.compile(
     r"(_ms$|(?<!per)_s$|_s_per_gb$|wall|overhead|step_time|compile"
     r"|_gb$|_bytes(?!_per_s|_cut)|detect_latency|fsyncs_per_mutation"
-    r"|_loss_steps)",
+    r"|_loss_steps|master_rpcs_per_shard|fetch_p99_ratio)",
     re.I,
 )
 

@@ -26,6 +26,7 @@ test at ~100 agents. Run standalone::
 
 import argparse
 import json
+import multiprocessing
 import os
 import shutil
 import tempfile
@@ -277,6 +278,273 @@ def run_fleet(agents: int = 1000, duration_s: float = 5.0,
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+class _LeaseSlice(threading.Thread):
+    """One connection thread driving a slice of data-plane workers.
+
+    ``mode="lease"``: each worker takes a bulk :class:`m.LeaseRequest`
+    (timed — that RPC is the only fetch-side tail a plane worker ever
+    waits on; ring pops are microseconds) and acks it back in
+    ``completion_batch``-sized :class:`m.LeaseReport` chunks — the
+    broker's steady-state traffic shape, minus the shm hop.
+
+    ``mode="per_call"``: the pre-lease baseline, one
+    ``TaskRequest``/``TaskReport`` pair per shard (2 RPCs/shard).
+    """
+
+    def __init__(self, addr: str, worker_ids: List[int], deadline: float,
+                 dataset: str, shards_per_lease: int,
+                 completion_batch: int, mode: str):
+        super().__init__(daemon=True, name=f"lease-{worker_ids[0]}")
+        self._client = RpcClient(addr, timeout=60.0, retry_deadline=20.0)
+        self._ids = worker_ids
+        self._deadline = deadline
+        self._dataset = dataset
+        self._spl = shards_per_lease
+        self._batch = completion_batch
+        self._mode = mode
+        self.fetch_lat: List[float] = []
+        self.completions = 0
+        self.leases = 0
+        self.rpcs = 0
+        self.errors = 0
+
+    def run(self):
+        try:
+            if self._mode == "per_call":
+                self._run_per_call()
+            else:
+                self._run_lease()
+        finally:
+            self._client.close()
+
+    def _run_per_call(self):
+        while time.monotonic() < self._deadline:
+            for wid in self._ids:
+                if time.monotonic() >= self._deadline:
+                    return
+                t0 = time.perf_counter()
+                try:
+                    task = self._client.call(m.TaskRequest(
+                        node_id=wid, dataset_name=self._dataset,
+                    ))
+                except Exception:
+                    self.errors += 1
+                    continue
+                self.fetch_lat.append(time.perf_counter() - t0)
+                self.rpcs += 1
+                if task is None or not task.exists:
+                    return  # dataset drained
+                try:
+                    self._client.call(m.TaskReport(
+                        node_id=wid, dataset_name=self._dataset,
+                        task_id=task.task_id, success=True,
+                    ))
+                    self.rpcs += 1
+                    self.completions += 1
+                except Exception:
+                    self.errors += 1
+
+    def _run_lease(self):
+        while time.monotonic() < self._deadline:
+            for wid in self._ids:
+                if time.monotonic() >= self._deadline:
+                    return
+                t0 = time.perf_counter()
+                try:
+                    lease = self._client.call(m.LeaseRequest(
+                        node_id=wid, dataset_name=self._dataset,
+                        max_shards=self._spl,
+                    ))
+                except Exception:
+                    self.errors += 1
+                    continue
+                self.fetch_lat.append(time.perf_counter() - t0)
+                self.rpcs += 1
+                if lease is None or not lease.exists:
+                    if lease is not None and lease.finished:
+                        return
+                    time.sleep(0.05)
+                    continue
+                self.leases += 1
+                ids = [t.task_id for t in lease.tasks]
+                for i in range(0, len(ids), self._batch):
+                    chunk = ids[i:i + self._batch]
+                    try:
+                        self._client.call(m.LeaseReport(
+                            node_id=wid, dataset_name=self._dataset,
+                            lease_id=lease.lease_id, done_ids=chunk,
+                        ))
+                        self.rpcs += 1
+                        self.completions += len(chunk)
+                    except Exception:
+                        self.errors += 1
+
+
+def _proc_main(addr: str, worker_ids: List[int], conns: int,
+               duration_s: float, deadline_wall: float, dataset: str,
+               shards_per_lease: int, completion_batch: int, mode: str,
+               out_q):
+    """Child-process entry (spawn context): drive a slice of the fleet
+    from OUTSIDE the master's GIL and ship summarized stats back.
+
+    Runs for ``duration_s`` from its own start (spawn/import time never
+    counts against the measured window) but never past ``deadline_wall``
+    — a straggler child must not stretch the fleet's tail."""
+    _raise_nofile()
+    start = time.time()
+    duration = max(0.1, min(duration_s, deadline_wall - start))
+    deadline = time.monotonic() + duration
+    conns = max(1, min(conns, len(worker_ids)))
+    slices = [
+        _LeaseSlice(
+            addr, worker_ids[i::conns], deadline, dataset,
+            shards_per_lease, completion_batch, mode,
+        )
+        for i in range(conns)
+    ]
+    for s in slices:
+        s.start()
+    for s in slices:
+        s.join(timeout=duration + 60.0)
+    lat = sorted(x for s in slices for x in s.fetch_lat)
+    step = max(1, len(lat) // 2000)
+    out_q.put({
+        "start": start,
+        "end": time.time(),
+        # Percentiles survive decimation of a SORTED sample list; 2k
+        # points per child keeps the queue payload small at any scale.
+        "fetch_lat": lat[::step] + lat[-1:],
+        "completions": sum(s.completions for s in slices),
+        "leases": sum(s.leases for s in slices),
+        "rpcs": sum(s.rpcs for s in slices),
+        "errors": sum(s.errors for s in slices),
+    })
+
+
+def run_lease_fleet(workers: int = 200, duration_s: float = 5.0,
+                    procs: int = 4, conns_per_proc: int = 8,
+                    shards_per_lease: int = 512,
+                    completion_batch: int = 512,
+                    mode: str = "lease",
+                    dataset_size: int = 1_000_000, shard_size: int = 1,
+                    num_epochs: int = 4,
+                    state_dir: str = "",
+                    wal_sync: Optional[str] = "group") -> Dict:
+    """Data-plane load run: a real in-process master fed by ``procs``
+    child PROCESSES (the PR-11 single-process generator tops out around
+    4k RPC/s on its own GIL — far below the plane's throughput).
+
+    Returns the BENCH ``data_plane`` metrics: ``completions_per_s``,
+    ``leases_per_s``, ``master_rpcs_per_shard``, ``fetch_p99_ms``.
+    """
+    _raise_nofile()
+    from dlrover_tpu.master.master import JobMaster
+
+    tmp = ""
+    if not state_dir:
+        tmp = state_dir = tempfile.mkdtemp(prefix="lease_fleet_")
+    overrides = {
+        # Snapshots pickle the whole task table under the mutation-shard
+        # quiesce; mid-bench that is a multi-second master stall
+        # measuring the snapshotter, not the data plane (both the timer
+        # AND the record backstop would fire — every grant/report is a
+        # journal record). Journal replay covers durability meanwhile.
+        env_utils.STATE_SNAPSHOT_SECS.name: "3600",
+        env_utils.STATE_SNAPSHOT_RECORDS.name: "10000000",
+    }
+    if wal_sync is not None:
+        overrides[env_utils.WAL_SYNC.name] = wal_sync
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        master = JobMaster(
+            port=0, node_num=workers, job_name="lease-fleet",
+            state_dir=state_dir,
+        )
+        master.prepare()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    addr = master.addr
+    dataset = "lease-shards"
+    try:
+        admin = RpcClient(addr, timeout=30.0, retry_deadline=10.0)
+        admin.call(m.DatasetShardParams(
+            node_id=0, dataset_name=dataset, dataset_size=dataset_size,
+            shard_size=shard_size, num_epochs=num_epochs,
+        ))
+        # Warm the split: epoch creation is lazy (first fetch triggers
+        # it) and at bench sizes takes seconds under the tasks shard —
+        # every child's opening grant would queue behind it and the
+        # p99 would measure the splitter, not the plane.
+        warm = admin.call(m.LeaseRequest(
+            node_id=0, dataset_name=dataset, max_shards=1,
+        ))
+        if warm is not None and warm.exists:
+            admin.call(m.LeaseReport(
+                node_id=0, dataset_name=dataset, lease_id=warm.lease_id,
+                done_ids=[], failed_ids=[t.task_id for t in warm.tasks],
+                release=True,
+            ))
+        admin.close()
+        procs = max(1, procs)
+        ctx = multiprocessing.get_context("spawn")
+        out_q = ctx.Queue()
+        ids = list(range(workers))
+        # Generous lead time: spawned children re-import the package
+        # before their clocks start.
+        deadline_wall = time.time() + duration_s + 2.0 * procs
+        children = [
+            ctx.Process(
+                target=_proc_main,
+                args=(addr, ids[i::procs], conns_per_proc, duration_s,
+                      deadline_wall, dataset, shards_per_lease,
+                      completion_batch, mode, out_q),
+                daemon=True,
+            )
+            for i in range(procs)
+        ]
+        for c in children:
+            c.start()
+        results = []
+        for _ in children:
+            results.append(out_q.get(timeout=duration_s + 120.0))
+        for c in children:
+            c.join(timeout=30.0)
+        window = max(r["end"] for r in results) - min(
+            r["start"] for r in results
+        )
+        completions = sum(r["completions"] for r in results)
+        leases = sum(r["leases"] for r in results)
+        rpcs = sum(r["rpcs"] for r in results)
+        lat = [x for r in results for x in r["fetch_lat"]]
+        wal = master.state_store.wal_status()
+        return {
+            "mode": mode,
+            "workers": workers,
+            "procs": procs,
+            "duration_s": round(window, 2),
+            "completions": completions,
+            "completions_per_s": round(completions / max(window, 1e-9), 1),
+            "leases": leases,
+            "leases_per_s": round(leases / max(window, 1e-9), 1),
+            "master_rpcs": rpcs,
+            "master_rpcs_per_shard": round(rpcs / max(completions, 1), 4),
+            "fetch_p50_ms": round(_percentile(lat, 50) * 1e3, 3),
+            "fetch_p99_ms": round(_percentile(lat, 99) * 1e3, 3),
+            "rpc_errors": sum(r["errors"] for r in results),
+            "wal_mutations": wal["appended_records"],
+            "wal_fsyncs": wal["fsync_count"],
+        }
+    finally:
+        master.stop()
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--agents", type=int, default=1000)
@@ -287,7 +555,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--kv-every", type=int, default=4)
     ap.add_argument("--events-every", type=int, default=8)
     ap.add_argument("--task-every", type=int, default=0)
+    ap.add_argument("--procs", type=int, default=0,
+                    help="data-plane mode: N child processes of lease "
+                         "workers instead of the control-plane mix")
+    ap.add_argument("--workers", type=int, default=200)
+    ap.add_argument("--mode", default="lease",
+                    choices=("lease", "per_call"))
+    ap.add_argument("--shards-per-lease", type=int, default=512)
+    ap.add_argument("--completion-batch", type=int, default=512)
     args = ap.parse_args(argv)
+    if args.procs > 0:
+        out = run_lease_fleet(
+            workers=args.workers, duration_s=args.duration,
+            procs=args.procs, mode=args.mode,
+            shards_per_lease=args.shards_per_lease,
+            completion_batch=args.completion_batch,
+            wal_sync=args.wal_sync,
+        )
+        print(json.dumps(out, sort_keys=True))
+        return 0
     out = run_fleet(
         agents=args.agents, duration_s=args.duration, conns=args.conns,
         wal_sync=args.wal_sync, kv_every=args.kv_every,
