@@ -1483,6 +1483,191 @@ def section_rescale():
     return out
 
 
+def section_reshape():
+    """In-place mesh reshape vs full restart for the same transition.
+
+    A {fsdp=4} world (every member holds a UNIQUE slice of params and
+    optimizer state, so the dead member's quarter genuinely has to come
+    off the snapshot) loses one member; the constrained search picks
+    the best spec for the 3 survivors and the in-place arm
+    applies the reshape to the LIVE loop — surviving shard regions move
+    device-to-device, only the dead member's slice is read back from
+    the shm snapshot (``reshape_d2d_bytes`` vs ``reshape_snapshot_bytes``
+    is the split that justifies the machinery). The restart arm pays
+    the full tax for the identical transition in a fresh subprocess:
+    interpreter + imports, rebuild under the SAME searched spec,
+    cross-topology disk restore, recompile. Both arms then train one
+    identical step; the losses must match bit-for-bit (the reshape is a
+    relayout, not a numerics change). Needs >= 4 devices, so both arms
+    run in subprocesses with a forced 8-device CPU platform."""
+    import subprocess
+    import tempfile
+
+    out = {"transition": "{fsdp=4} -> searched@3dev",
+           "global_batch": 16, "micro_batch": 4}
+    td = tempfile.mkdtemp(prefix="bench_reshape_")
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def arm_env(job):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        env["DLROVER_TPU_JOB_NAME"] = job
+        env.pop("DLROVER_TPU_MASTER_ADDR", None)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [repo] + [p for p in env.get("PYTHONPATH", "").split(
+                os.pathsep) if p and "axon" not in p]
+        )
+        return env
+
+    # ---- in-place arm: search + reshape apply on the live loop ----
+    inplace_code = (
+        "import dataclasses, json, os\n"
+        "import jax, jax.numpy as jnp, numpy as np, optax\n"
+        "from dataclasses import asdict\n"
+        "from dlrover_tpu.accel import ParallelSpec\n"
+        "from dlrover_tpu.accel.accelerate import _device_hbm\n"
+        "from dlrover_tpu.accel.search import (ModelProfile,\n"
+        "    search_reshape_spec)\n"
+        "from dlrover_tpu.common import messages as m\n"
+        "from dlrover_tpu.common.batching import derive_accum_schedule\n"
+        "from dlrover_tpu.common.ckpt_meta import ckpt_shm_name\n"
+        "from dlrover_tpu.common.shared_memory import SharedMemory\n"
+        "from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn\n"
+        "from dlrover_tpu.train.checkpoint.engine import CheckpointEngine\n"
+        "from dlrover_tpu.train.elastic_trainer import ElasticTrainer\n"
+        "from dlrover_tpu.train.rescale import RescaleEngine\n"
+        "cfg = dataclasses.replace(GPTConfig.tiny(), dtype=jnp.float32)\n"
+        "def token_loss(module, params, b):\n"
+        "    return loss_fn(module.apply({'params': params}, b), b)\n"
+        "micro = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0,\n"
+        "                           cfg.vocab_size)\n"
+        "et = ElasticTrainer(16, 4, world_size=4, rank=0)\n"
+        "et.prepare(GPT(cfg), optax.adamw(1e-3), micro, token_loss,\n"
+        "           spec=ParallelSpec(fsdp=4))\n"
+        "state = et.result.state\n"
+        "b = jax.random.randint(jax.random.PRNGKey(3),\n"
+        "    (et.local_batch_size, 16), 0, cfg.vocab_size)\n"
+        "for _ in range(2):\n"
+        "    state, met = et.result.train_step(\n"
+        "        state, jax.device_put(b, et.result.batch_sharding))\n"
+        "float(met['loss']); et.result.state = state\n"
+        "step0 = int(state['step'])\n"
+        f"ck = CheckpointEngine({td!r}, keep_latest=0)\n"
+        "try:\n"
+        "    assert ck.save_to_memory(step0, state, block=True)\n"
+        "    assert ck.save_to_storage(step0, state)\n"
+        "    found = search_reshape_spec(\n"
+        "        ModelProfile.from_config(cfg), 3, 16,\n"
+        "        _device_hbm(jax.devices()), current_spec=et.result.spec)\n"
+        "    assert found, 'reshape search found no feasible spec'\n"
+        "    new_spec = found[0]\n"
+        "    plan = m.RescalePlan(\n"
+        "        plan_id=1, rdzv_name='elastic-training', old_round=1,\n"
+        "        new_round=2, old_world={0:1,1:1,2:1,3:1},\n"
+        "        new_world={0:1,1:1,2:1}, global_batch=16, micro_batch=4,\n"
+        "        accum_counts=list(derive_accum_schedule(16,4,3).counts),\n"
+        "        snapshot_step=step0, status='issued',\n"
+        "        old_spec=asdict(et.result.spec),\n"
+        "        new_spec=asdict(new_spec))\n"
+        "    eng = RescaleEngine(et, node_rank=0, checkpointer=ck)\n"
+        "    eng.round = 1\n"
+        "    tr = eng.apply(plan, state=state)\n"
+        "    assert tr.ok, tr.error\n"
+        "    b4 = jax.random.randint(jax.random.PRNGKey(4),\n"
+        "        (et.local_batch_size, 16), 0, cfg.vocab_size)\n"
+        "    s1, m1 = et.result.train_step(\n"
+        "        tr.state, jax.device_put(b4, et.result.batch_sharding))\n"
+        "    print(json.dumps({\n"
+        "        'reshape_in_place_s': round(tr.wall_s, 3),\n"
+        "        'reshape_d2d_bytes': tr.d2d_bytes,\n"
+        "        'reshape_snapshot_bytes': tr.snapshot_bytes,\n"
+        "        'spec_diff': tr.spec_diff,\n"
+        "        'spec_new': asdict(new_spec), 'step0': step0,\n"
+        "        'post_loss': float(m1['loss'])}))\n"
+        "finally:\n"
+        "    ck.close()\n"
+        "    job = os.environ['DLROVER_TPU_JOB_NAME']\n"
+        "    SharedMemory.remove(ckpt_shm_name(job, 0, 0))\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", inplace_code],
+            env=arm_env("bench-reshape-ip"), capture_output=True,
+            text=True, timeout=600,
+        )
+        assert r.returncode == 0, (
+            f"in-place reshape arm rc={r.returncode} {r.stderr[-800:]}"
+        )
+        ip = json.loads(r.stdout.strip().splitlines()[-1])
+        out.update({k: v for k, v in ip.items() if k != "post_loss"})
+
+        # ---- restart arm: same transition, same searched spec ----
+        restart_code = (
+            "import dataclasses, json\n"
+            "import jax, jax.numpy as jnp, numpy as np, optax\n"
+            "from dlrover_tpu.accel.search import spec_from_dict\n"
+            "from dlrover_tpu.models.gpt import GPT, GPTConfig, loss_fn\n"
+            "from dlrover_tpu.train.checkpoint.engine import "
+            "CheckpointEngine\n"
+            "from dlrover_tpu.train.elastic_trainer import "
+            "ElasticTrainer\n"
+            "cfg = dataclasses.replace(GPTConfig.tiny(),\n"
+            "                          dtype=jnp.float32)\n"
+            "def token_loss(module, params, b):\n"
+            "    return loss_fn(module.apply({'params': params}, b), b)\n"
+            "micro = jax.random.randint(jax.random.PRNGKey(2), (4, 16),\n"
+            "                           0, cfg.vocab_size)\n"
+            "et = ElasticTrainer(16, 4, world_size=3, rank=0)\n"
+            f"spec = spec_from_dict({ip['spec_new']!r})\n"
+            "et.prepare(GPT(cfg), optax.adamw(1e-3), micro, token_loss,\n"
+            "           spec=spec)\n"
+            f"ck = CheckpointEngine({td!r}, keep_latest=0)\n"
+            "try:\n"
+            "    step, state = ck.load(et.result.state)\n"
+            f"    assert step == {ip['step0']}, step\n"
+            "    b4 = jax.random.randint(jax.random.PRNGKey(4),\n"
+            "        (et.local_batch_size, 16), 0, cfg.vocab_size)\n"
+            "    s1, m1 = et.result.train_step(\n"
+            "        state, jax.device_put(b4, et.result.batch_sharding))\n"
+            "    print(json.dumps({'post_loss': float(m1['loss'])}))\n"
+            "finally:\n"
+            "    ck.close()\n"
+        )
+        t0 = time.perf_counter()
+        r2 = subprocess.run(
+            [sys.executable, "-c", restart_code],
+            env=arm_env("bench-reshape-rs"), capture_output=True,
+            text=True, timeout=600,
+        )
+        if r2.returncode == 0:
+            out["restart_full_s"] = round(time.perf_counter() - t0, 3)
+            out["in_place_speedup_x"] = round(
+                out["restart_full_s"]
+                / max(out["reshape_in_place_s"], 1e-6), 1
+            )
+            rs = json.loads(r2.stdout.strip().splitlines()[-1])
+            out["loss_bit_identical"] = (
+                rs["post_loss"] == ip["post_loss"]
+            )
+            assert out["loss_bit_identical"], (
+                f"reshape diverged from restart: {ip['post_loss']} vs "
+                f"{rs['post_loss']}"
+            )
+        else:
+            log(f"bench[reshape]: restart arm rc={r2.returncode} "
+                f"{r2.stderr[-400:]}")
+    finally:
+        import shutil
+
+        shutil.rmtree(td, ignore_errors=True)
+    log(f"bench[reshape]: {out}")
+    return out
+
+
 def section_preempt():
     """Preemption notice vs no-notice for the same kill: two arms.
 
@@ -1808,11 +1993,11 @@ def main():
     # budget guard sheds the tail sections, not the headline.
     default_sections = (
         "small,large,llama,longctx,goodput,ckpt_io,ckpt_dedup,"
-        "opt_shard,rescale,preempt,straggler,master_scale,data_plane,"
-        "medium,dtlint"
+        "opt_shard,rescale,reshape,preempt,straggler,master_scale,"
+        "data_plane,medium,dtlint"
         if on_tpu else
-        "small,goodput,ckpt_io,ckpt_dedup,opt_shard,rescale,preempt,"
-        "straggler,master_scale,data_plane,dtlint"
+        "small,goodput,ckpt_io,ckpt_dedup,opt_shard,rescale,reshape,"
+        "preempt,straggler,master_scale,data_plane,dtlint"
     )
     sections = os.getenv(
         "DLROVER_TPU_BENCH_SECTIONS", default_sections
@@ -1854,6 +2039,8 @@ def main():
                 extra["goodput"] = section_goodput()
             elif name == "rescale":
                 extra["rescale"] = section_rescale()
+            elif name == "reshape":
+                extra["reshape"] = section_reshape()
             elif name == "preempt":
                 extra["preempt"] = section_preempt()
             elif name == "straggler":

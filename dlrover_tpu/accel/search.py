@@ -674,3 +674,118 @@ def reconfigure_module(module, spec, batch_size: int = 0):
     new_cfg = dataclasses.replace(cfg, **changes)
     logger.info("strategy search: reconfigured model %s", changes)
     return type(module)(new_cfg)
+
+
+# ---------------- elastic mesh reshape (PR-16) ----------------
+
+#: Spec axes whose degree change forces param/optimizer bytes to move
+#: (the data axis only re-partitions the batch; params are replicated
+#: across it, so changing it moves nothing at rest).
+_STATE_MOVING_AXES = ("fsdp", "tensor", "seq", "expert", "pipe")
+
+
+def spec_from_dict(d: dict):
+    """Rebuild a ``ParallelSpec`` from its ``dataclasses.asdict`` form
+    (the RescalePlan wire/journal encoding). Unknown keys are dropped so
+    old masters' journals replay against newer specs."""
+    from dlrover_tpu.accel.accelerate import ParallelSpec
+
+    fields = {f.name for f in dataclasses.fields(ParallelSpec)}
+    return ParallelSpec(**{
+        k: v for k, v in (d or {}).items() if k in fields
+    })
+
+
+def spec_diff(old, new) -> str:
+    """Human-readable axis-by-axis diff, e.g. ``data 2->3, tensor 2->1``.
+
+    ``old``/``new`` may be ParallelSpecs or their asdict dicts; the
+    string lands in plan logs, ``RescaleInfeasible`` nacks, timeline
+    evidence lines and goodput incidents, so it names only what changed
+    (``unchanged`` when nothing did)."""
+    if isinstance(old, dict):
+        old = spec_from_dict(old)
+    if isinstance(new, dict):
+        new = spec_from_dict(new)
+    parts = []
+    for name in ("data", "fsdp", "tensor", "seq", "expert", "pipe"):
+        a, b = getattr(old, name), getattr(new, name)
+        if a != b:
+            parts.append(f"{name} {a}->{b}")
+    if old.zero != new.zero:
+        parts.append(f"zero {'on->off' if old.zero else 'off->on'}")
+    return ", ".join(parts) if parts else "unchanged"
+
+
+def spec_move_distance(old, new) -> float:
+    """How much state a transition moves, as a tie-break score: one
+    point per state-moving axis whose degree changes, half a point for
+    a zero flip (optimizer-state relayout only). The search uses it to
+    prefer, among near-equal candidates, the spec that reshards the
+    least."""
+    d = 0.0
+    for name in _STATE_MOVING_AXES:
+        if getattr(old, name) != getattr(new, name):
+            d += 1.0
+    if old.zero != new.zero:
+        d += 0.5
+    return d
+
+
+def search_reshape_spec(
+    profile: ModelProfile,
+    n_devices: int,
+    batch_size: int,
+    hbm: float,
+    current_spec=None,
+    abstract_state=None,
+    peak_flops: float = _PEAK_FLOPS_DEFAULT,
+    stickiness: float = 0.05,
+    ici_bw: float = _ICI_BW,
+) -> Optional[Tuple[Any, CostEstimate]]:
+    """Constrained-world search: the best spec for ≤ ``n_devices``.
+
+    The elastic difference from :func:`search_spec`: a membership change
+    rarely lands on a friendly device count (4 → 3 with 2 heads), so the
+    searched spec may deliberately *idle* devices — every total
+    ``m ≤ n_devices`` is enumerated and candidates compete across
+    totals, with the cost model pricing the extra accumulation a
+    smaller world pays (ElasWave's TP-for-accumulation trade falls out
+    of the ranking, not a special case). ``stickiness`` biases the
+    choice toward ``current_spec``'s layout: among candidates within
+    that fraction of the best step time, the one moving the least state
+    (:func:`spec_move_distance`) wins, so a transition that *can* keep
+    the mesh shape does. Returns None when nothing is feasible (callers
+    fall back to the DP-only plan path)."""
+    if n_devices < 1:
+        return None
+    cands = []
+    for m in range(n_devices, 0, -1):
+        cands.extend(enumerate_specs(profile, m, batch_size))
+    if not cands:
+        return None
+    scored = []
+    for spec in cands:
+        est = estimate(
+            profile, spec, batch_size, hbm, abstract_state, peak_flops,
+            ici_bw=ici_bw,
+        )
+        scored.append((spec, est))
+    fitting = [s for s in scored if s[1].fits(hbm)]
+    pool = fitting or scored
+    pool = sorted(pool, key=lambda s: s[1].step_s)
+    best_t = pool[0][1].step_s
+    near = [s for s in pool if s[1].step_s <= best_t * (1.0 + stickiness)]
+    if current_spec is not None:
+        near.sort(key=lambda s: (
+            spec_move_distance(current_spec, s[0]), s[1].step_s,
+        ))
+    chosen, est = near[0]
+    logger.info(
+        "reshape search: %d candidates for <=%d devices -> %s "
+        "(est %.1f ms/step, move distance %s)",
+        len(cands), n_devices, chosen, est.step_s * 1e3,
+        "n/a" if current_spec is None
+        else spec_move_distance(current_spec, chosen),
+    )
+    return chosen, est

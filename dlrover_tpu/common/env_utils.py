@@ -407,6 +407,18 @@ RESCALE_POLL_INTERVAL_S = ENV.float(
     "DLROVER_TPU_RESCALE_POLL_INTERVAL_S", 0.2,
     "Agent/worker poll interval for an active rescale plan after their "
     "round goes stale.")
+RESCALE_RESHAPE = ENV.bool(
+    "DLROVER_TPU_RESCALE_RESHAPE", True,
+    "Enable elastic mesh reshape: on a membership change the master "
+    "searches the surviving device world for the best ParallelSpec and "
+    "embeds it in the plan; survivors rebuild their mesh in place and "
+    "hydrate state d2d where old and new shard covers overlap. 0/false "
+    "keeps plans DP-only (accumulation schedule changes only).")
+RESCALE_RESHAPE_STICKINESS = ENV.float(
+    "DLROVER_TPU_RESCALE_RESHAPE_STICKINESS", 0.05,
+    "Fractional step-time slack within which the reshape search prefers "
+    "the spec closest to the current mesh layout (fewest state-moving "
+    "axis changes), so a transition that can keep its shape does.")
 
 # ---------------- preemption plane ----------------
 PREEMPT = ENV.bool(

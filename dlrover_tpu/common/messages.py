@@ -14,7 +14,7 @@ tuples must list exactly the marked classes.
 """
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 
 @dataclass
@@ -402,10 +402,24 @@ class RescalePlan:
     snapshot_step: int = -1
     #: "issued" | "complete" | "aborted"
     status: str = ""
+    #: mesh reshape (PR-16): the ParallelSpec the fleet was running and
+    #: the one the coordinator's constrained-world search picked for the
+    #: surviving devices, as ``dataclasses.asdict`` dicts (degree name →
+    #: degree, plus ``zero``). Empty dicts = DP-only plan (pre-reshape
+    #: journals replay unchanged); survivors then keep their mesh and
+    #: only retune the accumulation schedule.
+    old_spec: Dict[str, Any] = field(default_factory=dict)
+    new_spec: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def exists(self) -> bool:
         return self.plan_id >= 0
+
+    @property
+    def reshapes(self) -> bool:
+        """True when the plan carries a searched mesh change (not just
+        a new accumulation schedule)."""
+        return bool(self.new_spec) and self.new_spec != self.old_spec
 
 
 @dataclass

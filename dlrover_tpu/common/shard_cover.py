@@ -1,0 +1,180 @@
+"""Shard-cover algebra: which bytes of a resharded leaf move d2d.
+
+The mesh-reshape data plane (``train/rescale.py``) rebuilds a live
+train state under a *different* ``ParallelSpec`` — TP traded for
+accumulation, FSDP degree changed, devices gone. Every destination
+shard must be hydrated from somewhere, and there are exactly two
+sources with different costs:
+
+- a **surviving live shard** whose region overlaps the destination
+  region: the bytes move device-to-device (``jax.device_put``), never
+  touching the host path — cheap;
+- the **shm snapshot** through the flash-checkpoint block catalog, for
+  whatever the surviving shards do not cover (their device died with
+  the evicted/preempted member) — a host read + H2D.
+
+This module is the pure geometry underneath that split. Regions are
+the block catalog's normal form — ``((start, stop), ...)`` per axis,
+exactly what ``engine._index_key`` produces — and the only operations
+are axis-aligned box intersection/subtraction, so the decomposition is
+*exact*: the d2d pieces and the snapshot remainder are disjoint and
+their union is the destination region, element for element. Tests
+(``tests/test_reshape.py``) assert that property exhaustively over
+{data×tp}→{data'×tp'} transitions and check the assembled bytes are
+bitwise identical to a full snapshot restore.
+
+No jax import at module scope: the algebra is plain tuples + numpy, so
+the master-side coordinator and the worker-side engine share it without
+dragging a backend in.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: ((start, stop), ...) — one half-open interval per axis.
+Region = Tuple[Tuple[int, int], ...]
+
+
+def normalize_index(index, shape) -> Region:
+    """A shard's slice-tuple index in region normal form (the same
+    normalization as the checkpoint engine's ``_index_key``)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def region_size(region: Region) -> int:
+    """Element count of a region (0 when any axis is empty)."""
+    n = 1
+    for start, stop in region:
+        if stop <= start:
+            return 0
+        n *= stop - start
+    return n
+
+
+def intersect_regions(a: Region, b: Region) -> Optional[Region]:
+    """Axis-aligned intersection, or None when empty."""
+    out = []
+    for (as_, ae), (bs, be) in zip(a, b):
+        s, e = max(as_, bs), min(ae, be)
+        if s >= e:
+            return None
+        out.append((s, e))
+    return tuple(out)
+
+
+def subtract_region(region: Region, hole: Region) -> List[Region]:
+    """``region \\ hole`` as disjoint boxes (slab decomposition).
+
+    Peels at most two slabs per axis off the part of ``region`` outside
+    ``hole`` and narrows the remainder, so the result boxes are disjoint
+    and their union is exactly the set difference."""
+    inter = intersect_regions(region, hole)
+    if inter is None:
+        return [region]
+    out: List[Region] = []
+    cur = list(region)
+    for ax, ((rs, re), (is_, ie)) in enumerate(zip(region, inter)):
+        if rs < is_:
+            out.append(tuple(cur[:ax] + [(rs, is_)] + cur[ax + 1:]))
+        if ie < re:
+            out.append(tuple(cur[:ax] + [(ie, re)] + cur[ax + 1:]))
+        cur[ax] = (is_, ie)
+    return out
+
+
+@dataclass(frozen=True)
+class CoverSplit:
+    """One destination region decomposed by its hydration source.
+
+    ``d2d`` pieces carry the index of the source cover that serves them
+    (first cover wins where sources overlap — replicas hold identical
+    bytes, so any single serving replica is correct). ``snapshot`` is
+    the remainder no surviving source covers. Pieces are mutually
+    disjoint and union to the destination region exactly."""
+
+    #: ((region, source_index), ...) — servable device-to-device.
+    d2d: Tuple[Tuple[Region, int], ...]
+    #: regions only the shm snapshot / block catalog can provide.
+    snapshot: Tuple[Region, ...]
+
+    @property
+    def d2d_elems(self) -> int:
+        return sum(region_size(r) for r, _ in self.d2d)
+
+    @property
+    def snapshot_elems(self) -> int:
+        return sum(region_size(r) for r in self.snapshot)
+
+
+def split_cover(dst: Region, sources: Sequence[Region]) -> CoverSplit:
+    """Decompose ``dst`` into d2d pieces (covered by ``sources``) and
+    the snapshot remainder. Exact: the pieces partition ``dst``."""
+    remaining: List[Region] = [dst] if region_size(dst) else []
+    d2d: List[Tuple[Region, int]] = []
+    for si, src in enumerate(sources):
+        if not remaining:
+            break
+        nxt: List[Region] = []
+        for r in remaining:
+            inter = intersect_regions(r, src)
+            if inter is None:
+                nxt.append(r)
+                continue
+            d2d.append((inter, si))
+            nxt.extend(subtract_region(r, inter))
+        remaining = nxt
+    return CoverSplit(d2d=tuple(d2d), snapshot=tuple(remaining))
+
+
+def sharding_covers(sharding, shape) -> List[Tuple[Any, Region]]:
+    """Every (device, region) a sharding lays out for ``shape``.
+
+    Replicated placements appear once per device — exactly what the
+    reshape needs: each destination device hydrates its own copy, and
+    each surviving source device is an independent d2d donor."""
+    dims = tuple(int(d) for d in shape)
+    return [
+        (dev, normalize_index(idx, dims))
+        for dev, idx in sharding.devices_indices_map(dims).items()
+    ]
+
+
+def leaf_transfer_split(
+    old_array,
+    new_sharding,
+    lost_devices,
+) -> Dict[Region, CoverSplit]:
+    """Per unique destination region of ``new_sharding``: how it splits
+    between surviving live shards of ``old_array`` and the snapshot.
+
+    ``lost_devices`` are devices whose HBM went with a dead member; live
+    shards on them must NOT serve as d2d sources (the real transfer has
+    nothing to read there). Returns ``{dst_region: CoverSplit}`` where
+    the split's source indices refer to the surviving-shard list in
+    iteration order of ``old_array.addressable_shards`` (restricted to
+    survivors) — see :func:`surviving_shards`."""
+    lost = set(lost_devices or ())
+    sources = [
+        normalize_index(sh.index, old_array.shape)
+        for sh in old_array.addressable_shards
+        if sh.device not in lost
+    ]
+    out: Dict[Region, CoverSplit] = {}
+    for _dev, region in sharding_covers(new_sharding, old_array.shape):
+        if region not in out:
+            out[region] = split_cover(region, sources)
+    return out
+
+
+def surviving_shards(old_array, lost_devices) -> List[Any]:
+    """The addressable shards usable as d2d donors, in the order
+    :func:`leaf_transfer_split` indexed them."""
+    lost = set(lost_devices or ())
+    return [
+        sh for sh in old_array.addressable_shards if sh.device not in lost
+    ]

@@ -307,6 +307,9 @@ class JobMaster:
                     elif kind == "rescale":
                         _, payload, ts = rec
                         self.rescale.replay(payload)
+                    elif kind == "reshape":
+                        _, payload, ts = rec
+                        self.rescale.replay_reshape(payload)
                     elif kind == "preempt":
                         _, payload, ts = rec
                         self.preempt.replay(payload)

@@ -50,6 +50,10 @@ class RescaleCoordinator:
         "_acks": "master.rescale",
         "_deadlines": "master.rescale",
         "_capable": "master.rescale",
+        "_spec": "master.rescale",
+        "_profile": "master.rescale",
+        "_hbm": "master.rescale",
+        "_last_select": "master.rescale",
     }
 
     """Decides, journals and tracks in-place scale transitions.
@@ -85,6 +89,16 @@ class RescaleCoordinator:
         # out the full apply timeout training on a stale world before
         # falling back to the restart it could have taken immediately.
         self._capable: set = set()
+        # Mesh-reshape inputs (journaled as ("reshape", ...) records):
+        # the fleet's current ParallelSpec, its ModelProfile and the
+        # per-device HBM, all as plain dicts/floats off ModelInfo.extra.
+        # Without them plans stay DP-only (schedule retunes).
+        self._spec: Dict[str, Any] = {}
+        self._profile: Dict[str, Any] = {}
+        self._hbm: float = 0.0
+        # The last searched-spec selection, for introspection and so an
+        # abort's evidence can name the transition it fenced.
+        self._last_select: Dict[str, Any] = {}
 
     # ---------------- journal plumbing ----------------
     @property
@@ -94,6 +108,10 @@ class RescaleCoordinator:
     def _journal(self, payload: Dict[str, Any]):
         if self._store is not None and not self._store.replaying:
             self._store.append(("rescale", payload, time.time()))
+
+    def _journal_reshape(self, payload: Dict[str, Any]):
+        if self._store is not None and not self._store.replaying:
+            self._store.append(("reshape", payload, time.time()))
 
     # ---------------- live inputs ----------------
     def set_batch_config(self, global_batch: int, micro_batch: int):
@@ -124,6 +142,32 @@ class RescaleCoordinator:
                 return
             self._capable.add(node_rank)
         self._journal({"rec": "capable", "node": int(node_rank)})
+
+    def set_parallel_config(
+        self, spec: Dict[str, Any], profile: Dict[str, Any],
+        hbm: float = 0.0,
+    ):
+        """Record the fleet's mesh layout + model profile (journaled as
+        a ``("reshape", ...)`` record): the inputs the constrained-world
+        spec search needs. Without them a membership change can only
+        retune the accumulation schedule — any job running TP/FSDP/pipe
+        degrees would nack the plan and pay the restart tax."""
+        spec = dict(spec or {})
+        profile = dict(profile or {})
+        with self._lock:
+            if (
+                self._spec == spec and self._profile == profile
+                and (hbm <= 0 or self._hbm == hbm)
+            ):
+                return
+            self._spec = spec
+            self._profile = profile
+            if hbm > 0:
+                self._hbm = float(hbm)
+        self._journal_reshape({
+            "rec": "config", "spec": spec, "profile": profile,
+            "hbm": float(hbm),
+        })
 
     def note_step(self, step: int):
         """Track the newest reported global step — the plan's
@@ -243,6 +287,9 @@ class RescaleCoordinator:
                 "full restart", e,
             )
             return None
+        old_spec, new_spec = self._select_reshape(
+            old_world, new_world, global_batch
+        )
         new_round = mgr.absorb_world(new_world)
         superseded: List[m.RescalePlan] = []
         with self._lock:
@@ -268,6 +315,8 @@ class RescaleCoordinator:
                 accum_counts=list(sched.counts),
                 snapshot_step=snapshot_step,
                 status=PLAN_ISSUED,
+                old_spec=old_spec,
+                new_spec=new_spec,
             )
             self._next_plan_id += 1
             self._plans[plan.plan_id] = plan
@@ -289,19 +338,92 @@ class RescaleCoordinator:
                 plan_id=old.plan_id, reason="superseded",
             )
         self._journal({"rec": "plan", "plan": asdict(plan)})
+        diff = ""
+        if plan.reshapes:
+            from dlrover_tpu.accel.search import spec_diff
+
+            diff = spec_diff(plan.old_spec, plan.new_spec)
+            select = {
+                "rec": "select", "plan_id": plan.plan_id,
+                "old_spec": dict(plan.old_spec),
+                "new_spec": dict(plan.new_spec), "diff": diff,
+            }
+            with self._lock:
+                self._last_select = select
+            self._journal_reshape(select)
         logger.info(
             "rescale plan %s: %s %s -> %s (round %s -> %s, accum %s, "
-            "snapshot_step %s)", plan.plan_id, transition,
+            "snapshot_step %s%s)", plan.plan_id, transition,
             sorted(old_world), sorted(new_world), plan.old_round,
             plan.new_round, plan.accum_counts, plan.snapshot_step,
+            f", reshape {diff}" if diff else "",
         )
         emit(  # dtlint: disable=DT012 -- replay-guarded at the sink: JobMaster._event_sink drops emits while store.replaying
             EventKind.RESCALE_PLAN, _role="master",
             plan_id=plan.plan_id, transition=transition,
             old_world=sorted(old_world), new_world=sorted(new_world),
             old_round=plan.old_round, new_round=plan.new_round,
+            **({"spec_diff": diff} if diff else {}),
         )
         return plan
+
+    def _select_reshape(
+        self,
+        old_world: Dict[int, int],
+        new_world: Dict[int, int],
+        global_batch: int,
+    ) -> tuple:
+        """Pick the surviving world's ParallelSpec via the constrained
+        search (``accel/search.py``). Returns ``(old_spec, new_spec)``
+        as asdict dicts, or ``({}, {})`` to keep the plan DP-only —
+        which is correct whenever the fleet never reported its mesh
+        (``set_parallel_config``), runs a trivial 1-device spec, or the
+        member→device mapping is not integral. Search failures degrade
+        to DP-only, never to a lost plan."""
+        with self._lock:
+            spec_d = dict(self._spec)
+            profile_d = dict(self._profile)
+            hbm = self._hbm
+        if not env_utils.RESCALE_RESHAPE.get() or not spec_d:  # dtlint: disable=DT011 -- never reached on replay: _issue_plan is guarded by _replaying in both triggers; plans replay via their journaled record
+            return {}, {}
+        try:
+            import dataclasses as _dc
+
+            from dlrover_tpu.accel.search import (
+                ModelProfile,
+                search_reshape_spec,
+                spec_from_dict,
+            )
+
+            cur = spec_from_dict(spec_d)
+            old_procs = sum(old_world.values())
+            new_procs = sum(new_world.values())
+            if cur.total <= 1 or old_procs <= 0:
+                return {}, {}
+            if cur.total % old_procs:
+                # No integral member→device mapping: the mesh does not
+                # shrink/grow proportionally with membership, so there
+                # is nothing principled to search against.
+                return {}, {}
+            n_devices = (cur.total // old_procs) * new_procs
+            fields = {f.name for f in _dc.fields(ModelProfile)}
+            profile = ModelProfile(**{
+                k: v for k, v in profile_d.items() if k in fields
+            })
+            found = search_reshape_spec(
+                profile, n_devices, global_batch,
+                hbm or 16e9, current_spec=cur,
+                stickiness=env_utils.RESCALE_RESHAPE_STICKINESS.get(),  # dtlint: disable=DT011 -- same guard: spec selection only runs live; the chosen spec is journaled in the plan record
+            )
+            if found is None:
+                return {}, {}
+            return spec_d, _dc.asdict(found[0])
+        except Exception as e:
+            logger.warning(
+                "reshape spec search failed (%s); issuing a DP-only "
+                "plan", e,
+            )
+            return {}, {}
 
     # ---------------- delivery / acks ----------------
     def get_plan(
@@ -361,17 +483,26 @@ class RescaleCoordinator:
                     completed = True
             rdzv_name = plan.rdzv_name
             new_round = plan.new_round
+            reshape_diff = ""
+            if plan.reshapes:
+                from dlrover_tpu.accel.search import spec_diff
+
+                reshape_diff = spec_diff(plan.old_spec, plan.new_spec)
         if self._replaying:
             return True
         if aborted:
             logger.error(
-                "rescale plan %s aborted by node %s: %s; invalidating "
-                "round %s for full restart", plan_id, node_rank, error,
+                "rescale plan %s (round %s%s) aborted by node %s: %s; "
+                "invalidating round %s for full restart", plan_id,
                 new_round,
+                f", reshape {reshape_diff}" if reshape_diff else "",
+                node_rank, error, new_round,
             )
             emit(  # dtlint: disable=DT012 -- replay-guarded at the sink: JobMaster._event_sink drops emits while store.replaying
                 EventKind.RESCALE_ABORT, _node_id=node_rank,
                 _role="master", plan_id=plan_id, reason=error or "nack",
+                round=new_round,
+                **({"spec_diff": reshape_diff} if reshape_diff else {}),
             )
             self._invalidate_if_current(rdzv_name, new_round)
         elif completed:
@@ -472,6 +603,10 @@ class RescaleCoordinator:
                 "micro_batch": self._micro_batch,
                 "last_step": self._last_step,
                 "capable": sorted(self._capable),
+                "spec": dict(self._spec),
+                "profile": dict(self._profile),
+                "hbm": self._hbm,
+                "last_select": dict(self._last_select),
             }
 
     def restore(self, state: dict):
@@ -507,6 +642,13 @@ class RescaleCoordinator:
             self._capable.update(
                 int(r) for r in state.get("capable", [])
             )
+            if state.get("spec"):
+                self._spec = dict(state["spec"])
+            if state.get("profile"):
+                self._profile = dict(state["profile"])
+            self._hbm = float(state.get("hbm", self._hbm))
+            if state.get("last_select"):
+                self._last_select = dict(state["last_select"])
 
     def replay(self, payload: Dict[str, Any]):
         """Re-apply one journaled ``("rescale", payload, ts)`` record.
@@ -543,3 +685,25 @@ class RescaleCoordinator:
                     plan.status = PLAN_ABORTED
         else:
             logger.warning("skipping unknown rescale record %r", rec)
+
+    def replay_reshape(self, payload: Dict[str, Any]):
+        """Re-apply one journaled ``("reshape", payload, ts)`` record.
+
+        Pure overwrite bookkeeping: ``config`` restores the spec-search
+        inputs (``set_parallel_config``'s snapshot), ``select`` restores
+        the last searched transition. The chosen spec itself rides in
+        the plan's own ``("rescale", ...)`` record — the search NEVER
+        re-runs on replay."""
+        rec = payload.get("rec")
+        if rec == "config":
+            with self._lock:
+                self._spec = dict(payload.get("spec", {}))
+                self._profile = dict(payload.get("profile", {}))
+                hbm = float(payload.get("hbm", 0.0))
+                if hbm > 0:
+                    self._hbm = hbm
+        elif rec == "select":
+            with self._lock:
+                self._last_select = dict(payload)
+        else:
+            logger.warning("skipping unknown reshape record %r", rec)

@@ -429,6 +429,15 @@ class MasterServicer:
                 req.extra["global_batch"],
                 req.extra.get("micro_batch", 1),
             )
+        if self._rescale is not None and req.extra.get("parallel_spec"):
+            # Mesh layout + model profile: the inputs the reshape spec
+            # search runs on when membership changes. Journaled by the
+            # coordinator as a ("reshape", ...) record.
+            self._rescale.set_parallel_config(
+                req.extra["parallel_spec"],
+                req.extra.get("model_profile", {}),
+                float(req.extra.get("hbm", 0.0)),
+            )
         return m.Response()
 
     def _report_failure(self, req: m.NodeFailure):

@@ -57,6 +57,13 @@ WAL_RECORDS: Dict[str, Tuple[str, ...]] = {
     # requeues the outstanding ids). Lease completion batches replay
     # through their ordinary "rpc" record (LeaseReport is journaled).
     "lease": ("ShardLeaseService.replay",),
+    # ("reshape", payload, ts) — mesh-reshape records on the rescale
+    # coordinator: the spec-search inputs (the fleet's ParallelSpec +
+    # model profile + HBM, from set_parallel_config) and the searched
+    # transition a plan selected. The chosen spec itself replays inside
+    # the plan's "rescale" record; these records only restore the
+    # inputs so a failed-over master can search the NEXT transition.
+    "reshape": ("RescaleCoordinator.replay_reshape",),
     # ("preempt", payload, ts) — preemption coordinator journal: only
     # the unjournaled-input transitions (writer-lease handoff computed
     # from the live rendezvous world, step-boundary shrink mark,

@@ -165,6 +165,27 @@ class GoodputLedger:
                 inc = self._open_incident_for(ev.node_id)
                 if inc is not None:
                     inc.trail.append(ev.kind)
+                    self._fold_reshape_evidence(inc, ev)
+
+    @staticmethod
+    def _fold_reshape_evidence(inc: Incident, ev: JobEvent):  # dtlint: holds(observability.goodput)
+        """Reshape transitions annotate their incident the way straggler
+        probes do: the applied (or declined) old->new spec diff plus the
+        d2d/snapshot byte split, so a goodput report can say *what the
+        in-place optimization actually moved* — or why it fell back."""
+        diff = ev.args.get("spec_diff")
+        if not diff:
+            return
+        if ev.kind == EventKind.RESCALE_COMPLETE:
+            inc.evidence = (
+                f"reshape {diff}: d2d {int(ev.args.get('d2d_bytes', 0))}B"
+                f", snapshot {int(ev.args.get('snapshot_bytes', 0))}B"
+            )
+        elif ev.kind == EventKind.RESCALE_ABORT:
+            reason = ev.args.get("reason", "")
+            inc.evidence = f"reshape {diff} declined" + (
+                f": {reason}" if reason else ""
+            )
 
     def _on_fault(self, ev: JobEvent):
         cause = _OPENING[ev.kind]

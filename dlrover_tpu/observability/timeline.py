@@ -114,8 +114,10 @@ def render_text(events: List[JobEvent], out=None) -> None:
                 file=out,
             )
             # Straggler incidents carry the detector's phase/probe
-            # evidence (which key degraded, by how much vs baseline).
-            if inc["cause"].startswith("straggler:") and inc.get("evidence"):
+            # evidence (which key degraded, by how much vs baseline);
+            # rescale incidents carry the reshape's spec diff and
+            # d2d/snapshot byte split (or the decline reason).
+            if inc.get("evidence"):
                 print(f"             evidence: {inc['evidence']}", file=out)
 
 

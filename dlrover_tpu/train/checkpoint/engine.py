@@ -847,6 +847,46 @@ class CheckpointEngine:
 
         return read
 
+    def memory_region_reader(self):
+        """``(step, read_region)`` over the newest shm snapshot.
+
+        The mesh-reshape hydration path (``train/rescale.py``) pulls most
+        of the new layout device-to-device from the surviving shards and
+        only needs the snapshot for the regions the dead members held —
+        a full ``load()`` would read and re-device_put everything. This
+        hands out a targeted reader instead: ``read_region(path, region)``
+        assembles exactly that region from the snapshot blocks (region is
+        ``((start, stop), ...)`` per axis in global coordinates) and
+        raises ``KeyError`` on an unknown path or a cover gap. Returns
+        ``(-1, None)`` when no consistent snapshot exists.
+        """
+        meta = self._memory_meta()
+        if meta is None or not SharedMemory.exists(self._shm_name):
+            return -1, None
+        shm = self._shm or SharedMemory(self._shm_name)
+        self._shm = shm
+        buf = shm.buf
+        catalog: Dict[str, List] = {}
+        for t in meta.tensors:
+            catalog.setdefault(t.path, []).append(
+                (t, self._shm_reader(buf, t))
+            )
+
+        def read_region(path: str, region) -> np.ndarray:
+            blocks = catalog.get(path)
+            if not blocks:
+                raise KeyError(f"no snapshot blocks for {path}")
+            region = tuple((int(s), int(e)) for s, e in region)
+            out = np.empty(
+                tuple(e - s for s, e in region), dtype=blocks[0][0].dtype
+            )
+            # Same straggling-staging-thread guard as load().
+            with self._write_mutex:
+                self._region_fill(out, region, blocks, exact_pairs=None)
+            return out
+
+        return meta.step, read_region
+
     def _load_from_storage(self, template) -> Tuple[int, Any]:
         """Storage restore with a verified fallback chain.
 

@@ -138,14 +138,30 @@ class ElasticTrainer:
         """Samples this process feeds per train-step call."""
         return self.micro_batch_size * self.accum_steps
 
-    def retune(self, world_size: int, rank: Optional[int] = None):
+    @property
+    def spec(self):
+        """The ParallelSpec the prepared step runs under (None before
+        :meth:`prepare`; the *resolved* spec once built, even when
+        prepare was called with ``spec="auto"``)."""
+        if self.result is not None:
+            return self.result.spec
+        if self._prepare_args is not None:
+            sp = self._prepare_args[4]
+            return sp if not isinstance(sp, str) else None
+        return None
+
+    def retune(self, world_size: int, rank: Optional[int] = None,
+               spec=None):
         """Re-derive the schedule for a new world (in-place rescale).
 
         The global batch is preserved exactly: the total microbatch
         count is world-independent, only its partition over ranks
         changes (remainder to the lowest ranks, deterministically).
         When :meth:`prepare` already ran, the train step is rebuilt for
-        the new accumulation count. Returns the new schedule.
+        the new accumulation count. ``spec`` swaps in a new
+        ``ParallelSpec`` for the rebuild — the mesh-reshape entry point:
+        the caller (``train/rescale.py``) then rehydrates the rebuilt
+        state from the old shards d2d. Returns the new schedule.
         """
         schedule = derive_accum_schedule(
             self.global_batch_size, self.configured_micro_batch,
@@ -154,6 +170,12 @@ class ElasticTrainer:
         self.world_size = world_size
         if rank is not None:
             self.rank = rank
+        if spec is not None and self._prepare_args is not None:
+            (module, optimizer, sample, loss, _old_spec,
+             accel_kwargs) = self._prepare_args
+            self._prepare_args = (
+                module, optimizer, sample, loss, spec, accel_kwargs,
+            )
         self._apply_schedule(schedule)
         if self._prepare_args is not None:
             self._build()
@@ -184,16 +206,54 @@ class ElasticTrainer:
         try:
             from dlrover_tpu.agent.master_client import MasterClient
 
+            extra = {
+                "global_batch": self.global_batch_size,
+                "micro_batch": self.configured_micro_batch,
+            }
+            extra.update(self._parallel_config_extras())
             MasterClient.singleton_instance().report_model_info(
                 params_count=0, flops_per_step=0.0,
                 batch_size=self.global_batch_size,
-                extra={
-                    "global_batch": self.global_batch_size,
-                    "micro_batch": self.configured_micro_batch,
-                },
+                extra=extra,
             )
         except Exception as e:
             logger.debug("batch config report failed: %s", e)
+
+    def _parallel_config_extras(self) -> dict:
+        """The mesh-reshape search inputs (spec + model profile + HBM)
+        for ModelInfo.extra. Best-effort: a trainer whose model defies
+        profiling just keeps DP-only plans."""
+        if self.result is None:
+            return {}
+        try:
+            import dataclasses
+
+            import jax
+            import numpy as np
+
+            from dlrover_tpu.accel.accelerate import _device_hbm
+            from dlrover_tpu.accel.search import ModelProfile
+            from dlrover_tpu.accel.sharding import unbox
+
+            cfg = getattr(self.result.module, "cfg", None)
+            if cfg is not None and dataclasses.is_dataclass(cfg):
+                profile = ModelProfile.from_config(cfg)
+            else:
+                count = sum(
+                    int(np.prod(np.shape(leaf))) for leaf in
+                    jax.tree_util.tree_leaves(
+                        unbox(self.result.state["params"])
+                    )
+                )
+                profile = ModelProfile.from_params(count)
+            return {
+                "parallel_spec": dataclasses.asdict(self.result.spec),
+                "model_profile": dataclasses.asdict(profile),
+                "hbm": float(_device_hbm(jax.devices())),
+            }
+        except Exception as e:
+            logger.debug("parallel config extras failed: %s", e)
+            return {}
 
     def _build(self):
         import numpy as np

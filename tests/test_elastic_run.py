@@ -124,6 +124,10 @@ class TestElasticRun:
         with open(marker) as f:
             assert int(f.read()) == 7
 
+    # Promoted to slow: at ~75s this was the single largest tier-1 cost
+    # and the eviction/re-form path stays covered by the faster
+    # in-process drills (test_rescale, test_reshape).
+    @pytest.mark.slow
     def test_permanent_node_loss_survivor_reforms(self, tmp_path):
         """Kill one of two agents (and its worker) with NO failure report:
         the master's heartbeat monitor evicts the node, invalidates the
@@ -348,6 +352,10 @@ class TestElasticRun:
 
 
 class TestMasterFailover:
+    # Promoted to slow for tier-1 headroom (~16s of subprocess churn);
+    # master-restart recovery itself is exercised in-process by the
+    # state-store/WAL replay tests.
+    @pytest.mark.slow
     def test_master_killed_and_relaunched_job_completes(self, tmp_path):
         """The master is the one per-job singleton: kill it mid-run and
         relaunch it at the same address (the reference's operator

@@ -332,6 +332,10 @@ class TestVocabChurnScale:
     stay functional (exact spill/restore bookkeeping) and complete in
     bounded time thanks to the O(1)-victim LRU + batched tier moves."""
 
+    # Promoted to slow: ~45s of pure churn volume; the same
+    # spill/restore bookkeeping is asserted by the fast capped-table
+    # tests above, this one only adds scale.
+    @pytest.mark.slow
     def test_churn_through_capped_table(self):
         import time
 

@@ -35,7 +35,8 @@ REGRESSION_PCT = 5.0
 _INTERESTING = re.compile(
     r"(tokens_per_s|goodput_.*_pct|mbps|speedup|mfu_pct|step_time_ms"
     r"|_save_s|restore_ms|overhead|wall_.*_s|blocking_save"
-    r"|_gb$|_bytes|_cut_x|rescale|preempt|detect_latency|attribution"
+    r"|_gb$|_bytes|_cut_x|rescale|reshape|preempt|detect_latency"
+    r"|attribution"
     r"|agents_sustained|beats_per_s|fsyncs_per_mutation|rpc_p99"
     r"|completions_per_s|leases_per_s|master_rpcs_per_shard"
     r"|fetch_p99)", re.I,
@@ -58,6 +59,11 @@ _INTERESTING = re.compile(
 #: shrink; its wall-second keys (``preempt_in_place_s``,
 #: ``no_notice_restart_s``) already match ``_s$``, and
 #: ``notice_speedup_x`` stays higher-is-better via ``speedup``.
+#: Reshape: ``reshape_in_place_s`` (transition wall clock) matches
+#: ``_s$`` and ``reshape_d2d_bytes``/``reshape_snapshot_bytes`` match
+#: ``_bytes`` — all lower-is-better (less moved, and what moves should
+#: move d2d: the snapshot share shrinking is the win, tracked by the
+#: byte split itself).
 #: Data-plane: ``master_rpcs_per_shard`` (lease amortisation) and the
 #: ``fetch_p99_ratio`` flatness figure want to shrink;
 #: ``completions_per_s``/``leases_per_s`` stay higher-is-better via the
