@@ -1164,6 +1164,143 @@ def section_straggler():
     return out
 
 
+def section_remediation():
+    """Closed-loop straggler remediation, two arms on the same
+    degraded-link fleet (in-process, CPU-friendly): four synthetic
+    workers, worker 0's link probes degraded for a fixed span of
+    rounds. The **auto** arm runs the RemediationPolicy — sustained
+    verdict → quarantine → in-place shrink → probe recovery →
+    probation regrow; the **detect-only** arm
+    (DLROVER_TPU_REMEDIATION=0) books the incident but leaves the
+    world alone, dragging every collective at the straggler's pace
+    while the link is bad. Goodput uses the collective step-time
+    model: a round costs the slow step time while a degraded node is
+    in the training world, the healthy step time otherwise. Reports
+    the modelled throughput of both arms, the uplift (higher is
+    better), the detect→act latency in policy ticks (lower is
+    better), and the flap count (quarantines beyond the first +
+    reverts; must be zero)."""
+    from dlrover_tpu.common.constants import RendezvousName
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+    from dlrover_tpu.master.monitor.straggler import StragglerDetector
+    from dlrover_tpu.master.remediation import (
+        STATE_PROBATION, RemediationPolicy,
+    )
+    from dlrover_tpu.master.rendezvous import (
+        ElasticTrainingRendezvousManager,
+    )
+    from dlrover_tpu.master.rescale import RescaleCoordinator
+
+    TRAIN = RendezvousName.TRAINING
+    probe_ok = {"h2d_mbps": 800.0, "d2h_mbps": 800.0, "rtt_ms": 1.0}
+    probe_bad = {"h2d_mbps": 800.0, "d2h_mbps": 40.0, "rtt_ms": 20.0}
+    workers, rounds = 4, 30
+    degrade_from, degrade_until = 4, 16  # worker 0's bad-link span
+    fast_s, slow_s = 0.1, 0.4  # collective step-time model
+
+    knobs = {
+        "DLROVER_TPU_REMEDIATION_SUSTAIN_TICKS": "2",
+        "DLROVER_TPU_REMEDIATION_COOLDOWN_S": "0",
+        "DLROVER_TPU_REMEDIATION_PROBATION_S": "3",
+    }
+
+    def arm(remediate):
+        os.environ["DLROVER_TPU_REMEDIATION"] = (
+            "1" if remediate else "0"
+        )
+        mgr = ElasticTrainingRendezvousManager(TRAIN)
+        mgr.update_rdzv_params(workers, workers, waiting_timeout=10)
+        for r in range(workers):
+            mgr.join_rendezvous(r, 1)
+        mgr.get_comm_world(0)
+        coord = RescaleCoordinator(rdzv_managers={TRAIN: mgr})
+        coord.set_batch_config(16, 4)
+        coord.note_step(5)
+        for r in range(workers):
+            coord.set_capable(r)
+        det = StragglerDetector(
+            speed_monitor=SpeedMonitor(), window=16, ratio=2.0,
+            sustain=2, evict_after=1e9, evict_enabled=False,
+        )
+        policy = RemediationPolicy(
+            straggler_detector=det, rdzv_managers={TRAIN: mgr},
+            rescale_coordinator=coord,
+        )
+        sim_time, quarantined_at = 0.0, None
+        for round_ in range(rounds):
+            degraded = degrade_from <= round_ < degrade_until
+            for w in range(workers):
+                det.note_probe(w, dict(
+                    probe_bad if w == 0 and degraded else probe_ok
+                ))
+            det.tick()
+            policy.tick(now=float(round_))
+            world = mgr.current_world()
+            if quarantined_at is None and 0 not in world:
+                quarantined_at = round_
+                plan_id = policy.node_state(0)["plan_id"]
+                for r in sorted(world):
+                    coord.apply_ack(plan_id, r, ok=True)
+            if (
+                policy.state(0) == STATE_PROBATION
+                and 0 not in world
+            ):
+                # gate lifted: the parked node's next join poll regrows
+                mgr.join_rendezvous(0, 1)
+                coord.on_node_joined(0, 1, TRAIN)
+            sim_time += slow_s if (0 in world and degraded) else fast_s
+        actions = dict(policy._actions)
+        flaps = (
+            max(0, actions.get("quarantine", 0) - 1)
+            + actions.get("revert", 0)
+        )
+        return {
+            "steps_per_s": rounds / sim_time,
+            "quarantined_at": quarantined_at,
+            "regrown": len(mgr.current_world()) == workers,
+            "flaps": flaps,
+        }
+
+    prev = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    try:
+        auto = arm(remediate=True)
+        detect_only = arm(remediate=False)
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        os.environ.pop("DLROVER_TPU_REMEDIATION", None)
+
+    out = {
+        "steps_per_s_auto": round(auto["steps_per_s"], 3),
+        "steps_per_s_detect_only": round(
+            detect_only["steps_per_s"], 3
+        ),
+        "remediation_goodput_uplift_pct": round(
+            100.0 * (auto["steps_per_s"]
+                     / detect_only["steps_per_s"] - 1.0), 1
+        ),
+        "flaps": auto["flaps"],
+        "regrown_to_full_world": auto["regrown"],
+    }
+    if auto["quarantined_at"] is not None:
+        out["action_latency_ticks"] = (
+            auto["quarantined_at"] - degrade_from
+        )
+    out["protocol"] = (
+        f"{workers} synthetic workers x {rounds} policy ticks, worker "
+        f"0 link-degraded ticks [{degrade_from},{degrade_until}); "
+        f"step model {slow_s}s degraded-in-world / {fast_s}s "
+        "otherwise; auto arm = RemediationPolicy (sustain=2, "
+        "cooldown=0), detect-only arm = DLROVER_TPU_REMEDIATION=0"
+    )
+    log(f"bench[remediation]: {out}")
+    return out
+
+
 def section_dtlint():
     """Static-analysis wall time, cold vs cached: ``tools.dtlint`` over
     the whole package with ``--no-cache`` (every file parsed, all 12
@@ -1993,11 +2130,11 @@ def main():
     # budget guard sheds the tail sections, not the headline.
     default_sections = (
         "small,large,llama,longctx,goodput,ckpt_io,ckpt_dedup,"
-        "opt_shard,rescale,reshape,preempt,straggler,master_scale,"
-        "data_plane,medium,dtlint"
+        "opt_shard,rescale,reshape,preempt,straggler,remediation,"
+        "master_scale,data_plane,medium,dtlint"
         if on_tpu else
         "small,goodput,ckpt_io,ckpt_dedup,opt_shard,rescale,reshape,"
-        "preempt,straggler,master_scale,data_plane,dtlint"
+        "preempt,straggler,remediation,master_scale,data_plane,dtlint"
     )
     sections = os.getenv(
         "DLROVER_TPU_BENCH_SECTIONS", default_sections
@@ -2045,6 +2182,8 @@ def main():
                 extra["preempt"] = section_preempt()
             elif name == "straggler":
                 extra["straggler"] = section_straggler()
+            elif name == "remediation":
+                extra["remediation"] = section_remediation()
             elif name == "master_scale":
                 extra["master_scale"] = section_master_scale()
             elif name == "data_plane":

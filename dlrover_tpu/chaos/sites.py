@@ -60,6 +60,11 @@ class ChaosSite:
     #: ShardLeaseService.tick expiry sweep: force-expire a live lease
     #: as if its TTL lapsed (whole-lease re-dispatch), detail = lease id.
     SHARD_LEASE_EXPIRE = "shard.lease.expire"
+    #: RemediationPolicy quarantine action, after the pre-flight and
+    #: before the world is touched (deny: skip the action this tick,
+    #: exercising the hold/backoff path; delay: sleep ``delay_s``),
+    #: detail = "node{rank}".
+    REMEDIATION_ACT = "remediation.act"
     #: Reserved for unit drills of the injector mechanics themselves
     #: (schedules, journaling): never instrumented in product code.
     TEST_PROBE = "test.probe"

@@ -495,6 +495,46 @@ STRAGGLER_EVICT_AFTER = ENV.float(
     "Seconds a classified straggler may persist before the eviction "
     "recommendation (or eviction, if enabled) fires.")
 
+# ---------------- automatic straggler remediation ----------------
+REMEDIATION = ENV.bool(
+    "DLROVER_TPU_REMEDIATION", True,
+    "Drive straggler verdicts through the automatic remediation policy "
+    "(master/remediation.py): quarantine via in-place shrink, probation "
+    "regrow on probe recovery, permanent eviction after repeated "
+    "probation failures. Off: verdicts stay observe-only (PR-10 "
+    "behavior).")
+REMEDIATION_SUSTAIN_TICKS = ENV.int(
+    "DLROVER_TPU_REMEDIATION_SUSTAIN_TICKS", 3,
+    "Policy ticks a detector verdict must persist (SUSPECT state) "
+    "before quarantine — hysteresis on top of the detector's own "
+    "sustain, so a flapping verdict never moves the world.")
+REMEDIATION_COOLDOWN_S = ENV.float(
+    "DLROVER_TPU_REMEDIATION_COOLDOWN_S", 30.0,
+    "Minimum seconds between remediation actions, fleet-wide. Bounds "
+    "the world-change rate no matter how many nodes degrade at once.")
+REMEDIATION_MAX_CONCURRENT = ENV.int(
+    "DLROVER_TPU_REMEDIATION_MAX_CONCURRENT", 1,
+    "Maximum nodes simultaneously quarantined or on probation. A wider "
+    "outage than this is a fleet problem, not a straggler problem — "
+    "the policy holds instead of shrinking the job away.")
+REMEDIATION_MIN_WORLD = ENV.int(
+    "DLROVER_TPU_REMEDIATION_MIN_WORLD", 2,
+    "Never quarantine below this many nodes (on top of the rescale "
+    "plane's own survivor-quorum check).")
+REMEDIATION_PROBATION_S = ENV.float(
+    "DLROVER_TPU_REMEDIATION_PROBATION_S", 60.0,
+    "Seconds a recovered node must stay clean after regrow before its "
+    "record clears back to HEALTHY.")
+REMEDIATION_BACKOFF_S = ENV.float(
+    "DLROVER_TPU_REMEDIATION_BACKOFF_S", 60.0,
+    "Base backoff after a nacked/declined quarantine or a failed "
+    "probation, doubling per failure, before the node is eligible for "
+    "another action.")
+REMEDIATION_PROBATION_FAILS = ENV.int(
+    "DLROVER_TPU_REMEDIATION_PROBATION_FAILS", 2,
+    "Probation failures (verdict returning after a regrow) before the "
+    "node is permanently evicted through the node-manager path.")
+
 # ---------------- fault injection / debug ----------------
 CHAOS = ENV.str(
     "DLROVER_TPU_CHAOS", "",

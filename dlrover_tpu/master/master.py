@@ -36,6 +36,7 @@ from dlrover_tpu.master.rendezvous import (
     ElasticTrainingRendezvousManager,
 )
 from dlrover_tpu.master.preempt import PreemptionCoordinator
+from dlrover_tpu.master.remediation import RemediationPolicy
 from dlrover_tpu.master.rescale import RescaleCoordinator
 from dlrover_tpu.master.servicer import MasterServicer, create_master_service
 from dlrover_tpu.master.shard.lease_service import ShardLeaseService
@@ -155,6 +156,22 @@ class JobMaster:
         self.mutation_locks = MutationLocks()
         if self.state_store is not None:
             self.state_store.quiesce = self.mutation_locks.all
+        # Automatic straggler remediation: the node-monitor loop ticks
+        # the policy right after the detector; a sustained verdict
+        # becomes a journaled quarantine (in-place shrink), probe
+        # recovery a probation regrow, chronic failure an eviction.
+        self.remediation = RemediationPolicy(
+            straggler_detector=self.straggler_detector,
+            rdzv_managers=self.rdzv_managers,
+            rescale_coordinator=self.rescale,
+            task_manager=self.task_manager,
+            shard_lease=self.shard_lease,
+            speed_monitor=self.speed_monitor,
+            state_store=self.state_store,
+            mutation_locks=self.mutation_locks,
+            evict_cb=self._evict_node,
+        )
+        self.observability.attach(remediation=self.remediation)
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             kv_store=self.kv_store,
@@ -169,6 +186,7 @@ class JobMaster:
             preempt_coordinator=self.preempt,
             mutation_locks=self.mutation_locks,
             shard_lease=self.shard_lease,
+            remediation_policy=self.remediation,
         )
         self._server = create_master_service(port, self.servicer)
         self.port = self._server.port
@@ -238,6 +256,7 @@ class JobMaster:
             "rescale": self.rescale.checkpoint(),
             "preempt": self.preempt.checkpoint(),
             "shard_lease": self.shard_lease.checkpoint(),
+            "remediation": self.remediation.checkpoint(),
         }
 
     def _recover_state(self):
@@ -272,6 +291,7 @@ class JobMaster:
                 self.rescale.restore(state.get("rescale", {}))
                 self.preempt.restore(state.get("preempt", {}))
                 self.shard_lease.restore(state.get("shard_lease", {}))
+                self.remediation.restore(state.get("remediation", {}))
             for rec in records:
                 try:
                     kind = rec[0]
@@ -313,6 +333,9 @@ class JobMaster:
                     elif kind == "preempt":
                         _, payload, ts = rec
                         self.preempt.replay(payload)
+                    elif kind == "remediate":
+                        _, payload, ts = rec
+                        self.remediation.replay(payload)
                     elif kind == "lease":
                         _, req_id, payload, ts = rec
                         resp = self.shard_lease.replay(payload)
@@ -425,6 +448,7 @@ class JobMaster:
                 self.preempt.tick()
                 self.shard_lease.tick()
                 self.straggler_detector.tick()
+                self.remediation.tick()
                 if self.state_store is not None:
                     self.state_store.maybe_snapshot(self._collect_state)
                 if not self.job_manager.all_nodes():
@@ -471,6 +495,10 @@ class JobMaster:
         self.metric_collector.remove_node(node_id)
         # An announced departure must not later read as a false alarm.
         self.preempt.on_node_removed(node_id)
+        # Drop (or confirm, for the policy's own evictions) the node's
+        # remediation record so an unrelated eviction never leaves a
+        # stale join gate behind.
+        self.remediation.on_node_evicted(node_id)
         if node_id in old_world:
             # Survivors of the shrunken world may transition in place
             # instead of restarting (no-op during journal replay and

@@ -44,7 +44,7 @@ import bisect
 import statistics
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.lockdep import instrumented_lock
@@ -392,8 +392,16 @@ class StragglerDetector:
                 )
                 try:
                     self._evict_cb(wid, f"straggler:{kind}")
-                except Exception:
+                except Exception as e:
+                    # A broken remediation path must be visible, not
+                    # swallowed: the event is durable (journaled) and
+                    # the goodput ledger notes it on the open incident.
                     logger.exception("straggler eviction failed")
+                    emit(
+                        EventKind.REMEDIATION_FAILED, _node_id=wid,
+                        _role="master", action="evict", kind=kind,
+                        error=f"{type(e).__name__}: {e}",
+                    )
             else:
                 logger.warning(
                     "straggler eviction recommended for worker %s "
@@ -407,6 +415,21 @@ class StragglerDetector:
         with self._lock:
             return {
                 wid: p.flagged
+                for wid, p in self._profiles.items()
+                if p.flagged is not None
+            }
+
+    def straggler_details(self) -> Dict[int, Dict[str, Any]]:
+        """Flagged workers with their detection stamps — the remediation
+        policy's input table (kind + when first detected, so quarantine
+        records can book detect→act latency)."""
+        with self._lock:
+            return {
+                wid: {
+                    "kind": p.flagged,
+                    "since_ts": p.since_ts,
+                    "detect_ts": p.detect_ts,
+                }
                 for wid, p in self._profiles.items()
                 if p.flagged is not None
             }

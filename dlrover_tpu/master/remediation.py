@@ -1,0 +1,667 @@
+"""Master-side automatic straggler remediation: close the detect loop.
+
+PR 10 classifies stragglers (compute/input/link) and PR 16 made every
+parallelism mode elastic, but acting on a verdict stayed log-only: a
+chronically degraded node bled goodput forever unless an operator set
+``DLROVER_TPU_STRAGGLER_EVICT`` and accepted a blunt permanent eviction.
+This policy drives the full loop autonomously —
+
+    HEALTHY -> SUSPECT -> QUARANTINED -> PROBATION -> HEALTHY | EVICTED
+
+- a sustained :class:`StragglerDetector` verdict makes the node
+  SUSPECT; after ``REMEDIATION_SUSTAIN_TICKS`` policy ticks with the
+  verdict still standing (hysteresis on top of the detector's own
+  sustain), the node is QUARANTINED: dropped from the rendezvous and the
+  survivors handed an in-place shrink plan through the
+  :class:`RescaleCoordinator` (composing with the PR-16 reshape specs,
+  so evicting a TP member reshapes rather than restarts);
+- a quarantined node is *parked*, not killed: its agent keeps
+  heartbeating and probing, and the servicer's join gate keeps it out of
+  the training rendezvous. When its probes recover (the detector clears
+  the flag), the node enters PROBATION: the gate lifts and its next join
+  poll regrows the world through the ordinary grow path;
+- a clean probation window clears the node back to HEALTHY; a node
+  whose verdict returns during probation fails it — once back to
+  quarantine with backoff, twice and it is permanently EVICTED through
+  the node-manager path;
+- the action path degrades gracefully: a nacked or declined shrink plan
+  reverts the node to SUSPECT with exponential backoff — never a crash,
+  never a stuck state. Safety rails bound the blast radius: a cooldown
+  between actions, a max-concurrent-remediations cap, and a min-world
+  floor (plus the rescale quorum pre-flight) so the policy can never
+  shrink below quorum or flap the fleet.
+
+Durability: detection hysteresis is re-derived live from telemetry, but
+every *acted* transition (quarantine, revert, probation, probation
+fail, clear, evicted) is an apply-then-log ``("remediate", payload,
+ts)`` WAL record — a failed-over master reproduces pending quarantines
+and in-flight probations exactly once instead of re-shrinking a world
+that already shrank. The goodput ledger books each action as a
+persistent ``remediation:<kind>`` incident with detect/act/recover
+stamps so the credit for acting is measurable per node.
+"""
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from dlrover_tpu.chaos.injector import fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.lockdep import instrumented_lock
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import EventKind, emit
+
+STATE_SUSPECT = "suspect"
+STATE_QUARANTINED = "quarantined"
+STATE_PROBATION = "probation"
+STATE_EVICTED = "evicted"
+
+#: States that keep a node out of the training rendezvous (the
+#: servicer's join gate): quarantined nodes park until probation,
+#: evicted nodes park forever.
+_GATED_STATES = (STATE_QUARANTINED, STATE_EVICTED)
+
+
+def _new_record(kind: str, now: float, detect_ts: float,
+                since_ts: float) -> Dict[str, Any]:
+    return {
+        "state": STATE_SUSPECT,
+        "kind": kind,
+        "streak": 1,
+        "since_ts": float(since_ts),
+        "detect_ts": float(detect_ts),
+        "act_ts": 0.0,
+        "plan_id": -1,
+        "fails": 0,
+        "backoff_until": 0.0,
+        "probation_until": 0.0,
+        "evidence": "",
+        "first_seen_ts": float(now),
+    }
+
+
+class RemediationPolicy:
+    #: dtlint DT009: the per-node state table and the action rate
+    #: limiter move as one unit under the policy lock; the counters are
+    #: exporter bookkeeping folded in the same critical sections.
+    GUARDED_BY = {
+        "_nodes": "master.remediation",
+        "_last_action_ts": "master.remediation",
+        "_actions": "master.remediation",
+    }
+
+    """Tick-driven state machine turning straggler verdicts into
+    journaled quarantine / regrow / evict actions.
+
+    Wiring: the master's node-monitor loop calls :meth:`tick` right
+    after ``StragglerDetector.tick`` (the policy polls the detector's
+    verdict table — no callback plumbing, so the two evolve
+    independently); the servicer's ``_join_rendezvous`` asks
+    :meth:`gated` before admitting a node to the training rendezvous;
+    ``JobMaster._apply_evict`` calls :meth:`on_node_evicted` so an
+    eviction from any path clears (or confirms) the node's record.
+    """
+
+    def __init__(
+        self,
+        straggler_detector=None,
+        rdzv_managers: Optional[Dict[str, Any]] = None,
+        rescale_coordinator=None,
+        task_manager=None,
+        shard_lease=None,
+        speed_monitor=None,
+        state_store=None,
+        mutation_locks=None,
+        evict_cb: Optional[Callable[[int, str], None]] = None,
+    ):
+        self._lock = instrumented_lock("master.remediation")
+        self._detector = straggler_detector
+        self._rdzv_managers = rdzv_managers or {}
+        self._rescale = rescale_coordinator
+        self._task_manager = task_manager
+        self._shard_lease = shard_lease
+        self._speed_monitor = speed_monitor
+        self._store = state_store
+        self._mutation_locks = mutation_locks
+        self._evict_cb = evict_cb
+        # node_rank -> record (see _new_record)
+        self._nodes: Dict[int, Dict[str, Any]] = {}
+        self._last_action_ts = 0.0
+        # action name -> count, for the exporter counter.
+        self._actions: Dict[str, int] = {}
+
+    # ---------------- journal plumbing ----------------
+    @property
+    def _replaying(self) -> bool:
+        return self._store is not None and self._store.replaying
+
+    def _journal(self, payload: Dict[str, Any]):
+        if self._store is not None and not self._store.replaying:
+            self._store.append(("remediate", payload, time.time()))
+
+    # ---------------- queries ----------------
+    def gated(self, node_rank: int) -> bool:
+        """True while the node must stay out of the training rendezvous
+        (quarantined or permanently evicted). The servicer's join gate:
+        without it a quarantined node's agent — alive on purpose — would
+        rejoin and instantly regrow the world the policy just shrank."""
+        with self._lock:
+            rec = self._nodes.get(int(node_rank))
+            return rec is not None and rec["state"] in _GATED_STATES
+
+    def state(self, node_rank: int) -> Optional[str]:
+        with self._lock:
+            rec = self._nodes.get(int(node_rank))
+            return rec["state"] if rec is not None else None
+
+    def node_state(self, node_rank: int) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = self._nodes.get(int(node_rank))
+            return dict(rec) if rec is not None else None
+
+    def states(self) -> Dict[int, str]:
+        with self._lock:
+            return {n: rec["state"] for n, rec in self._nodes.items()}
+
+    # ---------------- lifecycle hooks ----------------
+    def on_node_evicted(self, node_rank: int):
+        """An eviction landed through any path (heartbeat timeout, agent
+        failure report replay, or this policy's own evict action): drop
+        the node's record unless the policy itself marked it EVICTED —
+        a node evicted for unrelated reasons may legitimately come back
+        and rejoin, so it must not stay gated. Replay-pure (reached from
+        the journaled ``("evict", ...)`` record)."""
+        with self._lock:
+            rec = self._nodes.get(int(node_rank))
+            if rec is not None and rec["state"] != STATE_EVICTED:
+                del self._nodes[int(node_rank)]
+
+    # ---------------- the tick ----------------
+    def tick(self, now: Optional[float] = None):
+        """One policy pass (master node-monitor loop, right after the
+        detector tick). Folds the detector's verdict table into the
+        state table, settles in-flight plans, and fires at most one
+        action per tick — collect under the lock, act outside it."""
+        if self._replaying or not env_utils.REMEDIATION.get():
+            return
+        now = now if now is not None else time.time()
+        flagged = self._straggler_details()
+        quarantine: Optional[tuple] = None
+        evict: Optional[tuple] = None
+        fails: List[tuple] = []
+        probations: List[tuple] = []
+        clears: List[tuple] = []
+        plan_polls: List[tuple] = []
+        with self._lock:
+            for wid, info in flagged.items():
+                rec = self._nodes.get(wid)
+                if rec is None:
+                    self._nodes[wid] = _new_record(
+                        info["kind"], now,
+                        info.get("detect_ts") or now,
+                        info.get("since_ts") or now,
+                    )
+                elif rec["state"] == STATE_SUSPECT:
+                    rec["streak"] += 1
+                    rec["kind"] = info["kind"]
+                elif rec["state"] == STATE_PROBATION:
+                    # The verdict came back while on probation: failed.
+                    rec["fails"] += 1
+                    rec["kind"] = info["kind"]
+                    if rec["fails"] >= env_utils.REMEDIATION_PROBATION_FAILS.get():
+                        evict = (wid, rec["kind"], rec["fails"])
+                    else:
+                        backoff = (
+                            env_utils.REMEDIATION_BACKOFF_S.get()
+                            * (2 ** (rec["fails"] - 1))
+                        )
+                        rec["state"] = STATE_SUSPECT
+                        # Re-arm fully sustained: after the backoff the
+                        # next eligible tick may re-quarantine at once.
+                        rec["streak"] = env_utils.REMEDIATION_SUSTAIN_TICKS.get()
+                        rec["backoff_until"] = now + backoff
+                        fails.append((wid, rec["kind"], rec["fails"],
+                                      rec["backoff_until"]))
+            for wid in sorted(self._nodes):
+                rec = self._nodes[wid]
+                if wid in flagged:
+                    pass
+                elif rec["state"] == STATE_SUSPECT:
+                    # Recovered before any action: hysteresis absorbed
+                    # the flap. Nothing was acted, nothing to journal.
+                    del self._nodes[wid]
+                    continue
+                elif rec["state"] == STATE_QUARANTINED and rec["plan_id"] < 0:
+                    # Probes recovered while parked: start probation.
+                    until = now + env_utils.REMEDIATION_PROBATION_S.get()
+                    rec["state"] = STATE_PROBATION
+                    rec["probation_until"] = until
+                    probations.append((wid, rec["kind"], until))
+                    continue
+                elif (
+                    rec["state"] == STATE_PROBATION
+                    and now >= rec["probation_until"]
+                ):
+                    clears.append((wid, rec["kind"]))
+                    del self._nodes[wid]
+                    continue
+                if rec["state"] == STATE_QUARANTINED and rec["plan_id"] >= 0:
+                    plan_polls.append((wid, rec["plan_id"]))
+            if evict is None:
+                quarantine = self._pick_quarantine(now)
+        for wid, plan_id in plan_polls:
+            self._settle_plan(wid, plan_id, now)
+        for wid, kind, n_fails, until in fails:
+            self._journal({
+                "rec": "fail", "node": wid, "kind": kind,
+                "fails": n_fails, "backoff_until": until,
+            })
+            logger.warning(
+                "remediation: node %s failed probation (%s returned, "
+                "fail %d); re-suspect with backoff until %.0f",
+                wid, kind, n_fails, until,
+            )
+            emit(
+                EventKind.REMEDIATION_REVERT, _node_id=wid, _role="master",
+                kind=kind, reason="probation-failed", fails=n_fails,
+                backoff_until=until,
+            )
+            self._count("probation_fail")
+        for wid, kind, until in probations:
+            self._journal({
+                "rec": "probation", "node": wid, "kind": kind,
+                "until": until,
+            })
+            logger.info(
+                "remediation: node %s probes recovered; probation until "
+                "%.0f — join gate lifted, regrow rides the join path",
+                wid, until,
+            )
+            emit(
+                EventKind.REMEDIATION_PROBATION, _node_id=wid,
+                _role="master", kind=kind, until=until,
+            )
+            self._count("probation")
+        for wid, kind in clears:
+            self._journal({"rec": "clear", "node": wid})
+            logger.info(
+                "remediation: node %s finished probation clean; healthy",
+                wid,
+            )
+            emit(
+                EventKind.REMEDIATION_CLEAR, _node_id=wid, _role="master",
+                kind=kind,
+            )
+            self._count("clear")
+        if evict is not None:
+            self._do_evict(*evict)
+        elif quarantine is not None:
+            self._do_quarantine(*quarantine, now=now)
+
+    def _straggler_details(self) -> Dict[int, Dict[str, Any]]:
+        if self._detector is None:
+            return {}
+        details = getattr(self._detector, "straggler_details", None)
+        if details is not None:
+            return details()
+        return {
+            wid: {"kind": kind}
+            for wid, kind in self._detector.stragglers().items()
+        }
+
+    # ---------------- quarantine ----------------
+    def _pick_quarantine(self, now: float) -> Optional[tuple]:  # dtlint: holds(master.remediation)
+        """Lowest eligible SUSPECT rank, or None. Lock held. The rails:
+        policy hysteresis (sustain ticks), per-node backoff, the global
+        action cooldown, and the concurrent-remediations cap. World
+        size / quorum are checked at act time (outside the lock)."""
+        if now - self._last_action_ts < env_utils.REMEDIATION_COOLDOWN_S.get():
+            return None
+        active = sum(
+            1 for rec in self._nodes.values()
+            if rec["state"] in (STATE_QUARANTINED, STATE_PROBATION)
+        )
+        if active >= env_utils.REMEDIATION_MAX_CONCURRENT.get():
+            return None
+        sustain = env_utils.REMEDIATION_SUSTAIN_TICKS.get()
+        for wid in sorted(self._nodes):
+            rec = self._nodes[wid]
+            if (
+                rec["state"] == STATE_SUSPECT
+                and rec["streak"] >= sustain
+                and now >= rec["backoff_until"]
+            ):
+                return (wid, rec["kind"], rec["detect_ts"], rec["since_ts"])
+        return None
+
+    def _do_quarantine(self, wid: int, kind: str, detect_ts: float,
+                       since_ts: float, now: float):
+        """The action: drop the node from the rendezvous and hand the
+        survivors an in-place shrink plan. Pre-flighted — the world is
+        only touched when the coordinator confirms it would plan —
+        because an issued-then-declined shrink forces the full-restart
+        fallback this policy exists to avoid."""
+        training = self._rdzv_managers.get(RendezvousName.TRAINING)
+        old_world = training.current_world() if training is not None else {}
+        if wid not in old_world:
+            # Not in the active world (mid-restart, already gone):
+            # nothing to shrink; the record stays SUSPECT and the
+            # verdict re-evaluates next tick.
+            return
+        floor = env_utils.REMEDIATION_MIN_WORLD.get()
+        if len(old_world) - 1 < floor:
+            logger.warning(
+                "remediation: node %s is a sustained %s straggler but "
+                "shrinking %d -> %d would breach the min-world floor "
+                "(%d); holding", wid, kind, len(old_world),
+                len(old_world) - 1, floor,
+            )
+            return
+        if self._rescale is not None:
+            ok, why = self._rescale.can_plan_shrink(wid, old_world)
+            if not ok:
+                logger.warning(
+                    "remediation: shrink for node %s not plannable (%s); "
+                    "holding in SUSPECT", wid, why,
+                )
+                return
+        chaos = fault_hit(ChaosSite.REMEDIATION_ACT, detail=f"node{wid}")
+        if chaos is not None:
+            if chaos.kind == "delay":
+                time.sleep(chaos.delay_s)
+            elif chaos.kind in ("deny", "drop"):
+                logger.warning(
+                    "remediation: chaos denied the quarantine action "
+                    "for node %s this tick", wid,
+                )
+                return
+        plan = None
+        locks = self._mutation_locks
+        if locks is not None:
+            # Same span as _evict_node: the apply mutates tasks, leases,
+            # rendezvous and the rescale plane, so it serializes against
+            # concurrent RPC mutations in journal order.
+            with locks.all():
+                plan = self._apply_quarantine(wid, old_world)
+        else:
+            plan = self._apply_quarantine(wid, old_world)
+        if plan is None:
+            # The coordinator declined after the pre-flight (raced
+            # config change): the world already shrank, the stale-round
+            # restart fallback is in charge, and the node reverts to
+            # SUSPECT with backoff so the fleet reforms with it.
+            self._revert(wid, kind, now, reason="plan-declined")
+            return
+        with self._lock:
+            rec = self._nodes.get(wid)
+            if rec is None:
+                return
+            rec["state"] = STATE_QUARANTINED
+            rec["plan_id"] = plan.plan_id
+            rec["act_ts"] = now
+            self._last_action_ts = now
+        self._journal({
+            "rec": "quarantine", "node": wid, "kind": kind,
+            "plan_id": plan.plan_id, "detect_ts": detect_ts,
+            "since_ts": since_ts, "act_ts": now,
+        })
+        logger.warning(
+            "remediation: quarantined sustained %s straggler node %s "
+            "(plan %s, world %s -> %s); parked pending probe recovery",
+            kind, wid, plan.plan_id, sorted(old_world),
+            sorted(plan.new_world),
+        )
+        emit(
+            EventKind.REMEDIATION_QUARANTINE, _node_id=wid, _role="master",
+            kind=kind, plan_id=plan.plan_id, detect_ts=detect_ts,
+            since_ts=since_ts, old_world=sorted(old_world),
+            new_world=sorted(plan.new_world),
+        )
+        self._count("quarantine")
+
+    def _apply_quarantine(self, wid: int, old_world: Dict[int, int]):
+        """Drop the node everywhere the eviction path does — except the
+        node registry and the straggler profiles: the agent stays alive
+        (still heartbeats, still probes) and the detector must keep the
+        frozen-baseline profile to see the recovery."""
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(wid)
+        if self._task_manager is not None:
+            self._task_manager.recover_worker_tasks(wid)
+        if self._shard_lease is not None:
+            # Leased shards re-entered todo just now; drop the lease
+            # bookkeeping so expiry cannot requeue them twice.
+            self._shard_lease.drop_agent(wid)
+        if self._speed_monitor is not None:
+            self._speed_monitor.remove_worker(wid)
+        if self._rescale is None:
+            return None
+        return self._rescale.on_node_removed(wid, old_world)
+
+    # ---------------- plan settlement ----------------
+    def _settle_plan(self, wid: int, plan_id: int, now: float):
+        """Poll the in-flight shrink plan: complete confirms the
+        quarantine (the node waits parked for probe recovery); aborted
+        — a survivor nacked or the apply timed out — reverts the node
+        to SUSPECT with backoff. Idempotent by construction: a failed-
+        over master that lost the revert record re-derives it from the
+        replayed plan state on its first tick."""
+        if self._rescale is None:
+            return
+        status = self._rescale.plan_status(plan_id)
+        if status == "complete":
+            with self._lock:
+                rec = self._nodes.get(wid)
+                if rec is not None and rec["plan_id"] == plan_id:
+                    rec["plan_id"] = -1
+        elif status == "aborted" or status is None:
+            kind = ""
+            with self._lock:
+                rec = self._nodes.get(wid)
+                if rec is None or rec["plan_id"] != plan_id:
+                    return
+                kind = rec["kind"]
+            self._revert(wid, kind, now, reason=f"plan-{plan_id}-aborted")
+
+    def _revert(self, wid: int, kind: str, now: float, reason: str):
+        """Nacked/declined action -> SUSPECT with exponential backoff.
+        Never a crash, never a stuck state: the join gate lifts (the
+        node may reform with the restarting fleet) and the verdict gets
+        another shot only after the backoff."""
+        with self._lock:
+            rec = self._nodes.get(wid)
+            if rec is None:
+                return
+            rec["fails"] = rec.get("fails", 0)
+            backoff = (
+                env_utils.REMEDIATION_BACKOFF_S.get()
+                * (2 ** min(rec["fails"], 4))
+            )
+            rec["state"] = STATE_SUSPECT
+            rec["plan_id"] = -1
+            rec["streak"] = 0
+            rec["backoff_until"] = now + backoff
+            until = rec["backoff_until"]
+        self._journal({
+            "rec": "revert", "node": wid, "kind": kind,
+            "reason": reason, "backoff_until": until,
+        })
+        logger.warning(
+            "remediation: quarantine of node %s reverted (%s); SUSPECT "
+            "with backoff until %.0f", wid, reason, until,
+        )
+        emit(
+            EventKind.REMEDIATION_REVERT, _node_id=wid, _role="master",
+            kind=kind, reason=reason, backoff_until=until,
+        )
+        self._count("revert")
+
+    # ---------------- permanent eviction ----------------
+    def _do_evict(self, wid: int, kind: str, n_fails: int):
+        """Second probation failure: the node is chronically bad —
+        evict permanently through the node-manager path (the journaled
+        ``("evict", ...)`` record). The eviction drops our record
+        (:meth:`on_node_evicted`); the ``evicted`` record recreates it
+        as EVICTED so the join gate outlives the node registry."""
+        reason = f"remediation:{kind} (failed probation x{n_fails})"
+        if self._evict_cb is not None:
+            try:
+                self._evict_cb(wid, reason)
+            except Exception as e:
+                logger.exception(
+                    "remediation: eviction of node %s failed", wid
+                )
+                emit(
+                    EventKind.REMEDIATION_FAILED, _node_id=wid,
+                    _role="master", action="evict", kind=kind,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                self._count("evict_failed")
+                # Not evicted: fall back to another quarantine round
+                # rather than a stuck EVICTED-but-present state.
+                with self._lock:
+                    rec = self._nodes.get(wid)
+                    if rec is not None:
+                        rec["state"] = STATE_SUSPECT
+                        rec["streak"] = 0
+                return
+        with self._lock:
+            rec = self._nodes.get(wid)
+            if rec is None:
+                rec = self._nodes[wid] = _new_record(
+                    kind, 0.0, 0.0, 0.0
+                )
+            rec["state"] = STATE_EVICTED
+            rec["kind"] = kind
+            rec["fails"] = n_fails
+        self._journal({
+            "rec": "evicted", "node": wid, "kind": kind, "fails": n_fails,
+        })
+        logger.error(
+            "remediation: node %s permanently evicted after %d failed "
+            "probations (%s)", wid, n_fails, kind,
+        )
+        emit(
+            EventKind.REMEDIATION_EVICT, _node_id=wid, _role="master",
+            kind=kind, fails=n_fails,
+        )
+        self._count("evict")
+
+    def _count(self, action: str):
+        with self._lock:
+            self._actions[action] = self._actions.get(action, 0) + 1
+
+    # ---------------- durability ----------------
+    def checkpoint(self) -> dict:
+        with self._lock:
+            return {
+                "nodes": {
+                    str(wid): dict(rec)
+                    for wid, rec in self._nodes.items()
+                },
+                "last_action_ts": self._last_action_ts,
+                "actions": dict(self._actions),
+            }
+
+    def restore(self, state: dict):
+        if not state:
+            return
+        with self._lock:
+            for wid, rec in state.get("nodes", {}).items():
+                self._nodes[int(wid)] = dict(rec)
+            self._last_action_ts = max(
+                self._last_action_ts,
+                float(state.get("last_action_ts", 0.0)),
+            )
+            for action, n in state.get("actions", {}).items():
+                self._actions[action] = max(
+                    self._actions.get(action, 0), int(n)
+                )
+
+    def replay(self, payload: Dict[str, Any]):
+        """Re-apply one journaled ``("remediate", payload, ts)`` record.
+
+        Pure bookkeeping — no emits, no rendezvous or rescale side
+        effects (those subsystems replay from their own records): only
+        the policy's state table moves, so a failed-over master holds
+        exactly the pending quarantines/probations it held before.
+        """
+        rec = payload.get("rec")
+        wid = int(payload.get("node", -1))
+        with self._lock:
+            if rec == "quarantine":
+                node = self._nodes.setdefault(
+                    wid, _new_record(payload.get("kind", ""), 0.0, 0.0, 0.0)
+                )
+                node["state"] = STATE_QUARANTINED
+                node["kind"] = payload.get("kind", node["kind"])
+                node["plan_id"] = int(payload.get("plan_id", -1))
+                node["detect_ts"] = float(payload.get("detect_ts", 0.0))
+                node["since_ts"] = float(payload.get("since_ts", 0.0))
+                node["act_ts"] = float(payload.get("act_ts", 0.0))
+                self._last_action_ts = max(
+                    self._last_action_ts, node["act_ts"]
+                )
+            elif rec == "revert":
+                node = self._nodes.get(wid)
+                if node is not None:
+                    node["state"] = STATE_SUSPECT
+                    node["plan_id"] = -1
+                    node["streak"] = 0
+                    node["backoff_until"] = float(
+                        payload.get("backoff_until", 0.0)
+                    )
+            elif rec == "probation":
+                node = self._nodes.get(wid)
+                if node is not None:
+                    node["state"] = STATE_PROBATION
+                    node["plan_id"] = -1
+                    node["probation_until"] = float(
+                        payload.get("until", 0.0)
+                    )
+            elif rec == "fail":
+                node = self._nodes.get(wid)
+                if node is not None:
+                    node["state"] = STATE_SUSPECT
+                    node["fails"] = int(payload.get("fails", 0))
+                    node["streak"] = 0
+                    node["backoff_until"] = float(
+                        payload.get("backoff_until", 0.0)
+                    )
+            elif rec == "clear":
+                self._nodes.pop(wid, None)
+            elif rec == "evicted":
+                node = self._nodes.setdefault(
+                    wid, _new_record(payload.get("kind", ""), 0.0, 0.0, 0.0)
+                )
+                node["state"] = STATE_EVICTED
+                node["kind"] = payload.get("kind", node["kind"])
+                node["fails"] = int(payload.get("fails", 0))
+            else:
+                logger.warning("skipping unknown remediate record %r", rec)
+
+    # ---------------- outputs ----------------
+    def metrics(self) -> List:
+        """Exporter gauges (appended by the ObservabilityPlane)."""
+        with self._lock:
+            by_state_kind: Dict[tuple, int] = {}
+            for rec in self._nodes.values():
+                key = (rec["state"], rec["kind"] or "unknown")
+                by_state_kind[key] = by_state_kind.get(key, 0) + 1
+            actions = dict(self._actions)
+        return [
+            (
+                "dlrover_tpu_remediation", "gauge",
+                "Nodes per remediation-policy state and straggler kind.",
+                [({"state": s, "kind": k}, float(v))
+                 for (s, k), v in sorted(by_state_kind.items())]
+                or [(None, 0.0)],
+            ),
+            (
+                "dlrover_tpu_remediation_actions_total", "counter",
+                "Remediation actions taken since master start.",
+                [({"action": a}, float(v))
+                 for a, v in sorted(actions.items())] or [(None, 0.0)],
+            ),
+        ]

@@ -19,7 +19,7 @@ forgets an issued plan nor re-issues a completed one.
 
 import time
 from dataclasses import asdict
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu.chaos.injector import fault_hit
 from dlrover_tpu.chaos.sites import ChaosSite
@@ -209,6 +209,62 @@ class RescaleCoordinator:
         return self._issue_plan(
             rdzv_name, old_world, survivors, transition="shrink"
         )
+
+    def can_plan_shrink(
+        self, node_rank: int, old_world: Dict[int, int]
+    ) -> Tuple[bool, str]:
+        """Pre-flight for the remediation policy: would
+        :meth:`on_node_removed` issue a plan for this shrink right now?
+
+        Runs the same gates (rescale enabled, membership, survivor
+        quorum, batch config, survivor capability, schedule
+        satisfiability) without touching the rendezvous or issuing
+        anything. The policy must know BEFORE dropping the node — an
+        issued-then-declined shrink falls back to the full restart the
+        quarantine exists to avoid. Returns ``(ok, reason)``.
+        """
+        if self._replaying or not env_utils.RESCALE.get():
+            return False, "rescale disabled"
+        if node_rank not in old_world:
+            return False, f"node {node_rank} not in the active world"
+        survivors = {
+            r: w for r, w in old_world.items() if r != node_rank
+        }
+        if not survivors:
+            return False, "no survivors"
+        quorum = env_utils.RESCALE_MIN_QUORUM.get()
+        if len(survivors) / len(old_world) < quorum:
+            return False, (
+                f"{len(survivors)}/{len(old_world)} survivors below "
+                f"quorum {quorum:.2f}"
+            )
+        with self._lock:
+            global_batch, micro_batch = self._global_batch, self._micro_batch
+            incapable = sorted(set(survivors) - self._capable)
+        if global_batch <= 0:
+            return False, "no batch config reported"
+        if incapable:
+            return False, (
+                f"survivors {incapable} never advertised a live rescale "
+                "engine"
+            )
+        try:
+            derive_accum_schedule(
+                global_batch, micro_batch, sum(survivors.values())
+            )
+        except ValueError as e:
+            return False, f"schedule unsatisfiable ({e})"
+        return True, ""
+
+    def plan_status(self, plan_id: int) -> Optional[str]:
+        """Settlement state of a plan: ``"issued"`` / ``"complete"`` /
+        ``"aborted"``, or ``None`` for an unknown id. The remediation
+        policy polls this each tick to confirm (or revert) a pending
+        quarantine — idempotently, so a failed-over master re-derives
+        the same answer from the replayed plan records."""
+        with self._lock:
+            plan = self._plans.get(int(plan_id))
+            return plan.status if plan is not None else None
 
     def on_node_joined(
         self, node_rank: int, local_world_size: int, rdzv_name: str

@@ -94,6 +94,7 @@ class MasterServicer:
         preempt_coordinator=None,
         mutation_locks=None,
         shard_lease=None,
+        remediation_policy=None,
     ):
         self._rdzv_managers = rdzv_managers
         self._kv_store = kv_store
@@ -106,6 +107,7 @@ class MasterServicer:
         self._observability = observability
         self._rescale = rescale_coordinator
         self._preempt = preempt_coordinator
+        self._remediation = remediation_policy
         if shard_lease is None:
             from dlrover_tpu.master.shard.lease_service import (
                 ShardLeaseService,
@@ -218,6 +220,20 @@ class MasterServicer:
     # ---------------- rendezvous ----------------
     def _join_rendezvous(self, req: m.JoinRendezvous):
         mgr = self._rdzv_managers[req.rdzv_name]
+        if (
+            req.rdzv_name == RendezvousName.TRAINING
+            and self._remediation is not None
+            and self._remediation.gated(req.node_rank)
+        ):
+            # Quarantined (or remediation-evicted) nodes park outside
+            # the training rendezvous: admitting the join would regrow
+            # the world the policy just shrank. The agent's normal
+            # retry loop keeps polling, so the moment probation lifts
+            # the gate this same path triggers the regrow. Keep the
+            # heartbeat — a parked node is alive on purpose.
+            if self._job_manager:
+                self._job_manager.report_heartbeat(req.node_id, time.time())
+            return mgr.current_round()
         active = mgr.current_world()
         round_ = mgr.join_rendezvous(req.node_rank, req.local_world_size)
         if req.rdzv_name == RendezvousName.TRAINING and self._job_manager:

@@ -69,4 +69,10 @@ WAL_RECORDS: Dict[str, Tuple[str, ...]] = {
     # from the live rendezvous world, step-boundary shrink mark,
     # false-alarm cancel); the notice itself replays via its rpc record.
     "preempt": ("PreemptionCoordinator.replay",),
+    # ("remediate", payload, ts) — remediation-policy journal: every
+    # acted transition (quarantine/revert/probation/fail/clear/evicted),
+    # apply-then-log. Detection hysteresis is deliberately NOT journaled
+    # — it re-derives live from telemetry — so replay reproduces exactly
+    # the pending quarantines/probations, never a re-shrink.
+    "remediate": ("RemediationPolicy.replay",),
 }

@@ -82,6 +82,22 @@ class EventKind:
     PREEMPT_NOTICE = "preempt.notice"
     PREEMPT_HANDLED = "preempt.handled"
     PREEMPT_CANCEL = "preempt.cancel"
+    # Automatic straggler remediation (master/remediation.py): a
+    # sustained verdict was acted on — the node quarantined out of the
+    # world via an in-place shrink (detection — opens the
+    # remediation:<kind> incident); its probes recovered and it regrew
+    # on probation (recovery — closes it); probation finished clean; an
+    # action was nacked/declined and reverted to SUSPECT with backoff
+    # (context); or the node failed probation twice and was permanently
+    # evicted (closes the incident). REMEDIATION_FAILED surfaces an
+    # eviction callback that raised — a broken remediation path must be
+    # visible, not swallowed (context).
+    REMEDIATION_QUARANTINE = "remediation.quarantine"
+    REMEDIATION_PROBATION = "remediation.probation"
+    REMEDIATION_CLEAR = "remediation.clear"
+    REMEDIATION_REVERT = "remediation.revert"
+    REMEDIATION_EVICT = "remediation.evict"
+    REMEDIATION_FAILED = "remediation.failed"
 
 
 @dataclass

@@ -72,6 +72,10 @@ class Incident:
     node_id: int = -1
     start_ts: float = 0.0
     detect_ts: Optional[float] = None
+    #: When a remediation action moved the world (quarantine issued) —
+    #: detect->act is the policy's decision latency, act->recover the
+    #: time the node spent parked.
+    act_ts: Optional[float] = None
     recover_ts: Optional[float] = None
     injected: bool = False
     trail: List[str] = field(default_factory=list)
@@ -100,6 +104,10 @@ class Incident:
             "detect_s": (
                 None if self.detect_ts is None
                 else max(0.0, self.detect_ts - self.start_ts)
+            ),
+            "act_s": (
+                None if self.act_ts is None
+                else max(0.0, self.act_ts - self.start_ts)
             ),
             "recover_s": (
                 None if self.recover_ts is None
@@ -160,6 +168,8 @@ class GoodputLedger:
             self._on_straggler_detect(ev)
         elif ev.kind == EventKind.STRAGGLER_RECOVER:
             self._on_straggler_recover(ev)
+        elif ev.kind.startswith("remediation."):
+            self._on_remediation(ev)
         elif ev.kind in _CONTEXT:
             with self._lock:
                 inc = self._open_incident_for(ev.node_id)
@@ -242,9 +252,17 @@ class GoodputLedger:
                 return inc
         return None
 
-    def _open_straggler_for(self, node_id: int) -> Optional[Incident]:  # dtlint: holds(observability.goodput)
+    def _open_straggler_for(self, node_id: int, prefix: str = "straggler:") -> Optional[Incident]:  # dtlint: holds(observability.goodput)
+        """Most recent open persistent incident for the node whose cause
+        matches the prefix. Prefix-scoped on purpose: a node can carry a
+        ``straggler:<kind>`` (detector lifecycle) AND a
+        ``remediation:<kind>`` (policy lifecycle) incident at once, and
+        each side must only ever close its own."""
         for inc in reversed(self._incidents):
-            if inc.open and inc.persistent and inc.node_id == node_id:
+            if (
+                inc.open and inc.persistent and inc.node_id == node_id
+                and inc.cause.startswith(prefix)
+            ):
                 return inc
         return None
 
@@ -274,6 +292,57 @@ class GoodputLedger:
             inc = self._open_straggler_for(ev.node_id)
             if inc is not None:
                 inc.recover_ts = ev.ts
+                inc.trail.append(ev.kind)
+
+    def _on_remediation(self, ev: JobEvent):
+        """Book the remediation policy's lifecycle as a persistent
+        ``remediation:<kind>`` incident with detect/act/recover stamps:
+        start = when the outlier first showed, detect = classification,
+        act = the quarantine action, recover = probation regrow (or the
+        permanent eviction). Persistent — the survivors keep stepping
+        through the whole window, so the span charges the per-cause
+        table, never the downtime union."""
+        kind = ev.args.get("kind", "unknown")
+        with self._lock:
+            inc = self._open_straggler_for(ev.node_id, prefix="remediation:")
+            if ev.kind == EventKind.REMEDIATION_QUARANTINE:
+                self._t0 = min(self._t0, ev.ts)
+                if inc is None:
+                    inc = Incident(
+                        cause=f"remediation:{kind}", node_id=ev.node_id,
+                        start_ts=float(ev.args.get("since_ts") or ev.ts),
+                        detect_ts=float(ev.args.get("detect_ts") or ev.ts),
+                        persistent=True,
+                    )
+                    self._incidents.append(inc)
+                inc.cause = f"remediation:{kind}"
+                inc.act_ts = ev.ts
+                inc.trail.append(ev.kind)
+                inc.evidence = (
+                    f"quarantine plan {ev.args.get('plan_id')}: world "
+                    f"{ev.args.get('old_world')} -> "
+                    f"{ev.args.get('new_world')}"
+                )
+            elif ev.kind in (
+                EventKind.REMEDIATION_PROBATION, EventKind.REMEDIATION_EVICT
+            ):
+                if inc is not None:
+                    inc.recover_ts = ev.ts
+                    inc.trail.append(ev.kind)
+            elif ev.kind == EventKind.REMEDIATION_FAILED:
+                # Satellite of the swallowed-eviction fix: a broken
+                # remediation path notes itself on whichever persistent
+                # incident is carrying the node's story.
+                if inc is None:
+                    inc = self._open_straggler_for(ev.node_id)
+                if inc is not None:
+                    inc.trail.append(ev.kind)
+                    inc.evidence = (
+                        f"remediation {ev.args.get('action', 'action')} "
+                        f"failed: {ev.args.get('error', 'unknown error')}"
+                    )
+            elif inc is not None:
+                # REVERT / CLEAR context on the open incident's trail.
                 inc.trail.append(ev.kind)
 
     def note_step(self, step: int, ts: Optional[float] = None):

@@ -50,6 +50,7 @@ class ObservabilityPlane:
         self._task_manager = None
         self._straggler_detector = None
         self._shard_lease = None
+        self._remediation = None
         # Native histograms: master RPC handle latency per message type
         # (servicer.handle) and state-store WAL write/fsync durations
         # (ROADMAP item 4). Lock-cheap — safe to call on the hot path.
@@ -62,7 +63,7 @@ class ObservabilityPlane:
 
     def attach(self, speed_monitor=None, job_manager=None,
                task_manager=None, straggler_detector=None,
-               shard_lease=None):
+               shard_lease=None, remediation=None):
         """Late-bind the metric sources the exporter reads from."""
         if speed_monitor is not None:
             self._speed_monitor = speed_monitor
@@ -74,6 +75,8 @@ class ObservabilityPlane:
             self._straggler_detector = straggler_detector
         if shard_lease is not None:
             self._shard_lease = shard_lease
+        if remediation is not None:
+            self._remediation = remediation
 
     # ------------- intake -------------
     def ingest_report(self, events: List[JobEvent]):
@@ -286,6 +289,8 @@ class ObservabilityPlane:
             ))
         if self._straggler_detector is not None:
             metrics.extend(self._straggler_detector.metrics())
+        if self._remediation is not None:
+            metrics.extend(self._remediation.metrics())
         if self.rpc_hist.total_count:
             metrics.append((
                 "dlrover_tpu_rpc_handle_seconds", "histogram",

@@ -107,16 +107,25 @@ def render_text(events: List[JobEvent], out=None) -> None:
                 "-" if inc["detect_s"] is None
                 else f"{inc['detect_s']:.1f}s"
             )
+            # Remediation incidents carry a third stamp: when the
+            # policy's quarantine actually moved the world.
+            act = (
+                "" if inc.get("act_s") is None
+                else f"  act={inc['act_s']:.1f}s"
+            )
             print(
                 f"  +{inc['start_ts'] - t0:9.3f}s  node {inc['node_id']} "
-                f" cause={inc['cause']}  detect={detect}  recover={state}"
+                f" cause={inc['cause']}  detect={detect}{act}"
+                f"  recover={state}"
                 f"{'  [injected]' if inc['injected'] else ''}",
                 file=out,
             )
             # Straggler incidents carry the detector's phase/probe
             # evidence (which key degraded, by how much vs baseline);
             # rescale incidents carry the reshape's spec diff and
-            # d2d/snapshot byte split (or the decline reason).
+            # d2d/snapshot byte split (or the decline reason);
+            # remediation incidents carry the quarantine plan and the
+            # old->new world.
             if inc.get("evidence"):
                 print(f"             evidence: {inc['evidence']}", file=out)
 

@@ -63,6 +63,11 @@ class TestElasticRun:
         )
         assert result.returncode == 0, result.stderr[-2000:]
 
+    # Promoted to slow: ~123s of subprocess churn, the single largest
+    # tier-1 cost after the two-node drill; the crash→flash-restore
+    # chain stays covered in-process (test_checkpoint, test_state_store)
+    # and by the shm-restore unit drills.
+    @pytest.mark.slow
     def test_crash_restart_resumes_from_flash_checkpoint(self, tmp_path):
         """The core goodput scenario: every-step MEMORY snapshots, DISK
         persist every 10 steps, crash at step 7. The agent flushes the step-7
@@ -269,6 +274,11 @@ class TestElasticRun:
             master.terminate()
             master.wait(timeout=10)
 
+    # Promoted to slow: ~130s, the largest tier-1 cost; two-node
+    # crash/restore coverage continues in the slow lane and the same
+    # failover machinery is exercised in-process by the WAL-replay and
+    # rescale drills.
+    @pytest.mark.slow
     def test_two_node_flash_checkpoint_crash(self, tmp_path):
         """Multi-node flash checkpoint: both nodes snapshot to their shm
         every step; a crash on node 0 flushes, both agents restart their

@@ -1,0 +1,772 @@
+"""Automatic straggler remediation (ISSUE 17): detect → quarantine →
+in-place shrink → probation regrow, fully journaled.
+
+Covers the :class:`RemediationPolicy` state machine table (hysteresis,
+cooldown, min-world floor, concurrent cap, probation pass/fail/flap),
+the nacked-plan → SUSPECT-with-backoff regression, WAL replay
+reproducing a mid-quarantine failover exactly once, the servicer's
+quarantine join gate, the goodput ledger's ``remediation:<kind>``
+incidents with detect/act/recover stamps, the surfaced (no longer
+swallowed) eviction-callback failure, and — slow-marked — the chaos
+drill: a ``probe.link degrade`` on one node is autonomously
+quarantined, the job shrinks in place, and regrows through the join
+path when the probes recover, with goodput above the detect-only arm.
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu.agent.device_check import LinkProbe
+from dlrover_tpu.chaos.injector import (
+    CHAOS_ENV,
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+)
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.monitor.straggler import StragglerDetector
+from dlrover_tpu.master.remediation import (
+    STATE_EVICTED,
+    STATE_PROBATION,
+    STATE_QUARANTINED,
+    STATE_SUSPECT,
+    RemediationPolicy,
+)
+from dlrover_tpu.master.rescale import PLAN_ABORTED, PLAN_ISSUED
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.state_store import MasterStateStore
+from dlrover_tpu.observability import events as events_mod
+from dlrover_tpu.observability.event_log import EventLog
+from dlrover_tpu.observability.events import EventKind, JobEvent, emit
+from dlrover_tpu.observability.goodput import GoodputLedger
+
+from tests.test_rescale import TRAIN, formed_world, make_coordinator
+
+PROBE_OK = {"h2d_mbps": 800.0, "d2h_mbps": 800.0, "rtt_ms": 1.0}
+
+
+@pytest.fixture(autouse=True)
+def _clean_routing_and_chaos(monkeypatch):
+    monkeypatch.delenv(CHAOS_ENV, raising=False)
+    FaultInjector.reset()
+    events_mod.reset()
+    yield
+    events_mod.reset()
+    FaultInjector.reset()
+
+
+@pytest.fixture(autouse=True)
+def fast_knobs(monkeypatch):
+    """Deterministic policy timing: no cooldown, tight hysteresis. Each
+    test overrides what it exercises."""
+    monkeypatch.setenv("DLROVER_TPU_REMEDIATION_SUSTAIN_TICKS", "2")
+    monkeypatch.setenv("DLROVER_TPU_REMEDIATION_COOLDOWN_S", "0")
+    monkeypatch.setenv("DLROVER_TPU_REMEDIATION_PROBATION_S", "5")
+    monkeypatch.setenv("DLROVER_TPU_REMEDIATION_BACKOFF_S", "10")
+
+
+class FakeDetector:
+    """Settable verdict table, the policy's whole input surface."""
+
+    def __init__(self):
+        self.flags = {}
+
+    def flag(self, wid, kind="link", since_ts=0.0, detect_ts=0.0):
+        self.flags[wid] = {
+            "kind": kind, "since_ts": since_ts, "detect_ts": detect_ts,
+        }
+
+    def clear(self, wid):
+        self.flags.pop(wid, None)
+
+    def straggler_details(self):
+        return {w: dict(d) for w, d in self.flags.items()}
+
+    def stragglers(self):
+        return {w: d["kind"] for w, d in self.flags.items()}
+
+
+def make_policy(n=4, det=None, coord=None, mgr=None, store=None,
+                evict_cb=None, **coord_kw):
+    if mgr is None:
+        mgr, _, _ = formed_world(n)
+    det = det if det is not None else FakeDetector()
+    if coord is None:
+        coord = make_coordinator(mgr, **coord_kw)
+    policy = RemediationPolicy(
+        straggler_detector=det,
+        rdzv_managers={TRAIN: mgr},
+        rescale_coordinator=coord,
+        state_store=store,
+        evict_cb=evict_cb,
+    )
+    return policy, det, coord, mgr
+
+
+def quarantine(policy, det, wid=0, kind="link", t0=100.0):
+    """Drive wid through SUSPECT into QUARANTINED (sustain=2)."""
+    det.flag(wid, kind=kind, since_ts=t0 - 5, detect_ts=t0)
+    policy.tick(now=t0)
+    policy.tick(now=t0 + 1)
+    assert policy.state(wid) == STATE_QUARANTINED
+    return t0 + 1
+
+
+class TestStateMachine:
+    def test_sustain_hysteresis_before_quarantine(self):
+        policy, det, coord, mgr = make_policy()
+        det.flag(0, "link", since_ts=95.0, detect_ts=100.0)
+        policy.tick(now=100.0)
+        # one tick: SUSPECT, world untouched
+        assert policy.state(0) == STATE_SUSPECT
+        assert len(mgr.current_world()) == 4
+        assert not policy.gated(0)
+        policy.tick(now=101.0)
+        # second sustained tick: quarantined, world shrank in place
+        assert policy.state(0) == STATE_QUARANTINED
+        assert policy.gated(0)
+        world = mgr.current_world()
+        assert 0 not in world and len(world) == 3
+        rec = policy.node_state(0)
+        assert rec["plan_id"] >= 0
+        assert coord.plan_status(rec["plan_id"]) == PLAN_ISSUED
+        assert rec["detect_ts"] == 100.0 and rec["act_ts"] == 101.0
+
+    def test_flap_clears_suspect_without_action(self):
+        policy, det, coord, mgr = make_policy()
+        det.flag(0)
+        policy.tick(now=100.0)
+        assert policy.state(0) == STATE_SUSPECT
+        det.clear(0)
+        policy.tick(now=101.0)
+        # verdict flapped before the hysteresis ran out: record dropped
+        assert policy.state(0) is None
+        assert len(mgr.current_world()) == 4
+
+    def test_cooldown_rate_limits_actions(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_REMEDIATION_COOLDOWN_S", "30")
+        monkeypatch.setenv("DLROVER_TPU_REMEDIATION_MAX_CONCURRENT", "4")
+        policy, det, coord, mgr = make_policy(n=6, capable=range(6))
+        det.flag(0)
+        det.flag(1)
+        policy.tick(now=100.0)
+        policy.tick(now=101.0)
+        assert policy.state(0) == STATE_QUARANTINED
+        # node 1 is equally sustained but the fleet-wide cooldown holds
+        assert policy.state(1) == STATE_SUSPECT
+        policy.tick(now=102.0)
+        assert policy.state(1) == STATE_SUSPECT
+        policy.tick(now=132.0)  # past the cooldown
+        assert policy.state(1) == STATE_QUARANTINED
+
+    def test_concurrent_cap_holds_second_quarantine(self):
+        policy, det, coord, mgr = make_policy(n=6, capable=range(6))
+        det.flag(0)
+        det.flag(1)
+        t = 100.0
+        for i in range(6):
+            policy.tick(now=t + i)
+        # default cap is 1: one node parked, the other held SUSPECT
+        assert policy.state(0) == STATE_QUARANTINED
+        assert policy.state(1) == STATE_SUSPECT
+        assert len(mgr.current_world()) == 5
+
+    def test_min_world_floor_blocks_shrink(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_REMEDIATION_MIN_WORLD", "4")
+        policy, det, coord, mgr = make_policy(n=4)
+        det.flag(0)
+        for i in range(5):
+            policy.tick(now=100.0 + i)
+        # 4 -> 3 would breach the floor: held in SUSPECT forever
+        assert policy.state(0) == STATE_SUSPECT
+        assert len(mgr.current_world()) == 4
+
+    def test_preflight_decline_never_touches_world(self):
+        # No batch config: the coordinator cannot plan any shrink.
+        policy, det, coord, mgr = make_policy(global_batch=0)
+        det.flag(0)
+        for i in range(4):
+            policy.tick(now=100.0 + i)
+        assert policy.state(0) == STATE_SUSPECT
+        # the node was NOT dropped from the rendezvous — an
+        # issued-then-declined shrink would have forced a full restart
+        assert len(mgr.current_world()) == 4
+
+    def test_probation_pass_clears_to_healthy(self):
+        policy, det, coord, mgr = make_policy()
+        t = quarantine(policy, det)
+        rec = policy.node_state(0)
+        # survivors ack -> plan completes -> settle
+        for r in (1, 2, 3):
+            coord.apply_ack(rec["plan_id"], r, ok=True)
+        policy.tick(now=t + 1)
+        assert policy.node_state(0)["plan_id"] == -1
+        # probes recover: the verdict clears -> probation, gate lifts
+        det.clear(0)
+        policy.tick(now=t + 2)
+        assert policy.state(0) == STATE_PROBATION
+        assert not policy.gated(0)
+        # clean probation window -> HEALTHY (record dropped)
+        policy.tick(now=t + 2 + 5.1)
+        assert policy.state(0) is None
+
+    def test_probation_fail_backs_off_then_requarantines(self):
+        policy, det, coord, mgr = make_policy()
+        t = quarantine(policy, det)
+        rec = policy.node_state(0)
+        for r in (1, 2, 3):
+            coord.apply_ack(rec["plan_id"], r, ok=True)
+        policy.tick(now=t + 1)
+        det.clear(0)
+        policy.tick(now=t + 2)
+        assert policy.state(0) == STATE_PROBATION
+        # the node regrows; simulate by re-joining the world
+        mgr.join_rendezvous(0, 1)
+        coord.on_node_joined(0, 1, TRAIN)
+        # verdict returns during probation: first failure -> SUSPECT
+        # with backoff, NOT an instant re-shrink
+        det.flag(0)
+        policy.tick(now=t + 3)
+        rec = policy.node_state(0)
+        assert rec["state"] == STATE_SUSPECT and rec["fails"] == 1
+        assert rec["backoff_until"] == pytest.approx(t + 13)
+        policy.tick(now=t + 4)
+        assert policy.state(0) == STATE_SUSPECT  # backoff holds
+        # past the backoff: fully sustained already, re-quarantines
+        policy.tick(now=t + 14)
+        assert policy.state(0) == STATE_QUARANTINED
+
+    def test_second_probation_failure_evicts_permanently(self):
+        evicted = []
+        policy, det, coord, mgr = make_policy(
+            evict_cb=lambda wid, reason: evicted.append((wid, reason))
+        )
+        t = quarantine(policy, det)
+        rec = policy.node_state(0)
+        for r in (1, 2, 3):
+            coord.apply_ack(rec["plan_id"], r, ok=True)
+        policy.tick(now=t + 1)
+        det.clear(0)
+        policy.tick(now=t + 2)         # probation #1
+        mgr.join_rendezvous(0, 1)      # gate lifted: the node regrows
+        coord.on_node_joined(0, 1, TRAIN)
+        det.flag(0)
+        policy.tick(now=t + 3)         # fail #1 -> suspect+backoff
+        policy.tick(now=t + 14)        # re-quarantine
+        assert policy.state(0) == STATE_QUARANTINED
+        rec = policy.node_state(0)
+        for r in (1, 2, 3):
+            coord.apply_ack(rec["plan_id"], r, ok=True)
+        policy.tick(now=t + 15)
+        det.clear(0)
+        policy.tick(now=t + 16)        # probation #2
+        assert policy.state(0) == STATE_PROBATION
+        det.flag(0)
+        policy.tick(now=t + 17)        # fail #2 -> permanent eviction
+        assert evicted and evicted[0][0] == 0
+        assert "remediation:link" in evicted[0][1]
+        assert policy.state(0) == STATE_EVICTED
+        # the gate outlives the eviction: the node can never rejoin
+        assert policy.gated(0)
+
+    def test_unrelated_eviction_drops_record(self):
+        policy, det, coord, mgr = make_policy()
+        det.flag(0)
+        policy.tick(now=100.0)
+        assert policy.state(0) == STATE_SUSPECT
+        # heartbeat-timeout eviction lands through the node manager:
+        # the record must not linger (a returning node may rejoin)
+        policy.on_node_evicted(0)
+        assert policy.state(0) is None and not policy.gated(0)
+
+    def test_disabled_policy_never_acts(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_REMEDIATION", "0")
+        policy, det, coord, mgr = make_policy()
+        det.flag(0)
+        for i in range(5):
+            policy.tick(now=100.0 + i)
+        assert policy.state(0) is None
+        assert len(mgr.current_world()) == 4
+
+
+class TestNackedPlan:
+    def test_nacked_plan_reverts_to_suspect_with_backoff(self):
+        """Regression: a survivor nacking the shrink plan must revert
+        the node to SUSPECT with backoff — never a crash, never a stuck
+        QUARANTINED record pinning a gate nobody will lift."""
+        policy, det, coord, mgr = make_policy()
+        t = quarantine(policy, det)
+        rec = policy.node_state(0)
+        coord.apply_ack(rec["plan_id"], 1, ok=False, error="oom")
+        assert coord.plan_status(rec["plan_id"]) == PLAN_ABORTED
+        policy.tick(now=t + 1)
+        rec = policy.node_state(0)
+        assert rec["state"] == STATE_SUSPECT
+        assert rec["plan_id"] == -1
+        assert rec["backoff_until"] == pytest.approx(t + 11)
+        assert not policy.gated(0)      # gate lifted: node may reform
+        # backoff respected, then eligible again
+        policy.tick(now=t + 2)
+        assert policy.state(0) == STATE_SUSPECT
+
+    def test_plan_timeout_reverts(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_RESCALE_APPLY_TIMEOUT_S", "0.05")
+        policy, det, coord, mgr = make_policy()
+        t = quarantine(policy, det)
+        time.sleep(0.1)
+        coord.tick()                    # deadline sweep aborts the plan
+        policy.tick(now=t + 1)
+        assert policy.state(0) == STATE_SUSPECT
+
+
+class TestWalReplay:
+    def _journaled_policy(self, tmp_path, **kw):
+        store = MasterStateStore(str(tmp_path))
+        store.snapshot(lambda: {})      # open the generation's journal
+        policy, det, coord, mgr = make_policy(store=store, **kw)
+        return store, policy, det, coord, mgr
+
+    def test_mid_quarantine_failover_replays_exactly_once(self, tmp_path):
+        store, policy, det, coord, mgr = self._journaled_policy(tmp_path)
+        quarantine(policy, det)
+        plan_id = policy.node_state(0)["plan_id"]
+        store.close()                   # crash: no graceful checkpoint
+
+        # ---- failed-over master: fresh world, fresh coordinator ----
+        mgr2, _, _ = formed_world(4)
+        calls = []
+        policy2, det2, coord2, _ = make_policy(mgr=mgr2, det=det)
+        coord2.on_node_removed = lambda *a, **k: calls.append(a)
+        store2 = MasterStateStore(str(tmp_path))
+        _, records = store2.recover()
+        remediate = [r for r in records if r[0] == "remediate"]
+        assert len(remediate) == 1      # exactly one quarantine record
+        store2.replaying = True
+        try:
+            for rec in remediate:
+                policy2.replay(rec[1])
+        finally:
+            store2.replaying = False
+        # the pending quarantine is reproduced...
+        rec = policy2.node_state(0)
+        assert rec["state"] == STATE_QUARANTINED
+        assert rec["plan_id"] == plan_id
+        assert policy2.gated(0)
+        # ...exactly once: replay is pure bookkeeping, no re-shrink
+        assert calls == []
+        # and the still-flagged verdict does not re-act on tick: the
+        # node is already quarantined
+        policy2.tick(now=500.0)
+        assert calls == []
+        store2.close()
+
+    def test_probation_and_fail_records_replay(self, tmp_path):
+        store, policy, det, coord, mgr = self._journaled_policy(tmp_path)
+        t = quarantine(policy, det)
+        rec = policy.node_state(0)
+        for r in (1, 2, 3):
+            coord.apply_ack(rec["plan_id"], r, ok=True)
+        policy.tick(now=t + 1)
+        det.clear(0)
+        policy.tick(now=t + 2)          # probation record
+        det.flag(0)
+        policy.tick(now=t + 3)          # fail record
+        expect = policy.node_state(0)
+        store.close()
+
+        policy2 = RemediationPolicy()
+        store2 = MasterStateStore(str(tmp_path))
+        _, records = store2.recover()
+        for rec in records:
+            if rec[0] == "remediate":
+                policy2.replay(rec[1])
+        got = policy2.node_state(0)
+        assert got["state"] == expect["state"] == STATE_SUSPECT
+        assert got["fails"] == expect["fails"] == 1
+        assert got["backoff_until"] == expect["backoff_until"]
+        store2.close()
+
+    def test_tick_is_inert_while_replaying(self, tmp_path):
+        store, policy, det, coord, mgr = self._journaled_policy(tmp_path)
+        det.flag(0)
+        store.replaying = True
+        try:
+            for i in range(5):
+                policy.tick(now=100.0 + i)
+        finally:
+            store.replaying = False
+        assert policy.state(0) is None
+        assert len(mgr.current_world()) == 4
+        store.close()
+
+    def test_master_checkpoint_roundtrip(self, tmp_path):
+        """Through the real JobMaster: the remediation table rides the
+        snapshot and the ("remediate", ...) journal records ride the
+        dispatcher, so a relaunched master holds the same gates."""
+        master = JobMaster(port=0, node_num=4, state_dir=str(tmp_path))
+        det = FakeDetector()
+        master.remediation._detector = det
+        for r in range(4):
+            master.rdzv_managers[TRAIN].join_rendezvous(r, 1)
+        master.rdzv_managers[TRAIN].get_comm_world(0)
+        master.rescale.set_batch_config(16, 4)
+        for r in range(4):
+            master.rescale.set_capable(r)
+        det.flag(3, "compute", since_ts=1.0, detect_ts=2.0)
+        master.remediation.tick(now=100.0)
+        master.remediation.tick(now=101.0)
+        assert master.remediation.state(3) == STATE_QUARANTINED
+        master._stopped.set()
+        master._server.stop()
+        master.state_store.close()
+
+        master2 = JobMaster(port=0, node_num=4, state_dir=str(tmp_path))
+        assert master2.remediation.state(3) == STATE_QUARANTINED
+        assert master2.remediation.gated(3)
+        master2._stopped.set()
+        master2._server.stop()
+        master2.state_store.close()
+
+
+class TestJoinGate:
+    def _servicer(self, mgr, policy):
+        return MasterServicer(
+            rdzv_managers={TRAIN: mgr},
+            kv_store=None,
+            task_manager=None,
+            job_manager=None,
+            speed_monitor=None,
+            sync_service=None,
+            shard_lease=object(),
+            remediation_policy=policy,
+        )
+
+    def test_quarantined_join_parks_without_growing(self):
+        policy, det, coord, mgr = make_policy()
+        quarantine(policy, det)
+        servicer = self._servicer(mgr, policy)
+        world_before = mgr.current_world()
+        round_ = servicer._join_rendezvous(m.JoinRendezvous(
+            rdzv_name=TRAIN, node_rank=0, local_world_size=1,
+        ))
+        # parked: not admitted to the waiting set, no grow plan, but
+        # told the current round so its poll loop keeps retrying
+        assert mgr.current_world() == world_before
+        assert mgr.num_nodes_waiting() == 0
+        assert round_ == mgr.current_round()
+
+    def test_probation_join_flows_to_grow_path(self):
+        policy, det, coord, mgr = make_policy()
+        t = quarantine(policy, det)
+        rec = policy.node_state(0)
+        for r in (1, 2, 3):
+            coord.apply_ack(rec["plan_id"], r, ok=True)
+        policy.tick(now=t + 1)
+        det.clear(0)
+        policy.tick(now=t + 2)
+        assert policy.state(0) == STATE_PROBATION
+        servicer = self._servicer(mgr, policy)
+        servicer._rescale = coord
+        servicer._join_rendezvous(m.JoinRendezvous(
+            rdzv_name=TRAIN, node_rank=0, local_world_size=1,
+        ))
+        # the gate lifted, so the ordinary join path issued the regrow
+        assert 0 in mgr.current_world()
+        assert len(mgr.current_world()) == 4
+
+
+class TestLedger:
+    def test_remediation_incident_books_detect_act_recover(self):
+        led = GoodputLedger(now=0.0)
+        led.ingest(JobEvent(
+            kind=EventKind.REMEDIATION_QUARANTINE, ts=110.0, node_id=2,
+            role="master", pid=1,
+            args={"kind": "link", "since_ts": 100.0, "detect_ts": 106.0,
+                  "plan_id": 7, "old_world": [0, 1, 2, 3],
+                  "new_world": [0, 1, 3]},
+        ))
+        led.note_step(5, ts=112.0)
+        s = led.summary(now=120.0)
+        [inc] = s["incidents"]
+        assert inc["cause"] == "remediation:link"
+        assert inc["persistent"] and inc["open"]
+        assert inc["detect_s"] == pytest.approx(6.0)
+        assert inc["act_s"] == pytest.approx(10.0)
+        assert "plan 7" in inc["evidence"]
+        # degradation, not downtime
+        assert s["downtime_s"] == 0.0 and s["goodput"] == 1.0
+        assert "remediation:link" in s["downtime_by_cause_s"]
+        led.ingest(JobEvent(
+            kind=EventKind.REMEDIATION_PROBATION, ts=130.0, node_id=2,
+            role="master", pid=1, args={"kind": "link"},
+        ))
+        [inc] = led.summary(now=140.0)["incidents"]
+        assert not inc["open"]
+        assert inc["recover_s"] == pytest.approx(30.0)
+
+    def test_straggler_recover_never_closes_remediation_incident(self):
+        """A node carries BOTH lifecycles at once; each closes its own."""
+        led = GoodputLedger(now=0.0)
+        led.ingest(JobEvent(
+            kind=EventKind.STRAGGLER_DETECT, ts=100.0, node_id=2,
+            role="master", pid=1, args={"kind": "link"},
+        ))
+        led.ingest(JobEvent(
+            kind=EventKind.REMEDIATION_QUARANTINE, ts=110.0, node_id=2,
+            role="master", pid=1, args={"kind": "link"},
+        ))
+        led.ingest(JobEvent(
+            kind=EventKind.STRAGGLER_RECOVER, ts=120.0, node_id=2,
+            role="master", pid=1, args={"kind": "link"},
+        ))
+        by_cause = {
+            i.cause: i for i in led.incidents()
+        }
+        assert not by_cause["straggler:link"].open
+        assert by_cause["remediation:link"].open
+
+    def test_evict_closes_and_revert_attaches(self):
+        led = GoodputLedger(now=0.0)
+        led.ingest(JobEvent(
+            kind=EventKind.REMEDIATION_QUARANTINE, ts=10.0, node_id=1,
+            role="master", pid=1, args={"kind": "compute"},
+        ))
+        led.ingest(JobEvent(
+            kind=EventKind.REMEDIATION_REVERT, ts=12.0, node_id=1,
+            role="master", pid=1,
+            args={"kind": "compute", "reason": "plan-3-aborted"},
+        ))
+        led.ingest(JobEvent(
+            kind=EventKind.REMEDIATION_EVICT, ts=20.0, node_id=1,
+            role="master", pid=1, args={"kind": "compute", "fails": 2},
+        ))
+        [inc] = led.incidents()
+        assert EventKind.REMEDIATION_REVERT in inc.trail
+        assert not inc.open and inc.recover_ts == 20.0
+
+
+class TestEvictFailureSurfaced:
+    def test_failed_evict_cb_emits_remediation_failed(self):
+        """Satellite of ISSUE 17: _evict_cb exceptions were logged and
+        dropped — they must surface as a remediation.failed event and a
+        goodput note."""
+        log = EventLog()
+        led = GoodputLedger()
+        log.add_listener(led.ingest)
+        events_mod.install_sink(log.append)
+        sm = SpeedMonitor()
+
+        def broken_evict(wid, reason):
+            raise RuntimeError("scaler backend unreachable")
+
+        det = StragglerDetector(
+            speed_monitor=sm, window=16, ratio=2.0, sustain=2,
+            evict_after=0.0, evict_enabled=True, evict_cb=broken_evict,
+        )
+        log.add_listener(det.observe)
+        slow = {"input_s": 0.01, "compute_s": 0.50,
+                "collective_s": 0.01, "readback_s": 0.01}
+        normal = {"input_s": 0.01, "compute_s": 0.10,
+                  "collective_s": 0.01, "readback_s": 0.01}
+        for step in range(8):
+            for w in range(3):
+                det.note_phases(
+                    w, dict(slow if w == 0 else normal), step=step
+                )
+            det.tick()
+        assert det.stragglers() == {0: "compute"}
+        failures = log.events(kinds=[EventKind.REMEDIATION_FAILED])
+        assert failures and failures[0].node_id == 0
+        assert "scaler backend unreachable" in failures[0].args["error"]
+        # goodput note on the node's open straggler incident
+        [inc] = [i for i in led.incidents()
+                 if i.cause == "straggler:compute"]
+        assert "failed" in inc.evidence
+        assert EventKind.REMEDIATION_FAILED in inc.trail
+
+    def test_policy_evict_failure_falls_back_to_suspect(self):
+        """The policy's own permanent eviction failing must not leave an
+        EVICTED-but-present node: it degrades to another quarantine
+        round."""
+        def broken_evict(wid, reason):
+            raise RuntimeError("node manager down")
+
+        policy, det, coord, mgr = make_policy(evict_cb=broken_evict)
+        t = quarantine(policy, det)
+        rec = policy.node_state(0)
+        for r in (1, 2, 3):
+            coord.apply_ack(rec["plan_id"], r, ok=True)
+        policy.tick(now=t + 1)
+        det.clear(0)
+        policy.tick(now=t + 2)
+        mgr.join_rendezvous(0, 1)
+        coord.on_node_joined(0, 1, TRAIN)
+        det.flag(0)
+        policy.tick(now=t + 3)          # fail #1
+        policy.tick(now=t + 14)         # re-quarantine
+        rec = policy.node_state(0)
+        for r in (1, 2, 3):
+            coord.apply_ack(rec["plan_id"], r, ok=True)
+        policy.tick(now=t + 15)
+        det.clear(0)
+        policy.tick(now=t + 16)
+        det.flag(0)
+        policy.tick(now=t + 17)         # fail #2 -> evict raises
+        assert policy.state(0) == STATE_SUSPECT
+
+
+class TestMetrics:
+    def test_state_gauge_and_action_counter(self):
+        policy, det, coord, mgr = make_policy()
+        quarantine(policy, det, kind="link")
+        metrics = {name: samples for name, _, _, samples
+                   in policy.metrics()}
+        assert ({"state": "quarantined", "kind": "link"}, 1.0) in (
+            metrics["dlrover_tpu_remediation"]
+        )
+        assert ({"action": "quarantine"}, 1.0) in (
+            metrics["dlrover_tpu_remediation_actions_total"]
+        )
+
+
+@pytest.mark.slow
+class TestChaosDrill:
+    """ISSUE 17 acceptance: ``probe.link degrade`` on one node →
+    autonomous quarantine → in-place shrink (no restart) → probe
+    recovery → probation regrow — every decision WAL-reproducible and
+    goodput strictly above the detect-only arm."""
+
+    DEGRADED_ROUNDS = 6
+
+    def _run_arm(self, monkeypatch, tmp_path, remediate: bool):
+        monkeypatch.setenv(
+            "DLROVER_TPU_REMEDIATION", "1" if remediate else "0"
+        )
+        monkeypatch.setenv("DLROVER_TPU_REMEDIATION_SUSTAIN_TICKS", "2")
+        monkeypatch.setenv("DLROVER_TPU_REMEDIATION_COOLDOWN_S", "0")
+        monkeypatch.setenv("DLROVER_TPU_REMEDIATION_PROBATION_S", "0.1")
+        log = EventLog()
+        sm = SpeedMonitor()
+        det = StragglerDetector(
+            speed_monitor=sm, window=16, ratio=2.0, sustain=2,
+            evict_after=1e9, evict_enabled=False,
+        )
+        led = GoodputLedger()
+        log.add_listener(det.observe)
+        log.add_listener(led.ingest)
+        events_mod.install_sink(log.append)
+        mgr, _, _ = formed_world(4)
+        coord = make_coordinator(mgr)
+        store = MasterStateStore(str(tmp_path / ("auto" if remediate
+                                                 else "detect")))
+        store.snapshot(lambda: {})
+        policy = RemediationPolicy(
+            straggler_detector=det,
+            rdzv_managers={TRAIN: mgr},
+            rescale_coordinator=coord,
+            state_store=store,
+        )
+        events_mod.set_identity(0, "agent")
+        probe = LinkProbe(interval=0, busy_fn=lambda: False,
+                          sample_fn=lambda: dict(PROBE_OK))
+        monkeypatch.setenv(CHAOS_ENV, FaultPlan(seed=11, events=[
+            FaultEvent(site="probe.link", kind="degrade", every=1,
+                       max_fires=self.DEGRADED_ROUNDS,
+                       args={"factor": 0.05}),
+        ]).to_json())
+        FaultInjector.reset()
+
+        # Throughput model for the goodput comparison: a round is slow
+        # while a degraded node is in the training world, fast after
+        # the shrink removes it (3 healthy chips beat 3 healthy + 1
+        # that stalls every collective).
+        FAST, SLOW = 0.1, 0.4
+        sim_time, steps = 0.0, 0
+        quarantined_at = None
+        for round_ in range(14):
+            probe.sample_once()           # node 0, through chaos
+            for w in (1, 2, 3):
+                emit(EventKind.PROBE_LINK, _node_id=w, _role="agent",
+                     **PROBE_OK)
+            det.tick()
+            policy.tick()
+            world = mgr.current_world()
+            degraded_in_world = (
+                0 in world and round_ < self.DEGRADED_ROUNDS
+            )
+            sim_time += SLOW if degraded_in_world else FAST
+            steps += 1
+            if quarantined_at is None and 0 not in world:
+                quarantined_at = round_
+                # in-place shrink, not a restart: a live round exists
+                # and the plan's survivors keep their state
+                assert mgr.current_world() == {1: 1, 2: 1, 3: 1}
+                plan_id = policy.node_state(0)["plan_id"]
+                for r in (1, 2, 3):
+                    coord.apply_ack(plan_id, r, ok=True)
+            if (
+                remediate and policy.state(0) == STATE_PROBATION
+                and 0 not in world
+            ):
+                # gate lifted: the node's next join poll regrows
+                mgr.join_rendezvous(0, 1)
+                coord.on_node_joined(0, 1, TRAIN)
+            time.sleep(0.02)
+        events_mod.reset()
+        return {
+            "throughput": steps / sim_time,
+            "quarantined_at": quarantined_at,
+            "policy": policy,
+            "world": mgr.current_world(),
+            "store": store,
+            "log": log,
+            "actions": dict(policy._actions),
+        }
+
+    def test_degraded_link_quarantine_shrink_regrow_beats_detect_only(
+        self, monkeypatch, tmp_path
+    ):
+        auto = self._run_arm(monkeypatch, tmp_path, remediate=True)
+        FaultInjector.reset()
+        events_mod.reset()
+        detect_only = self._run_arm(
+            monkeypatch, tmp_path, remediate=False
+        )
+
+        # the detect-only arm never moved the world
+        assert detect_only["quarantined_at"] is None
+        assert len(detect_only["world"]) == 4
+        # the auto arm quarantined while the link was degraded...
+        assert auto["quarantined_at"] is not None
+        assert auto["quarantined_at"] < self.DEGRADED_ROUNDS
+        # ...and regrew to the full world after the probes recovered
+        assert auto["world"] == {0: 1, 1: 1, 2: 1, 3: 1}
+        assert auto["policy"].state(0) in (STATE_PROBATION, None)
+        # zero flaps: exactly one quarantine action, no reverts
+        assert auto["actions"].get("quarantine") == 1
+        assert "revert" not in auto["actions"]
+        # goodput strictly above the no-remediation arm
+        assert auto["throughput"] > detect_only["throughput"]
+
+        # every decision reproduces from WAL replay, exactly once
+        store = auto["store"]
+        store.close()
+        store2 = MasterStateStore(store._root if hasattr(
+            store, "_root") else str(tmp_path / "auto"))
+        _, records = store2.recover()
+        remediate_recs = [r[1] for r in records if r[0] == "remediate"]
+        kinds = [p["rec"] for p in remediate_recs]
+        assert kinds.count("quarantine") == 1
+        assert kinds.count("probation") == 1
+        replayed = RemediationPolicy()
+        for payload in remediate_recs:
+            replayed.replay(payload)
+        assert replayed.state(0) == auto["policy"].state(0) or (
+            replayed.state(0) == STATE_PROBATION
+        )
+        store2.close()
+        detect_only["store"].close()

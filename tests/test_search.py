@@ -370,6 +370,10 @@ class TestHierarchyAwareness:
 
 
 class TestProfiledSearch:
+    # Promoted to slow for tier-1 headroom (~19s: compiles and times
+    # K candidate meshes); the search logic itself stays tier-1 via
+    # the non-profiled TestSearch cases.
+    @pytest.mark.slow
     def test_dry_run_top_k_picks_and_trains(self):
         """spec="auto" + profile=True: the search's top-K candidates are
         compiled and timed on the real (virtual) mesh and the winner is

@@ -39,7 +39,7 @@ _INTERESTING = re.compile(
     r"|attribution"
     r"|agents_sustained|beats_per_s|fsyncs_per_mutation|rpc_p99"
     r"|completions_per_s|leases_per_s|master_rpcs_per_shard"
-    r"|fetch_p99)", re.I,
+    r"|fetch_p99|remediation|action_latency|flaps)", re.I,
 )
 
 #: Lower-is-better keys: latencies, wall clocks, overheads — and memory
@@ -69,10 +69,16 @@ _INTERESTING = re.compile(
 #: ``completions_per_s``/``leases_per_s`` stay higher-is-better via the
 #: same ``(?<!per)`` lookbehind, and ``fetch_p99_ms`` already matches
 #: ``_ms$``.
+#: Remediation: ``action_latency_ticks`` (sustained verdict → world
+#: moved) and ``flaps`` (spurious quarantine/revert cycles; zero is
+#: the contract) want to shrink;
+#: ``remediation_goodput_uplift_pct`` and the two ``steps_per_s_*``
+#: arms stay higher-is-better via the ``(?<!per)`` lookbehind.
 _LOWER_BETTER = re.compile(
     r"(_ms$|(?<!per)_s$|_s_per_gb$|wall|overhead|step_time|compile"
     r"|_gb$|_bytes(?!_per_s|_cut)|detect_latency|fsyncs_per_mutation"
-    r"|_loss_steps|master_rpcs_per_shard|fetch_p99_ratio)",
+    r"|_loss_steps|master_rpcs_per_shard|fetch_p99_ratio"
+    r"|action_latency|flaps)",
     re.I,
 )
 
