@@ -15,7 +15,25 @@ from dlrover_tpu.common.backoff import ExponentialBackoff
 from dlrover_tpu.common.constants import NodeEnv, NodeStatus
 from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.common.rpc import RpcClient
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.rpc import RpcClient, endpoint_from_file
+
+
+def _ha_endpoint_source():
+    """Endpoint re-resolution callable for masters running under the
+    hot-standby plane: when a standby promotes it publishes the new
+    ``host:port`` to the shared endpoint file, and the transport
+    re-reads it between retry rounds instead of hammering the dead
+    primary's address. None when no HA dir/file is configured — the
+    transport then keeps its fixed address."""
+    path = env_utils.MASTER_HA_ENDPOINT_FILE.get()
+    if not path:
+        ha_dir = env_utils.MASTER_HA_DIR.get()
+        if not ha_dir:
+            return None
+        from dlrover_tpu.master.ha import ENDPOINT_FILE
+        path = os.path.join(ha_dir, ENDPOINT_FILE)
+    return endpoint_from_file(path)
 
 
 class MasterClient:
@@ -23,7 +41,8 @@ class MasterClient:
 
     def __init__(self, master_addr: str, node_id: int = 0,
                  node_type: str = "worker"):
-        self._client = RpcClient(master_addr)
+        self._client = RpcClient(
+            master_addr, endpoint_source=_ha_endpoint_source())
         self._client.on_incarnation_change = self._on_master_incarnation_change
         self._node_id = node_id
         self._node_type = node_type

@@ -65,6 +65,20 @@ class ChaosSite:
     #: exercising the hold/backoff path; delay: sleep ``delay_s``),
     #: detail = "node{rank}".
     REMEDIATION_ACT = "remediation.act"
+    #: RpcClient.call asymmetric partition (one-way loss): "drop" tears
+    #: the connection down before the request is written (request lost);
+    #: "drop_response" writes the request, then severs before reading
+    #: the reply — the master executes and caches, the client retries,
+    #: and the dedup cache must answer exactly-once. Detail = request
+    #: message type name.
+    MASTER_PARTITION = "master.partition"
+    #: WalSubscribe handler, after the segment is read and before it is
+    #: returned (drop: answer empty this pull; truncate: ship the
+    #: segment with args["keep_bytes"] (default half) of its tail cut
+    #: mid-frame so the standby must detect the torn frame and
+    #: re-request from its last durable cursor; delay: sleep
+    #: args["delay_s"]). Detail = "seq{n}+{offset}".
+    WAL_STREAM = "wal.stream.drop"
     #: Reserved for unit drills of the injector mechanics themselves
     #: (schedules, journaling): never instrumented in product code.
     TEST_PROBE = "test.probe"

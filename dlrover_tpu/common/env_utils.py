@@ -535,6 +535,47 @@ REMEDIATION_PROBATION_FAILS = ENV.int(
     "Probation failures (verdict returning after a regrow) before the "
     "node is permanently evicted through the node-manager path.")
 
+# ---------------- master high availability ----------------
+MASTER_HA_DIR = ENV.path(
+    "DLROVER_TPU_MASTER_HA_DIR", "",
+    "Shared coordination directory for master hot standby: holds the "
+    "primacy lease record, the fleet-wide incarnation counter, and the "
+    "published endpoint file. Unset = HA off (single master, external "
+    "relaunch as before). Must be reachable by primary and standby "
+    "(same filesystem).")
+MASTER_HA_LEASE_TTL_S = ENV.float(
+    "DLROVER_TPU_MASTER_HA_LEASE_TTL_S", 3.0,
+    "Primacy lease time-to-live. A standby may claim primacy once the "
+    "recorded lease is older than this; the primary must renew well "
+    "inside it (see DLROVER_TPU_MASTER_HA_RENEW_S).")
+MASTER_HA_RENEW_S = ENV.float(
+    "DLROVER_TPU_MASTER_HA_RENEW_S", 1.0,
+    "Seconds between primacy-lease renewals by the holder. Keep at "
+    "most TTL/3 so one missed renewal (GC pause, slow fsync) does not "
+    "forfeit primacy.")
+MASTER_HA_POLL_S = ENV.float(
+    "DLROVER_TPU_MASTER_HA_POLL_S", 0.5,
+    "Standby cadence: seconds between WAL subscribe pulls and lease "
+    "observations. Bounds both replication lag and failover detection "
+    "latency.")
+MASTER_HA_SEGMENT_BYTES = ENV.int(
+    "DLROVER_TPU_MASTER_HA_SEGMENT_BYTES", 1 << 20,
+    "Maximum bytes of durable WAL shipped per WalSegment response. "
+    "Caps per-pull memory on both ends; a lagging standby just pulls "
+    "again immediately.")
+MASTER_HA_CLAIM_STALE_S = ENV.float(
+    "DLROVER_TPU_MASTER_HA_CLAIM_STALE_S", 10.0,
+    "Age after which an orphaned promotion claim file (a contender "
+    "that died between claim and lease write) is swept so later "
+    "contenders are not deadlocked.")
+MASTER_HA_ENDPOINT_FILE = ENV.path(
+    "DLROVER_TPU_MASTER_HA_ENDPOINT_FILE", "",
+    "File the active master publishes its host:port endpoint to and "
+    "RpcClient re-reads between retry rounds (endpoint re-resolution). "
+    "Defaults to <MASTER_HA_DIR>/endpoint when HA is on; may also be "
+    "set alone to ride an externally relaunched master onto a new "
+    "port without process restarts.")
+
 # ---------------- fault injection / debug ----------------
 CHAOS = ENV.str(
     "DLROVER_TPU_CHAOS", "",

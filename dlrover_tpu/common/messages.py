@@ -554,6 +554,61 @@ class ParallelConfig:
     version: int = 0
 
 
+# ---------------- master hot standby (WAL streaming) ----------------
+
+
+@dataclass
+class WalSubscribe(BaseRequest):
+    """A standby's pull for the next durable slice of the primary's WAL.
+
+    Read-only on the primary (never journaled — the replication stream
+    must not feed back into itself). The cursor is (``from_seq``,
+    ``from_offset``): the commit seq and journal byte offset the standby
+    has durably applied. A cursor of (0, 0) — or one the primary cannot
+    serve because the journal rotated underneath it — is answered with a
+    full-resync snapshot instead of a segment.
+    """
+
+    #: last commit seq the standby holds durable (0 = bootstrap)
+    from_seq: int = 0
+    #: byte offset into the primary's current journal file (0 = start)
+    from_offset: int = 0
+    #: cap on segment bytes per pull (server also caps by its own knob)
+    max_bytes: int = 0
+
+
+@dataclass
+class WalSegment:
+    """One replication pull's answer: a snapshot or a WAL byte range.
+
+    ``kind`` is ``"snapshot"`` (full resync: ``data`` is a complete
+    snapshot file image, byte-identical to the primary's newest snapshot;
+    the standby replaces its replica and resumes from the fresh cursor)
+    or ``"segment"`` (``data`` is whole-frame-aligned journal bytes
+    starting at ``offset``; empty when the standby is caught up). The
+    cursor the standby should pull from next is (``next_seq``,
+    ``next_offset``); ``durable_seq``/``commit_seq`` let it compute
+    replication lag.
+    """
+
+    kind: str = "segment"
+    #: commit seq the data starts after (snapshot: seq captured within)
+    seq: int = 0
+    #: journal byte offset ``data`` starts at (snapshot: 0)
+    offset: int = 0
+    data: bytes = b""
+    next_seq: int = 0
+    next_offset: int = 0
+    #: primary's durable/commit seqs and durable byte offset at read
+    #: time (lag accounting: lag_bytes = durable_offset - local cursor)
+    durable_seq: int = 0
+    commit_seq: int = 0
+    durable_offset: int = 0
+    #: primary's incarnation — a standby seeing this move without a
+    #: lease transition knows the world changed underneath it
+    incarnation: int = 0
+
+
 # ---------------- job / node lifecycle ----------------
 
 

@@ -81,6 +81,23 @@ def find_free_port(host: str = "") -> int:
         return s.getsockname()[1]
 
 
+def endpoint_from_file(path: str) -> Callable[[], str]:
+    """An ``endpoint_source`` reading ``host:port`` from a file.
+
+    The file is the HA plane's published endpoint (or any ``--port_file``
+    -style record): a promoted standby — or an externally relaunched
+    master on a new port — rewrites it atomically, and every client
+    consulting this source rides over between retry rounds without a
+    process restart."""
+    def read() -> str:
+        try:
+            with open(path) as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+    return read
+
+
 class _DedupCache:
     """Remember recent request-id → response so client retries after a
     connection error never apply a non-idempotent message twice.
@@ -572,9 +589,16 @@ class RpcClient:
 
     def __init__(self, addr: str, timeout: float = RPC_TIMEOUT,
                  retry_deadline: float = RPC_RETRY_DEADLINE,
-                 connect_timeout: float = 5.0):
+                 connect_timeout: float = 5.0,
+                 endpoint_source: Optional[Callable[[], str]] = None):
         host, port = addr.rsplit(":", 1)
         self._addr: Tuple[str, int] = (host, int(port))
+        # Optional ``() -> "host:port"`` consulted between retry rounds
+        # while the current address is unreachable (see
+        # :func:`endpoint_from_file`). Without it the address is frozen
+        # at construction and clients of a moved master are stranded
+        # until their process restarts.
+        self._endpoint_source = endpoint_source
         self._timeout = timeout
         self._retry_deadline = retry_deadline
         self._connect_timeout = connect_timeout
@@ -637,8 +661,30 @@ class RpcClient:
                                 raise ConnectionResetError(
                                     f"chaos: {chaos.kind} before send"
                                 )
+                        part = fault_hit(
+                            ChaosSite.MASTER_PARTITION,
+                            detail=type(request).__name__,
+                        )
+                        if part is not None and part.kind == "drop":
+                            # Symmetric loss: the request never reaches
+                            # the master.
+                            self._close_locked()
+                            raise ConnectionResetError(
+                                "chaos: partition dropped request"
+                            )
                         self._sock.settimeout(timeout or self._timeout)
                         _send(self._sock, envelope)
+                        if part is not None and part.kind == "drop_response":
+                            # Asymmetric (one-way) loss: the request
+                            # PASSES — the master executes and caches —
+                            # but the response never arrives. The retry
+                            # reuses the same envelope id, so the dedup
+                            # cache must answer it exactly-once instead
+                            # of re-applying the mutation.
+                            self._close_locked()
+                            raise ConnectionResetError(
+                                "chaos: partition dropped response"
+                            )
                         resp = _recv(self._sock)
                         if len(resp) == 3:
                             ok, payload, inc = resp
@@ -678,6 +724,31 @@ class RpcClient:
                 now = time.monotonic()
                 if self._down_since is None:
                     self._down_since = now
+                if self._endpoint_source is not None:
+                    # Endpoint re-resolution between retry rounds: a
+                    # promoted standby (or an external relaunch on a new
+                    # port) republished the endpoint — follow it with a
+                    # fresh retry window instead of burning the rest of
+                    # this one against the dead address.
+                    cand = None
+                    try:
+                        fresh = self._endpoint_source() or ""
+                    except Exception:
+                        fresh = ""
+                    if fresh and ":" in fresh:
+                        fhost, fport = fresh.rsplit(":", 1)
+                        try:
+                            cand = (fhost, int(fport))
+                        except ValueError:
+                            cand = None
+                    if cand is not None and cand != self._addr:
+                        logger.warning(
+                            "master endpoint moved %s -> %s; "
+                            "re-resolving", self._addr, cand,
+                        )
+                        self._addr = cand
+                        self._close_locked()
+                        self._down_since = now
                 delay = backoff.next_delay()
                 expired = (
                     now + delay

@@ -1,0 +1,223 @@
+"""Primacy lease: which master may mutate, enforced by incarnation.
+
+Master hot standby needs an answer to exactly one question — *who is
+primary right now?* — that stays correct through crashes, partitions
+and races. The answer here is a small lease record in a shared
+coordination directory (``DLROVER_TPU_MASTER_HA_DIR``; both masters
+must see the same filesystem):
+
+``lease``
+    JSON ``{incarnation, holder, ts}``, written atomically
+    (tmp + fsync + replace). The holder re-stamps ``ts`` every
+    ``MASTER_HA_RENEW_S``; anyone reading a record older than
+    ``MASTER_HA_LEASE_TTL_S`` may treat primacy as forfeit.
+``incarnation``
+    The fleet-wide monotonic counter. Promotions mint above BOTH this
+    counter and the deposed lease's incarnation, so fencing survives
+    any interleaving of promotions and plain relaunches.
+``claim``
+    The promotion mutex: contenders race ``os.open(O_CREAT | O_EXCL)``
+    on this file and exactly one wins (the double-promotion race in
+    the drill resolves here). A claimant that dies mid-promotion
+    leaves the file behind; claims older than
+    ``MASTER_HA_CLAIM_STALE_S`` are swept so the fleet is never
+    deadlocked on a corpse.
+``endpoint``
+    The active master's ``host:port``, re-read by ``RpcClient``
+    between retry rounds (endpoint re-resolution), so clients ride a
+    promotion without process restarts.
+
+Fencing is two-sided: the promoted master starts with a strictly
+higher incarnation (clients' PR-3 incarnation-change observers fire on
+first contact), and the deposed master's next :meth:`PrimacyLease.renew`
+sees the higher recorded incarnation, reports itself fenced, and the
+master fences its state store so late writes raise instead of acking.
+"""
+
+import json
+import os
+import socket
+import time
+from typing import Any, Dict, Optional
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.log import logger
+
+LEASE_FILE = "lease"
+INCARNATION_FILE = "incarnation"
+CLAIM_FILE = "claim"
+ENDPOINT_FILE = "endpoint"
+
+
+def _atomic_write(path: str, data: str):
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class PrimacyLease:
+    """One contender's view of the shared primacy lease.
+
+    Single-threaded per instance by contract: the master calls
+    ``acquire``/``renew`` from its renew thread, the standby calls
+    ``observe``/``acquire`` from its tail thread — no instance is ever
+    shared across threads, so the shared state lives in the files, not
+    in this object.
+    """
+
+    def __init__(
+        self,
+        ha_dir: str,
+        ttl_s: Optional[float] = None,
+        claim_stale_s: Optional[float] = None,
+        holder: str = "",
+    ):
+        os.makedirs(ha_dir, exist_ok=True)
+        self.ha_dir = ha_dir
+        self.ttl_s = (
+            env_utils.MASTER_HA_LEASE_TTL_S.get()
+            if ttl_s is None else ttl_s
+        )
+        self.claim_stale_s = (
+            env_utils.MASTER_HA_CLAIM_STALE_S.get()
+            if claim_stale_s is None else claim_stale_s
+        )
+        self.holder = holder or f"{socket.gethostname()}:{os.getpid()}"
+        #: incarnation this instance holds primacy under (0 = none)
+        self.incarnation = 0
+        #: set once renew() observed a newer incarnation in the record
+        self.fenced = False
+
+    # ---------------- record I/O ----------------
+    def _lease_path(self) -> str:
+        return os.path.join(self.ha_dir, LEASE_FILE)
+
+    def observe(self) -> Dict[str, Any]:
+        """The current lease record plus derived ``age``/``expired``.
+        An unreadable or absent record observes as expired at age
+        infinity — a blank coordination dir means primacy is up for
+        grabs."""
+        rec: Dict[str, Any] = {"incarnation": 0, "holder": "", "ts": 0.0}
+        try:
+            with open(self._lease_path()) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict):
+                rec.update(loaded)
+        except (OSError, ValueError):
+            pass
+        age = time.time() - float(rec.get("ts") or 0.0)
+        rec["age"] = age
+        rec["expired"] = age >= self.ttl_s
+        return rec
+
+    def _read_counter(self) -> int:
+        try:
+            with open(os.path.join(self.ha_dir, INCARNATION_FILE)) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return 0
+
+    # ---------------- acquisition (CAS via claim file) ----------------
+    def acquire(self, floor: int = 0, force: bool = False) -> Optional[int]:
+        """Try to take primacy; returns the minted incarnation or
+        ``None`` when another holder is alive or another contender won
+        the claim race.
+
+        ``floor`` lets a master fold its local state-store incarnation
+        into the mint, keeping the fleet counter monotonic with
+        pre-HA relaunch history. ``force`` skips the liveness check
+        (first boot of a known-sole primary).
+        """
+        claim = os.path.join(self.ha_dir, CLAIM_FILE)
+        try:
+            age = time.time() - os.stat(claim).st_mtime
+            if age >= self.claim_stale_s:
+                os.unlink(claim)
+                logger.warning(
+                    "swept stale promotion claim (age %.1fs) in %s",
+                    age, self.ha_dir,
+                )
+        except OSError:
+            pass
+        try:
+            fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return None  # lost the race: exactly one contender proceeds
+        try:
+            os.write(fd, self.holder.encode())
+            os.close(fd)
+            rec = self.observe()
+            if (
+                not force
+                and not rec["expired"]
+                and rec["holder"] not in ("", self.holder)
+            ):
+                return None  # holder is alive; no hostile takeover
+            incarnation = 1 + max(
+                self._read_counter(), int(rec.get("incarnation") or 0),
+                floor,
+            )
+            _atomic_write(
+                os.path.join(self.ha_dir, INCARNATION_FILE),
+                str(incarnation),
+            )
+            _atomic_write(
+                self._lease_path(),
+                json.dumps({
+                    "incarnation": incarnation,
+                    "holder": self.holder,
+                    "ts": time.time(),
+                }),
+            )
+            self.incarnation = incarnation
+            self.fenced = False
+            return incarnation
+        finally:
+            try:
+                os.unlink(claim)
+            except OSError:
+                pass
+
+    # ---------------- renewal / fencing ----------------
+    def renew(self) -> bool:
+        """Re-stamp the lease; returns ``False`` (and latches
+        ``fenced``) when the record shows a newer incarnation — someone
+        promoted over us and our writes must stop."""
+        if self.incarnation <= 0 or self.fenced:
+            return False
+        rec = self.observe()
+        if int(rec.get("incarnation") or 0) > self.incarnation:
+            self.fenced = True
+            logger.error(
+                "primacy lost: lease records incarnation %s > ours %s "
+                "(holder %s); fencing",
+                rec.get("incarnation"), self.incarnation,
+                rec.get("holder"),
+            )
+            return False
+        _atomic_write(
+            self._lease_path(),
+            json.dumps({
+                "incarnation": self.incarnation,
+                "holder": self.holder,
+                "ts": time.time(),
+            }),
+        )
+        return True
+
+    # ---------------- endpoint publication ----------------
+    def endpoint_path(self) -> str:
+        return os.path.join(self.ha_dir, ENDPOINT_FILE)
+
+    def publish_endpoint(self, addr: str):
+        _atomic_write(self.endpoint_path(), addr)
+
+    def read_endpoint(self) -> str:
+        try:
+            with open(self.endpoint_path()) as f:
+                return f.read().strip()
+        except OSError:
+            return ""

@@ -30,6 +30,14 @@ def parse_args(argv=None):
                         help="serve Prometheus /metrics on this port "
                         "(0 = ephemeral; unset = "
                         f"{env_utils.METRICS_PORT.name} env or disabled)")
+    parser.add_argument("--ha_dir", type=str, default="",
+                        help="shared coordination dir for master hot "
+                        "standby (primacy lease + endpoint); unset = "
+                        f"{env_utils.MASTER_HA_DIR.name} env or HA off")
+    parser.add_argument("--standby", action="store_true",
+                        help="run as a hot standby: tail the primary's "
+                        "WAL into --state_dir and promote on lease "
+                        "expiry (requires --ha_dir)")
     return parser.parse_args(argv)
 
 
@@ -45,9 +53,37 @@ def write_port_file(path: str, port: int):
 
 
 def run(args) -> int:
+    ha_dir = args.ha_dir or env_utils.MASTER_HA_DIR.get()
+    ha = None
+    if ha_dir:
+        from dlrover_tpu.master.ha import PrimacyLease
+
+        ha = PrimacyLease(ha_dir)
+    if args.standby:
+        if not ha:
+            logger.error("--standby requires --ha_dir (or %s)",
+                         env_utils.MASTER_HA_DIR.name)
+            return 2
+        if not args.state_dir:
+            logger.error("--standby requires --state_dir (the replica "
+                         "the standby tails into and promotes from)")
+            return 2
+        from dlrover_tpu.master.standby import HotStandby
+
+        standby = HotStandby(
+            ha, replica_dir=args.state_dir,
+            master_kwargs=dict(
+                port=args.port, node_num=args.node_num,
+                job_name=args.job_name,
+                metrics_port=args.metrics_port,
+            ),
+            port_file=args.port_file,
+        )
+        return standby.run()
     master = JobMaster(
         port=args.port, node_num=args.node_num, job_name=args.job_name,
         state_dir=args.state_dir, metrics_port=args.metrics_port,
+        ha=ha,
     )
     master.prepare()
     if args.port_file:

@@ -56,6 +56,7 @@ class JobMaster:
         scaler=None,
         state_dir: str = "",
         metrics_port: Optional[int] = None,
+        ha=None,
     ):
         ctx = get_context()
         self.job_name = job_name
@@ -66,9 +67,27 @@ class JobMaster:
         self.state_store: Optional[MasterStateStore] = None
         self.incarnation = 0
         self.last_recovery_stats = {}
+        # Primacy lease (master hot standby): when set, this master only
+        # mutates while it holds the lease — the renew thread fences the
+        # store and aborts the moment a newer incarnation appears.
+        self.ha = ha
         if state_dir:
             self.state_store = MasterStateStore(state_dir)
             self.incarnation = self.state_store.next_incarnation()
+            if ha is not None:
+                held = ha.incarnation
+                if held <= 0:
+                    # Fresh primary: take primacy now, folding the local
+                    # relaunch history into the fleet-wide mint. A
+                    # promoted standby arrives with the lease already
+                    # held (acquired before construction).
+                    held = ha.acquire(floor=self.incarnation)
+                if not held:
+                    raise RuntimeError(
+                        "another master holds the primacy lease; "
+                        "refusing to start as primary"
+                    )
+                self.incarnation = self.state_store.set_incarnation(held)
         self.speed_monitor = SpeedMonitor(hang_seconds=ctx.hang_detection_seconds)
         self.job_manager = job_manager or LocalJobManager(
             node_num=node_num, heartbeat_timeout=ctx.heartbeat_timeout
@@ -172,6 +191,8 @@ class JobMaster:
             evict_cb=self._evict_node,
         )
         self.observability.attach(remediation=self.remediation)
+        # Role/fencing gauge source (the standby attaches its own).
+        self.observability.attach(master_ha=self)
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             kv_store=self.kv_store,
@@ -201,6 +222,7 @@ class JobMaster:
         self._stopped = threading.Event()
         self._abort_reason: Optional[str] = None
         self._monitor_thread: Optional[threading.Thread] = None
+        self._ha_thread: Optional[threading.Thread] = None
         # Opt-in auto-scaling: needs a platform scaler backend (the local
         # platform default is agent-side supervision, no scaler).
         self.auto_scaler = None
@@ -364,6 +386,40 @@ class JobMaster:
             stats.get("torn_tails"), stats.get("quarantined_snapshots"),
         )
 
+    def ha_status(self) -> dict:
+        """Role/fencing snapshot for the observability plane's
+        ``dlrover_tpu_master_role`` gauge."""
+        fenced = bool(self.state_store is not None and self.state_store.fenced)
+        return {
+            "role": "fenced" if fenced else "primary",
+            "incarnation": self.incarnation,
+        }
+
+    def _ha_renew_loop(self):
+        """Primacy-lease heartbeat. Losing the lease (a standby promoted
+        over us — e.g. after a partition that only looked like our
+        death) fences the state store so late writes raise instead of
+        acking, and aborts the run loop: two masters can never both
+        mutate."""
+        renew_s = env_utils.MASTER_HA_RENEW_S.get()
+        while not self._stopped.wait(renew_s):
+            try:
+                if not self.ha.renew():
+                    self.state_store.fence(
+                        f"incarnation {self.ha.incarnation} superseded"
+                    )
+                    emit(
+                        EventKind.MASTER_FENCED, _role="master",
+                        incarnation=self.incarnation,
+                    )
+                    self._abort_reason = (
+                        "primacy lease lost: a newer master incarnation "
+                        "holds the lease"
+                    )
+                    return
+            except Exception:
+                logger.exception("primacy lease renewal failed")
+
     def prepare(self):
         self._server.start()
         self.stage = JobStage.RUNNING
@@ -372,6 +428,13 @@ class JobMaster:
             name="node-monitor",
         )
         self._monitor_thread.start()
+        if self.ha is not None and self.state_store is not None:
+            self.ha.publish_endpoint(self.addr)
+            self._ha_thread = threading.Thread(
+                target=self._ha_renew_loop, daemon=True,
+                name="ha-renew",
+            )
+            self._ha_thread.start()
         if self.auto_scaler is not None:
             self.auto_scaler.start()
         port_cfg = self._metrics_port_cfg

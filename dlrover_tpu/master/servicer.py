@@ -575,6 +575,53 @@ class MasterServicer:
         config.version = self._paral_config.version + 1
         self._paral_config = config
 
+    # ---------------- master hot standby (WAL streaming) ----------------
+    def _wal_subscribe(self, req: m.WalSubscribe):
+        """Serve one replication pull to a standby.
+
+        Read-only and never journaled: the replication stream must not
+        feed back into the journal it ships. Durability gating happens
+        in the store (only bytes behind the group-commit barrier are
+        readable), so a segment the standby holds is always state the
+        primary itself would recover.
+        """
+        store = self._state_store
+        if store is None:
+            return m.WalSegment(kind="segment")
+        cap = env_utils.MASTER_HA_SEGMENT_BYTES.get()
+        max_bytes = min(req.max_bytes, cap) if req.max_bytes > 0 else cap
+        seg = store.read_segment(req.from_seq, req.from_offset, max_bytes)
+        chaos = fault_hit(
+            ChaosSite.WAL_STREAM,
+            detail=f"seq{req.from_seq}+{req.from_offset}",
+        )
+        if chaos is not None:
+            if chaos.kind == "drop":
+                # Lose this pull entirely: answer empty at the same
+                # cursor; the standby's next tick retries.
+                seg = dict(seg, kind="segment", data=b"",
+                           seq=req.from_seq, offset=req.from_offset,
+                           next_seq=req.from_seq,
+                           next_offset=req.from_offset)
+            elif chaos.kind == "truncate" and seg["data"]:
+                # Ship a torn tail (cut mid-frame): the standby must
+                # verify frames itself, keep only the whole prefix, and
+                # re-request the remainder from its last durable cursor.
+                keep = int(chaos.args.get(
+                    "keep_bytes", len(seg["data"]) // 2
+                ))
+                seg = dict(seg, data=seg["data"][: max(1, keep)])
+            elif chaos.kind == "delay":
+                time.sleep(float(chaos.args.get("delay_s", 0.1)))
+        return m.WalSegment(
+            kind=seg["kind"], seq=seg["seq"], offset=seg["offset"],
+            data=seg["data"], next_seq=seg["next_seq"],
+            next_offset=seg["next_offset"],
+            durable_seq=seg["durable_seq"], commit_seq=seg["commit_seq"],
+            durable_offset=seg["durable_offset"],
+            incarnation=store.incarnation,
+        )
+
     # ---------------- cluster version ----------------
     def _get_cluster_version(self, req: m.ClusterVersionRequest):
         store = self._state_store
@@ -632,6 +679,7 @@ MasterServicer._HANDLERS = {
     m.SyncFinish: MasterServicer._sync_finished,
     m.SyncBarrierRequest: MasterServicer._sync_barrier,
     m.ParallelConfigRequest: MasterServicer._get_paral_config,
+    m.WalSubscribe: MasterServicer._wal_subscribe,
     m.ClusterVersionRequest: MasterServicer._get_cluster_version,
     m.JobExitRequest: MasterServicer._handle_job_exit,
 }
@@ -653,6 +701,9 @@ _BULK_CLASSES = (
     # storm can never queue ahead of a rescale ack.
     m.LeaseRequest,
     m.LeaseReport,
+    # Replication pulls are periodic and potentially megabyte-sized:
+    # keep the standby's tail loop off the control lane.
+    m.WalSubscribe,
 )
 
 

@@ -1,0 +1,359 @@
+"""Hot-standby master: WAL tailing, warm replica, automatic promotion.
+
+The standby closes the last single point of failure: until now a dead
+master depended on something *external* relaunching it at the same port
+and ``state_dir`` (the reference leans on K8s for this). A
+:class:`HotStandby` instead:
+
+1. **tails** the primary's WAL over :class:`~dlrover_tpu.common.messages.
+   WalSubscribe` pulls, writing the received snapshot/segment bytes
+   *byte-identically* into its own replica ``state_dir`` (standard
+   ``snapshot-N.bin`` / ``journal-N.wal`` layout). Only durable bytes
+   ever ship (the store gates on the group-commit barrier), and the
+   standby fsyncs before advancing its cursor, so the replica is always
+   a prefix of what the primary itself would recover;
+2. **verifies** every segment's crc frames itself, keeping only the
+   whole-frame prefix — a torn batch tail mid-stream (connection cut,
+   ``wal.stream.drop`` truncation) is detected locally and the
+   remainder re-requested from the last durable cursor;
+3. **watches** the primacy lease and, when it expires, races the
+   claim-file CAS (:meth:`~dlrover_tpu.master.ha.PrimacyLease.acquire`)
+   — exactly one contender wins a double-promotion race — then
+   **promotes**: constructs a :class:`JobMaster` over the replica dir,
+   which is ordinary PR-3 recovery (journal replay, dedup-cache
+   re-seeding, exactly-once), publishes the new endpoint through the
+   lease dir and ``--port_file``, and bumps the incarnation so the old
+   primary's late writes are refused.
+
+What the standby does NOT replicate: the RPC dedup cache (rebuilt from
+the journal at promotion), live sockets (clients re-resolve the
+endpoint between retry rounds), and anything re-derivable from agents
+(they re-register on the incarnation change, exactly as after a cold
+relaunch — promotion just skips the relaunch-and-wait part).
+"""
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RpcClient
+from dlrover_tpu.master.ha import PrimacyLease
+from dlrover_tpu.master.state_store import (
+    JOURNAL_PREFIX,
+    JOURNAL_SUFFIX,
+    SNAPSHOT_PREFIX,
+    SNAPSHOT_SUFFIX,
+    _JOURNAL_MAGIC,
+    _read_header,
+    _seq_of,
+    _whole_frames_end,
+)
+from dlrover_tpu.observability.events import EventKind, emit
+
+
+class HotStandby:
+    """Tail → verify → apply → (on lease expiry) promote.
+
+    Single-threaded by design: one loop does the pull, the verify, the
+    lease watch and the promotion, so there is no cursor state to lock
+    (dtlint DT009: every attr is owned by the tail thread; ``master``
+    and ``promoted`` are write-once published at promotion, and the
+    counters are read cross-thread only as monitoring snapshots).
+    """
+
+    GUARDED_BY = {
+        "master": None,
+        "promoted": None,
+        "lag_bytes": None,
+        "pulls": None,
+        "resyncs": None,
+        "torn_segments": None,
+        # Set once in __init__, read only by the tail thread at
+        # promotion — never mutated after construction.
+        "master_kwargs": None,
+    }
+
+    def __init__(
+        self,
+        lease: PrimacyLease,
+        replica_dir: str,
+        master_kwargs: Optional[Dict[str, Any]] = None,
+        port_file: str = "",
+        poll_s: Optional[float] = None,
+        max_bytes: Optional[int] = None,
+        auto_promote: bool = True,
+    ):
+        os.makedirs(replica_dir, exist_ok=True)
+        self.lease = lease
+        self.replica_dir = replica_dir
+        self.master_kwargs = dict(master_kwargs or {})
+        self.port_file = port_file
+        self.poll_s = (
+            env_utils.MASTER_HA_POLL_S.get() if poll_s is None else poll_s
+        )
+        self.max_bytes = (
+            env_utils.MASTER_HA_SEGMENT_BYTES.get()
+            if max_bytes is None else max_bytes
+        )
+        self.auto_promote = auto_promote
+        # Replication cursor: (journal generation, byte offset) durably
+        # applied to the replica. (0, 0) = bootstrap → snapshot resync.
+        self._cursor = (0, 0)
+        self._jfh = None
+        self._hdr = None  # (algo, header_len) of the current journal
+        self._client: Optional[RpcClient] = None
+        self._ep = ""
+        #: monitoring counters (see class docstring for the contract)
+        self.lag_bytes = 0
+        self.pulls = 0
+        self.resyncs = 0
+        self.torn_segments = 0
+        self.primary_incarnation = 0
+        self.master = None
+        self.promoted = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------- observability ----------------
+    def ha_status(self) -> Dict[str, Any]:
+        return {
+            "role": "promoted" if self.master is not None else "standby",
+            "incarnation": self.lease.incarnation
+            or self.primary_incarnation,
+            "replication_lag_bytes": self.lag_bytes,
+        }
+
+    # ---------------- replica file plumbing ----------------
+    def _close_journal(self):
+        if self._jfh is not None:
+            try:
+                os.fsync(self._jfh.fileno())
+            except (OSError, ValueError):
+                pass
+            try:
+                self._jfh.close()
+            except OSError:
+                pass
+            self._jfh = None
+
+    def _wipe_replica(self):
+        for name in os.listdir(self.replica_dir):
+            if (
+                _seq_of(name, SNAPSHOT_PREFIX, SNAPSHOT_SUFFIX) is None
+                and _seq_of(name, JOURNAL_PREFIX, JOURNAL_SUFFIX) is None
+            ):
+                continue
+            try:
+                os.remove(os.path.join(self.replica_dir, name))
+            except OSError:
+                pass
+
+    def _apply_snapshot(self, seg) -> bool:
+        """Full resync: replace the replica with the shipped snapshot
+        image and restart the journal from the matching generation."""
+        if not seg.data:
+            return False
+        self._close_journal()
+        self._wipe_replica()
+        path = os.path.join(
+            self.replica_dir,
+            f"{SNAPSHOT_PREFIX}{seg.seq}{SNAPSHOT_SUFFIX}",
+        )
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(seg.data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._cursor = (seg.seq, 0)
+        self._hdr = None
+        self.resyncs += 1
+        logger.info(
+            "standby resynced from snapshot seq=%s (%s bytes)",
+            seg.seq, len(seg.data),
+        )
+        return True
+
+    def _apply_segment(self, seg) -> bool:
+        """Verify the shipped bytes frame-by-frame and append the whole
+        prefix to the replica journal; a torn tail is dropped and
+        re-requested from the (unchanged) durable cursor."""
+        seq, off = self._cursor
+        if seg.seq != seq or seg.offset != off:
+            # The primary answered a different cursor than asked (e.g.
+            # a master change between pulls): force a clean resync.
+            self._cursor = (0, 0)
+            return False
+        data = seg.data
+        if not data:
+            self.lag_bytes = 0
+            return False
+        if self._hdr is None:
+            hdr = _read_header(data, _JOURNAL_MAGIC) if off == 0 else None
+            if hdr is None:
+                self._cursor = (0, 0)
+                return False
+            self._hdr = hdr
+        algo, hdr_len = self._hdr
+        keep = _whole_frames_end(data, max(0, hdr_len - off), algo)
+        if keep < len(data):
+            # Torn frame mid-stream (chaos truncation or a real torn
+            # batch tail): keep the verified prefix only; the next pull
+            # re-requests the remainder from the durable cursor.
+            self.torn_segments += 1
+            logger.warning(
+                "standby dropped torn segment tail at seq=%s offset=%s "
+                "(%s of %s bytes verified)", seq, off, keep, len(data),
+            )
+        if keep <= 0:
+            return False
+        if self._jfh is None:
+            self._jfh = open(
+                os.path.join(
+                    self.replica_dir,
+                    f"{JOURNAL_PREFIX}{seq}{JOURNAL_SUFFIX}",
+                ),
+                "ab", buffering=0,
+            )
+        self._jfh.write(data[:keep])
+        # Durable before the cursor moves: a standby crash replays its
+        # own recovery from what it fsynced, never past it.
+        os.fsync(self._jfh.fileno())
+        self._cursor = (seq, off + keep)
+        self.pulls += 1
+        return True
+
+    # ---------------- the tail loop ----------------
+    def tail_once(self) -> bool:
+        """One replication pull; returns True when replica state moved
+        (caller skips the poll sleep to drain a backlog quickly)."""
+        ep = self.lease.read_endpoint()
+        if not ep:
+            return False
+        if self._client is None or ep != self._ep:
+            if self._client is not None:
+                self._client.close()
+            # Fail-fast client: a dead primary must surface here within
+            # one pull so the lease watch gets its turn — the loop IS
+            # the retry, the in-call retry window stays zero.
+            self._client = RpcClient(
+                ep, timeout=10.0, retry_deadline=0.0, connect_timeout=2.0
+            )
+            self._ep = ep
+        seq, off = self._cursor
+        try:
+            seg = self._client.call(m.WalSubscribe(
+                from_seq=seq, from_offset=off, max_bytes=self.max_bytes,
+            ))
+        except Exception:
+            return False
+        if not isinstance(seg, m.WalSegment):
+            return False
+        self.primary_incarnation = seg.incarnation
+        if seg.kind == "snapshot":
+            return self._apply_snapshot(seg)
+        moved = self._apply_segment(seg)
+        self.lag_bytes = max(
+            0, seg.durable_offset - self._cursor[1]
+        ) if seg.seq == self._cursor[0] else 0
+        return moved
+
+    # ---------------- promotion ----------------
+    def maybe_promote(self):
+        """Promote iff the lease expired AND we win the claim race.
+        Returns the new JobMaster, or None (holder alive / lost race —
+        the loser keeps tailing and will resync off the winner)."""
+        if not self.auto_promote or self.master is not None:
+            return None
+        rec = self.lease.observe()
+        if not rec["expired"] or not rec.get("holder"):
+            # Never promote before a primary existed at all: an empty
+            # dir is a job that has not started, not a dead master.
+            return None
+        detect_ts = time.time()
+        if not self.lease.acquire():
+            return None
+        return self.promote(detect_ts=detect_ts)
+
+    def promote(self, detect_ts: Optional[float] = None):
+        """Become primary over the replica: ordinary durable-state
+        recovery (replay + dedup re-seed), then publish the endpoint."""
+        from dlrover_tpu.master.main import write_port_file
+        from dlrover_tpu.master.master import JobMaster
+
+        self._close_journal()
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        t0 = time.time()
+        logger.warning(
+            "standby promoting: lease expired, claim won "
+            "(replica cursor seq=%s offset=%s, lag %s bytes)",
+            self._cursor[0], self._cursor[1], self.lag_bytes,
+        )
+        master = JobMaster(
+            state_dir=self.replica_dir, ha=self.lease,
+            **self.master_kwargs,
+        )
+        master.prepare()
+        if self.port_file:
+            write_port_file(self.port_file, master.port)
+        promote_ts = time.time()
+        # Books the failover incident (cause "failover", backdated to
+        # detection) in the NEW master's goodput ledger; the next
+        # reported step stamps recovery.
+        emit(
+            EventKind.MASTER_FAILOVER, _role="master",
+            detect_ts=detect_ts or t0, promote_ts=promote_ts,
+            incarnation=master.incarnation,
+            replication_lag_bytes=self.lag_bytes,
+        )
+        self.master = master
+        self.promoted.set()
+        return master
+
+    # ---------------- lifecycle ----------------
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                moved = self.tail_once()
+                if self.maybe_promote() is not None:
+                    return
+                if not moved:
+                    self._stop.wait(self.poll_s)
+            except Exception:
+                logger.exception("standby tail iteration failed")
+                self._stop.wait(self.poll_s)
+
+    def start(self):
+        """Background mode (in-process standby for tests/bench)."""
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="standby-tail"
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._close_journal()
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def run(self) -> int:
+        """Foreground mode (``--standby``): tail until promoted, then
+        run the promoted master to job completion."""
+        logger.info(
+            "hot standby tailing into %s (ha_dir=%s, poll %.2fs)",
+            self.replica_dir, self.lease.ha_dir, self.poll_s,
+        )
+        self._loop()
+        if self.master is not None:
+            return self.master.run()
+        return 0

@@ -107,6 +107,33 @@ def _iter_frames(data: bytes, algo: str) -> Tuple[List[bytes], bool]:
     return payloads, False
 
 
+def _whole_frames_end(data: bytes, off: int, algo: str) -> int:
+    """Byte offset just past the last complete, checksum-valid frame in
+    ``data`` at or after ``off`` (the prefix before ``off`` — a file
+    header — is always kept). Replication segments are trimmed here so
+    a standby only ever appends verifiable whole records; a torn tail
+    (max_bytes cutting mid-frame, or a chaos truncation) parses to the
+    same boundary on the receiving side."""
+    if len(data) < off:
+        return len(data)
+    end = off
+    while True:
+        if end + _FRAME.size > len(data):
+            return end
+        length, crc = _FRAME.unpack_from(data, end)
+        start = end + _FRAME.size
+        if start + length > len(data):
+            return end
+        if not verify_block(data[start : start + length], crc, algo):
+            return end
+        end = start + length
+
+
+class StoreFencedError(RuntimeError):
+    """Raised by ``append`` after :meth:`MasterStateStore.fence`: a newer
+    incarnation holds primacy and this store must refuse late writes."""
+
+
 def _seq_of(name: str, prefix: str, suffix: str) -> Optional[int]:
     if not (name.startswith(prefix) and name.endswith(suffix)):
         return None
@@ -153,6 +180,7 @@ class MasterStateStore:
         "_durable_offset": "master.state_store.commit",
         "_fsync_count": "master.state_store.commit",
         "_commit_stop": "master.state_store.commit",
+        "fenced": "master.state_store",
         "last_recovery_stats": None,
     }
 
@@ -188,6 +216,10 @@ class MasterStateStore:
         #: True while recovery replays the journal: mutation paths that
         #: would normally append must not re-journal their own replay.
         self.replaying = False
+        #: Non-empty once a newer incarnation fenced this store: every
+        #: further append raises StoreFencedError, so a deposed primary
+        #: cannot ack a mutation the promoted master never saw.
+        self.fenced = ""
         self.incarnation = 0
         self.last_recovery_stats: Dict[str, Any] = {}
         #: Optional ``(op, seconds)`` callback ("append" = journal record
@@ -251,6 +283,31 @@ class MasterStateStore:
         os.replace(tmp, path)
         return self.incarnation
 
+    def set_incarnation(self, value: int) -> int:
+        """Persist an externally-minted incarnation (the HA lease's
+        fleet-wide counter) into this store's local file, so a plain
+        relaunch from this ``state_dir`` mints above every promotion
+        that happened elsewhere. Never moves backwards."""
+        self.incarnation = max(self.incarnation, int(value))
+        path = os.path.join(self.state_dir, INCARNATION_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(self.incarnation))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return self.incarnation
+
+    def fence(self, reason: str = ""):
+        """Refuse every future ``append``: a newer incarnation holds
+        primacy. Extends PR-3 fencing from "clients detect the new
+        master" to "two masters cannot both mutate" — the deposed
+        primary may keep answering reads, but any mutating handler
+        dies in its journal write and the client surfaces the error
+        (or rides to the new endpoint)."""
+        with self._lock:
+            self.fenced = reason or "superseded"
+
     # ---------------- journal ----------------
     def append(self, record: Any) -> Optional[int]:
         """Append one mutation record to the journal (write-ahead).
@@ -265,6 +322,11 @@ class MasterStateStore:
         dt = None
         fsync_dt = None
         with self._lock:
+            if self.fenced:
+                raise StoreFencedError(
+                    f"master state store fenced ({self.fenced}): a newer "
+                    "incarnation holds primacy; refusing late write"
+                )
             if self._journal_file is None or self.replaying:
                 return None
             f = self._journal_file
@@ -391,6 +453,81 @@ class MasterStateStore:
                 "appended_records": self._appended_records,
                 "journal_path": self._journal_path,
             }
+
+    # ---------------- replication (hot standby) ----------------
+    def replication_cursor(self) -> Tuple[int, int]:
+        """(journal generation, durable byte offset): the stream cursor
+        a standby caught up *right now* would hold."""
+        with self._lock:
+            seq = self._seq
+            with self._commit_cv:
+                return seq, self._durable_offset
+
+    def read_segment(
+        self, from_seq: int, from_offset: int, max_bytes: int = 1 << 20
+    ) -> Dict[str, Any]:
+        """One replication pull: durable journal bytes after the cursor.
+
+        The cursor is (journal generation, byte offset into that
+        journal file). Three answers, as a WalSegment-shaped dict:
+
+        - ``kind="segment"``: raw bytes of the current journal in
+          ``[from_offset, durable_offset)``, capped at ``max_bytes`` and
+          trimmed to whole crc frames (offset 0 includes the file
+          header). Empty when the standby is caught up. Only durable
+          bytes ship — a segment is shippable once its group-commit
+          barrier passed, so replica state never runs ahead of what the
+          primary would itself recover.
+        - ``kind="snapshot"``: full resync — the newest snapshot file's
+          raw bytes plus a fresh cursor at the matching journal's
+          start. Sent on bootstrap cursors and whenever the journal
+          rotated underneath the cursor: rotation carries un-covered
+          tail frames into the new journal, so resuming an old cursor
+          against the new file would double-apply them.
+        """
+        with self._lock:
+            seq = self._seq
+            path = self._journal_path
+            with self._commit_cv:
+                durable_offset = self._durable_offset
+                durable_seq = self._durable_seq
+                commit_seq = self._commit_seq
+            base = {
+                "durable_seq": durable_seq,
+                "commit_seq": commit_seq,
+                "durable_offset": durable_offset,
+            }
+            if path is None:
+                # Recovery window: no snapshot cut yet, nothing to ship.
+                return dict(base, kind="segment", seq=0, offset=0,
+                            data=b"", next_seq=0, next_offset=0)
+            if from_seq != seq or from_offset > durable_offset:
+                snap = os.path.join(
+                    self.state_dir,
+                    f"{SNAPSHOT_PREFIX}{seq}{SNAPSHOT_SUFFIX}",
+                )
+                try:
+                    with open(snap, "rb") as sf:  # dtlint: disable=DT002 -- read-only resync pull under the store lock; a rotation mid-read would hand the standby a mixed-generation image
+                        data = sf.read()
+                except OSError:
+                    data = b""
+                return dict(base, kind="snapshot", seq=seq, offset=0,
+                            data=data, next_seq=seq, next_offset=0)
+            want = max(0, min(max_bytes, durable_offset - from_offset))
+            try:
+                with open(path, "rb") as jf:  # dtlint: disable=DT002 -- read-only replication pull under the store lock; rotation cannot move the file mid-read
+                    jf.seek(from_offset)
+                    data = jf.read(want)
+            except OSError:
+                data = b""
+            hdr = len(_JOURNAL_MAGIC) + 1 + len(self._algo.encode())
+            keep = _whole_frames_end(
+                data, max(0, hdr - from_offset), self._algo
+            )
+            data = data[:keep]
+            return dict(base, kind="segment", seq=seq,
+                        offset=from_offset, data=data, next_seq=seq,
+                        next_offset=from_offset + len(data))
 
     def _open_journal(self, seq: int):
         if self._journal_file is not None:

@@ -98,6 +98,14 @@ class EventKind:
     REMEDIATION_REVERT = "remediation.revert"
     REMEDIATION_EVICT = "remediation.evict"
     REMEDIATION_FAILED = "remediation.failed"
+    # Master hot standby: a promoted standby took over (carries
+    # detect_ts/promote_ts so the goodput ledger books the failover
+    # incident's detect/act stamps; emitted by the NEW master so it
+    # lands in the surviving event log), and a deposed primary observed
+    # a newer incarnation in the lease and fenced its store (context —
+    # its log dies with it; the failover incident lives on the winner).
+    MASTER_FAILOVER = "master.failover"
+    MASTER_FENCED = "master.fenced"
 
 
 @dataclass

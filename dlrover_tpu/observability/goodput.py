@@ -168,6 +168,8 @@ class GoodputLedger:
             self._on_straggler_detect(ev)
         elif ev.kind == EventKind.STRAGGLER_RECOVER:
             self._on_straggler_recover(ev)
+        elif ev.kind == EventKind.MASTER_FAILOVER:
+            self._on_failover(ev)
         elif ev.kind.startswith("remediation."):
             self._on_remediation(ev)
         elif ev.kind in _CONTEXT:
@@ -344,6 +346,31 @@ class GoodputLedger:
             elif inc is not None:
                 # REVERT / CLEAR context on the open incident's trail.
                 inc.trail.append(ev.kind)
+
+    def _on_failover(self, ev: JobEvent):
+        """Book a master failover under its own cause. The promoting
+        standby emits MASTER_FAILOVER *after* it rebuilt state, so the
+        stamps arrive pre-measured: start/detect = when the lease
+        expiry was observed, act = when the promoted endpoint went
+        live. Non-persistent — the next reported step stamps recovery,
+        and detect→recover is exactly the downtime the bench's hot-vs-
+        cold arms compare."""
+        with self._lock:
+            self._incident_during_gap = True
+            start = float(ev.args.get("detect_ts") or ev.ts)
+            self._t0 = min(self._t0, start)
+            inc = Incident(
+                cause="failover", node_id=ev.node_id, start_ts=start,
+                detect_ts=start,
+                act_ts=float(ev.args.get("promote_ts") or ev.ts),
+            )
+            if ev.args.get("replication_lag_bytes") is not None:
+                inc.evidence = (
+                    "promoted with replication lag "
+                    f"{int(ev.args['replication_lag_bytes'])}B"
+                )
+            inc.trail.append(ev.kind)
+            self._incidents.append(inc)
 
     def note_step(self, step: int, ts: Optional[float] = None):
         """A training step was reported: the job is productive again —

@@ -51,6 +51,7 @@ class ObservabilityPlane:
         self._straggler_detector = None
         self._shard_lease = None
         self._remediation = None
+        self._master_ha = None
         # Native histograms: master RPC handle latency per message type
         # (servicer.handle) and state-store WAL write/fsync durations
         # (ROADMAP item 4). Lock-cheap — safe to call on the hot path.
@@ -63,7 +64,7 @@ class ObservabilityPlane:
 
     def attach(self, speed_monitor=None, job_manager=None,
                task_manager=None, straggler_detector=None,
-               shard_lease=None, remediation=None):
+               shard_lease=None, remediation=None, master_ha=None):
         """Late-bind the metric sources the exporter reads from."""
         if speed_monitor is not None:
             self._speed_monitor = speed_monitor
@@ -77,6 +78,8 @@ class ObservabilityPlane:
             self._shard_lease = shard_lease
         if remediation is not None:
             self._remediation = remediation
+        if master_ha is not None:
+            self._master_ha = master_ha
 
     # ------------- intake -------------
     def ingest_report(self, events: List[JobEvent]):
@@ -291,6 +294,24 @@ class ObservabilityPlane:
             metrics.extend(self._straggler_detector.metrics())
         if self._remediation is not None:
             metrics.extend(self._remediation.metrics())
+        if self._master_ha is not None:
+            ha = self._master_ha.ha_status()
+            metrics.append((
+                "dlrover_tpu_master_role", "gauge",
+                "This process's control-plane role (value 1 for the"
+                " current role; incarnation labels the primacy-lease"
+                " generation).",
+                [({"role": str(ha.get("role", "primary")),
+                   "incarnation": str(ha.get("incarnation", 0))}, 1)],
+            ))
+            lag = ha.get("replication_lag_bytes")
+            if lag is not None:
+                metrics.append((
+                    "dlrover_tpu_master_replication_lag_bytes", "gauge",
+                    "Standby WAL tail: durable bytes on the primary not"
+                    " yet mirrored locally.",
+                    [(None, lag)],
+                ))
         if self.rpc_hist.total_count:
             metrics.append((
                 "dlrover_tpu_rpc_handle_seconds", "histogram",

@@ -39,7 +39,8 @@ _INTERESTING = re.compile(
     r"|attribution"
     r"|agents_sustained|beats_per_s|fsyncs_per_mutation|rpc_p99"
     r"|completions_per_s|leases_per_s|master_rpcs_per_shard"
-    r"|fetch_p99|remediation|action_latency|flaps)", re.I,
+    r"|fetch_p99|remediation|action_latency|flaps"
+    r"|failover|replicat)", re.I,
 )
 
 #: Lower-is-better keys: latencies, wall clocks, overheads — and memory
@@ -74,11 +75,16 @@ _INTERESTING = re.compile(
 #: the contract) want to shrink;
 #: ``remediation_goodput_uplift_pct`` and the two ``steps_per_s_*``
 #: arms stay higher-is-better via the ``(?<!per)`` lookbehind.
+#: Failover: ``failover_downtime_hot_s``/``_cold_s`` already match
+#: ``_s$``; ``replication_lag_records`` (durable records the standby
+#: was missing at the kill) wants to shrink, while
+#: ``records_replicated`` and ``failover_speedup_x`` stay
+#: higher-is-better (the latter via ``speedup``).
 _LOWER_BETTER = re.compile(
     r"(_ms$|(?<!per)_s$|_s_per_gb$|wall|overhead|step_time|compile"
     r"|_gb$|_bytes(?!_per_s|_cut)|detect_latency|fsyncs_per_mutation"
     r"|_loss_steps|master_rpcs_per_shard|fetch_p99_ratio"
-    r"|action_latency|flaps)",
+    r"|action_latency|flaps|replication_lag)",
     re.I,
 )
 
