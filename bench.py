@@ -1301,6 +1301,59 @@ def section_remediation():
     return out
 
 
+def section_brain():
+    """Brain decision layer, three arms on the same degraded fleet
+    (``tools.fleet_sim.run_brain_drill``, in-process, CPU-friendly): a
+    4-node job where node 3 is chronically ~46% slow and the scaling
+    curve knees at 3 nodes. The **brain** arm starts at the wrong world
+    (4) with the policy on: seeded cross-job history drives the start
+    recommendation to the searched-best world, the drag shrink parks
+    the degraded node, and a crash-relaunched master must replay every
+    journaled decision exactly once. The **static_wrong** arm starts at
+    4 with the policy off (the degraded node paces the oversized world
+    forever); the **oracle_start** arm starts at the searched-best size
+    but with the degraded node aboard and never adapts. Reports the
+    modelled samples/s of all arms (brain must beat BOTH), the uplifts
+    (higher is better), convergence latency in policy ticks (lower is
+    better) and the WAL replay check (must hold)."""
+    from tools.fleet_sim import run_brain_drill
+
+    brain = run_brain_drill(arm="brain")
+    static_wrong = run_brain_drill(arm="static_wrong")
+    oracle = run_brain_drill(arm="oracle_start")
+    out = {
+        "samples_per_s_brain": brain["samples_per_s_avg"],
+        "samples_per_s_static_wrong": static_wrong["samples_per_s_avg"],
+        "samples_per_s_oracle_start": oracle["samples_per_s_avg"],
+        "brain_vs_static_wrong_uplift_pct": round(
+            100.0 * (brain["samples_per_s_avg"]
+                     / max(static_wrong["samples_per_s_avg"], 1e-9)
+                     - 1.0), 1,
+        ),
+        "brain_vs_oracle_start_uplift_pct": round(
+            100.0 * (brain["samples_per_s_avg"]
+                     / max(oracle["samples_per_s_avg"], 1e-9) - 1.0), 1,
+        ),
+        "converged_at_tick": brain["converged_at_tick"],
+        "recommended_world": brain["recommendation"].get("world_size"),
+        "recommendation_source": brain["recommendation"].get("source"),
+        "world_end": brain["world_end"],
+        "degraded_parked": brain["degraded_parked"],
+        "replay_match": brain["replay_match"],
+        "actions": brain["actions"],
+        "protocol": (
+            "4 nodes x 40 policy ticks, node 3 at 1.5x step time, "
+            "scaling knee at world 3 (145 vs 148 steps/s); brain arm = "
+            "DLROVER_TPU_BRAIN=1 (sustain=2, cooldown=0) + seeded "
+            "world_perf history + crash/relaunch replay check; "
+            "static_wrong arm = policy off at world 4; oracle_start "
+            "arm = policy off at world 3 with the degraded node aboard"
+        ),
+    }
+    log(f"bench[brain]: {out}")
+    return out
+
+
 def section_dtlint():
     """Static-analysis wall time, cold vs cached: ``tools.dtlint`` over
     the whole package with ``--no-cache`` (every file parsed, all 12
@@ -2295,12 +2348,12 @@ def main():
     # budget guard sheds the tail sections, not the headline.
     default_sections = (
         "small,large,llama,longctx,goodput,failover,ckpt_io,ckpt_dedup,"
-        "opt_shard,rescale,reshape,preempt,straggler,remediation,"
+        "opt_shard,rescale,reshape,preempt,straggler,remediation,brain,"
         "master_scale,data_plane,medium,dtlint"
         if on_tpu else
         "small,goodput,failover,ckpt_io,ckpt_dedup,opt_shard,rescale,"
-        "reshape,preempt,straggler,remediation,master_scale,data_plane,"
-        "dtlint"
+        "reshape,preempt,straggler,remediation,brain,master_scale,"
+        "data_plane,dtlint"
     )
     sections = os.getenv(
         "DLROVER_TPU_BENCH_SECTIONS", default_sections
@@ -2352,6 +2405,8 @@ def main():
                 extra["straggler"] = section_straggler()
             elif name == "remediation":
                 extra["remediation"] = section_remediation()
+            elif name == "brain":
+                extra["brain"] = section_brain()
             elif name == "master_scale":
                 extra["master_scale"] = section_master_scale()
             elif name == "data_plane":

@@ -1,4 +1,14 @@
+from dlrover_tpu.brain.autoconf import recommend_start_config
 from dlrover_tpu.brain.client import BrainClient, BrainResourceOptimizer
+from dlrover_tpu.brain.policy import BrainPolicy
 from dlrover_tpu.brain.service import BrainService
+from dlrover_tpu.brain.store import BrainMetricsStore
 
-__all__ = ["BrainService", "BrainClient", "BrainResourceOptimizer"]
+__all__ = [
+    "BrainService",
+    "BrainClient",
+    "BrainResourceOptimizer",
+    "BrainPolicy",
+    "BrainMetricsStore",
+    "recommend_start_config",
+]

@@ -16,11 +16,18 @@ from typing import Callable, Dict, List
 Algorithm = Callable[[List[Dict]], Dict]
 
 _ALGORITHMS: Dict[str, Algorithm] = {}
+_PRIORITIES: Dict[str, int] = {}
 
 
-def register_algorithm(name: str):
+def register_algorithm(name: str, priority: int = 0):
+    """Register an algorithm. ``priority`` fixes the merge stage:
+    within a stage algorithms merge in name order, higher stages merge
+    after (and so override) lower ones — refinement passes like
+    hot-node differentiation belong in a later stage."""
+
     def deco(fn: Algorithm) -> Algorithm:
         _ALGORITHMS[name] = fn
+        _PRIORITIES[name] = priority
         return fn
 
     return deco
@@ -29,19 +36,24 @@ def register_algorithm(name: str):
 def run_all(records: List[Dict]) -> Dict:
     """Run every registered algorithm and merge their partial plans.
 
-    The merged plan carries per-algorithm provenance: ``provenance``
-    maps each top-level plan key to the algorithm that (last) wrote it,
-    so a consumer can see which of the library's strategies produced
-    each recommendation (parity: the reference's per-optalgorithm
-    OptimizeJobMeta attribution)."""
+    The merge order is deterministic — ``(priority, name)``, never
+    registration (= import) order — so the plan cannot change shape
+    because a test imported a plugin module first. The merged plan
+    carries per-algorithm provenance: ``provenance`` maps each
+    top-level plan key to the ordered list of EVERY algorithm that
+    wrote it (last entry holds the final value), so a consumer sees
+    both who won a contested key and who else had an opinion (parity:
+    the reference's per-optalgorithm OptimizeJobMeta attribution)."""
     plan: Dict = {}
-    provenance: Dict[str, str] = {}
-    for name, fn in _ALGORITHMS.items():
-        out = fn(records)
+    provenance: Dict[str, List[str]] = {}
+    for name in sorted(
+        _ALGORITHMS, key=lambda n: (_PRIORITIES.get(n, 0), n)
+    ):
+        out = _ALGORITHMS[name](records)
         if out:
             plan.update(out)
             for key in out:
-                provenance[key] = name
+                provenance.setdefault(key, []).append(name)
     if plan:
         plan["provenance"] = provenance
     return plan
@@ -64,7 +76,7 @@ def percentile_sizing(records: List[Dict]) -> Dict:
     }
 
 
-@register_algorithm("hot_node_resource")
+@register_algorithm("hot_node_resource", priority=10)
 def hot_node_resource(
     records: List[Dict],
     hot_ratio: float = 1.5,
@@ -112,8 +124,8 @@ def hot_node_resource(
         return {}
     # The uniform worker plan must come from the NON-hot population —
     # sizing every worker for the outlier is exactly the waste this
-    # algorithm exists to remove (it runs after percentile_sizing and
-    # overrides its rows).
+    # algorithm exists to remove (priority 10: it merges after
+    # percentile_sizing and overrides its rows).
     normal = [
         r for node, rows in per_node.items() if node not in hot
         for r in rows

@@ -1,0 +1,202 @@
+"""Job-start auto-configuration: the Brain's ``--auto-tunning`` half.
+
+Given what we know about a model (a :class:`~dlrover_tpu.accel.search.
+ModelProfile`, or just a parameter count) and what the fleet offers
+(devices, HBM), recommend the ParallelSpec, world size and batch
+configuration a job should *start* with — before its first rendezvous,
+instead of discovering a wrong world size the expensive way. The
+analytic half runs :func:`~dlrover_tpu.accel.search.search_spec` at
+every candidate world size; the empirical half blends in observed
+throughput from same-named prior jobs (``world_perf`` records in the
+:class:`~dlrover_tpu.brain.store.BrainMetricsStore`): where history has
+seen a world size, its measured samples/s replaces the model's guess,
+and a single calibration factor (median observed/predicted ratio)
+de-biases the analytic curve everywhere else — so a systematically
+optimistic cost model cannot keep recommending worlds the fleet has
+already proven don't pay.
+
+The target-world rule is the same marginal-goodput test the runtime
+policy applies: keep growing while each added node delivers at least
+``BRAIN_GROW_EFFICIENCY`` of linear scaling; stop at the knee. Worlds
+whose best spec does not fit HBM are rejected outright (infeasible,
+not merely slow) — unless *no* world fits, which is reported as
+``feasible: False`` rather than a silently-oversubscribed plan.
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.log import logger
+
+#: History record kind the policy persists and this module blends.
+WORLD_PERF_KIND = "world_perf"
+
+
+def profile_from_dict(d: Optional[Dict[str, Any]]):
+    """A ``ModelProfile`` from its asdict/wire form (unknown keys
+    dropped — the rescale coordinator's journal-compat convention)."""
+    from dlrover_tpu.accel.search import ModelProfile
+
+    d = d or {}
+    fields = {f.name for f in dataclasses.fields(ModelProfile)}
+    known = {k: v for k, v in d.items() if k in fields}
+    if not known.get("param_count"):
+        return None
+    if len(known) == 1:
+        return ModelProfile.from_params(int(known["param_count"]))
+    return ModelProfile(**known)
+
+
+def observed_world_perf(
+    records: List[Dict[str, Any]],
+) -> Dict[int, float]:
+    """Median observed samples/s per world size from the job history
+    (``world_perf`` records; ``training_speed`` records that carry a
+    ``world_size`` count too)."""
+    import statistics
+
+    per_world: Dict[int, List[float]] = {}
+    for r in records:
+        if r.get("kind") not in (WORLD_PERF_KIND, "training_speed"):
+            continue
+        world = int(r.get("world_size", 0))
+        speed = float(r.get("samples_per_s", 0.0))
+        if world > 0 and speed > 0:
+            per_world.setdefault(world, []).append(speed)
+    return {
+        w: statistics.median(v[-32:]) for w, v in per_world.items()
+    }
+
+
+def recommend_start_config(
+    records: List[Dict[str, Any]],
+    n_nodes: int,
+    devices_per_node: int = 1,
+    hbm: float = 16e9,
+    global_batch: int = 0,
+    model: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """The start recommendation for one job, as a plain JSON-able dict.
+
+    ``records`` is the job's brain history (may be empty — the
+    recommendation is then purely analytic). ``n_nodes`` is the fleet
+    ceiling: the recommendation never exceeds it, and deliberately may
+    come in under it. Returns ``{}`` when there is no model to size
+    against (no ``model`` dict and no ``model_info`` history).
+    """
+    model = dict(model or {})
+    if not model.get("param_count"):
+        # Fall back to the newest model_info the job ever reported.
+        for r in reversed(records):
+            if r.get("kind") == "model_info" and r.get("param_count"):
+                model = {**r, **model}
+                break
+    profile = profile_from_dict(model)
+    if profile is None:
+        return {}
+    if global_batch <= 0:
+        global_batch = int(model.get("global_batch", 0)) or next(
+            (int(r["batch_size"]) for r in reversed(records)
+             if r.get("kind") == "training_speed"
+             and r.get("batch_size")), 32,
+        )
+    devices_per_node = max(1, int(devices_per_node))
+    observed = observed_world_perf(records)
+
+    from dlrover_tpu.accel.search import search_spec
+
+    candidates: List[Dict[str, Any]] = []
+    for nodes in range(1, max(1, int(n_nodes)) + 1):
+        n_dev = nodes * devices_per_node
+        top = search_spec(
+            profile, n_dev, global_batch, hbm, top_k=1,
+            devices_per_host=devices_per_node,
+        )
+        if not top:
+            continue
+        spec, est = top[0]
+        predicted = global_batch / max(est.step_s, 1e-9)
+        candidates.append({
+            "world_size": nodes,
+            "n_devices": n_dev,
+            "spec": dataclasses.asdict(spec),
+            "est_step_s": round(est.step_s, 6),
+            "predicted_samples_per_s": round(predicted, 3),
+            "fits_hbm": est.fits(hbm),
+            "hbm_bytes_needed": round(est.total_bytes),
+        })
+
+    feasible = [c for c in candidates if c["fits_hbm"]]
+    if not candidates:
+        return {}
+    if not feasible:
+        worst = min(candidates, key=lambda c: c["hbm_bytes_needed"])
+        logger.warning(
+            "brain autoconf: no world size up to %d fits %.1f GB HBM "
+            "(closest needs %.1f GB at world %d)", n_nodes, hbm / 1e9,
+            worst["hbm_bytes_needed"] / 1e9, worst["world_size"],
+        )
+        return {
+            "feasible": False,
+            "reason": "no candidate world fits HBM",
+            "global_batch": global_batch,
+            "closest": worst,
+            "candidates": candidates,
+        }
+
+    # De-bias the analytic curve with whatever history has measured.
+    calibration = 1.0
+    ratios = []
+    by_world = {c["world_size"]: c for c in candidates}
+    for world, speed in observed.items():
+        c = by_world.get(world)
+        if c and c["predicted_samples_per_s"] > 0:
+            ratios.append(speed / c["predicted_samples_per_s"])
+    if ratios:
+        import statistics
+
+        calibration = statistics.median(ratios)
+    blended_from_history = False
+    for c in feasible:
+        if c["world_size"] in observed:
+            c["samples_per_s"] = round(observed[c["world_size"]], 3)
+            c["source"] = "observed"
+            blended_from_history = True
+        else:
+            c["samples_per_s"] = round(
+                c["predicted_samples_per_s"] * calibration, 3
+            )
+            c["source"] = "predicted"
+
+    # Marginal-goodput knee: grow while each extra node pays its way.
+    efficiency = env_utils.BRAIN_GROW_EFFICIENCY.get()
+    best = feasible[0]
+    for c in feasible[1:]:
+        added = c["world_size"] - best["world_size"]
+        linear_gain = best["samples_per_s"] * added / best["world_size"]
+        if c["samples_per_s"] - best["samples_per_s"] >= (
+            efficiency * linear_gain
+        ):
+            best = c
+        # A non-paying size does not end the walk: a larger world can
+        # unlock a better spec (a new factorization) and clear the bar
+        # against the incumbent.
+
+    spec = best["spec"]
+    replicas = max(1, spec.get("data", 1) * spec.get("fsdp", 1))
+    return {
+        "feasible": True,
+        "world_size": best["world_size"],
+        "n_devices": best["n_devices"],
+        "spec": spec,
+        "global_batch": global_batch,
+        "micro_batch": max(1, global_batch // replicas),
+        "est_step_s": best["est_step_s"],
+        "samples_per_s": best["samples_per_s"],
+        "calibration": round(calibration, 4),
+        "source": (
+            "history-blended" if blended_from_history else "searched"
+        ),
+        "candidates": feasible,
+    }

@@ -9,7 +9,11 @@ stats to the service with no master-side coupling.
 
 from typing import Dict, Optional
 
-from dlrover_tpu.brain.service import BrainOptimizeRequest, BrainPersist
+from dlrover_tpu.brain.service import (
+    BrainConfigRequest,
+    BrainOptimizeRequest,
+    BrainPersist,
+)
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RpcClient
 from dlrover_tpu.master.scaling import ResourcePlan
@@ -31,6 +35,18 @@ class BrainClient:
 
     def get_optimization_plan(self, job_name: str) -> Dict:
         return self._rpc.call(BrainOptimizeRequest(job_name=job_name))
+
+    def get_start_config(self, job_name: str, n_nodes: int,
+                         devices_per_node: int = 1, hbm: float = 16e9,
+                         global_batch: int = 0,
+                         model: Optional[Dict] = None) -> Dict:
+        """Pre-launch auto-configuration (the ``--auto-tunning`` ask):
+        world size, ParallelSpec and batch for a job about to start."""
+        return self._rpc.call(BrainConfigRequest(
+            job_name=job_name, n_nodes=n_nodes,
+            devices_per_node=devices_per_node, hbm=hbm,
+            global_batch=global_batch, model=dict(model or {}),
+        ))
 
     def close(self):
         self._rpc.close()

@@ -1,0 +1,813 @@
+"""Runtime brain policy: goodput-driven auto-scaling on the master.
+
+The reactive planes (remediation, rescale, preemption) answer "the
+world changed — now what"; this policy answers the question none of
+them ask: **is the world the right size at all?** Ticked off the
+master's node-monitor loop, it maintains a *target world size* and
+steers the fleet toward it through the existing elastic machinery:
+
+- **grow** — while tokens/s still scales. The policy never admits
+  nodes itself: it raises the target, and the servicer's join gate
+  (:meth:`gated_join`) simply stops parking joiners, so the next join
+  poll regrows the world through the ordinary
+  ``RescaleCoordinator.on_node_joined`` path. Each admitted grow is
+  journaled and the fleet cooldown armed.
+- **shrink** — when a chip's marginal contribution goes negative. Two
+  triggers: a node whose step-phase drag exceeds what its 1/N compute
+  contributes (``StragglerDetector.step_drag``: in a synchronous
+  collective the world steps at the slowest member's pace), and an
+  oversized world (observed throughput at N failed the
+  ``BRAIN_GROW_EFFICIENCY`` marginal test against N-1, or the start
+  recommendation says fewer chips do the same work). The shrink rides
+  ``can_plan_shrink`` pre-flight + ``on_node_removed``, exactly like a
+  remediation quarantine; the victim is *parked* (join-gated), not
+  killed, and is released only when the fleet runs short of capacity.
+- **target** — derived at first model report by the auto-configuration
+  half (:mod:`dlrover_tpu.brain.autoconf`: strategy search at every
+  candidate world, blended with observed prior-run throughput), then
+  refined live by the same marginal test the recommendation used.
+
+Safety rails mirror :class:`~dlrover_tpu.master.remediation.
+RemediationPolicy`, deliberately: hysteresis (``BRAIN_SUSTAIN_TICKS``
+of a persistent signal before any action), a min-world floor, one
+action per tick, and a **fleet cooldown shared with remediation** —
+the brain defers wholesale while a remediation is in flight or inside
+the shared window (never fights it; a straggler being quarantined is
+remediation's story), and both policies arm each other's stamp when
+they move the world.
+
+Durability: hysteresis streaks and throughput samples are re-derived
+live, but every *decision* (recommend, target, grow, shrink, revert,
+release) is an apply-then-log ``("brain", payload, ts)`` WAL record —
+a failed-over master reproduces the target, the parked set and the
+pending plan exactly once, and never re-shrinks a world that already
+shrank. Throughput history additionally lands in the cross-job
+:class:`~dlrover_tpu.brain.store.BrainMetricsStore` (``world_perf``
+records) so the *next* job of this name starts at the size this one
+converged to.
+"""
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from dlrover_tpu.brain.autoconf import (
+    WORLD_PERF_KIND,
+    recommend_start_config,
+)
+from dlrover_tpu.chaos.injector import fault_hit
+from dlrover_tpu.chaos.sites import ChaosSite
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.lockdep import instrumented_lock
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import EventKind, emit
+
+#: Ticks a world-size change must settle before throughput samples are
+#: trusted again (a mid-transition sample blends two worlds' speeds).
+_SETTLE_TICKS = 2
+
+#: EWMA weight of a fresh throughput sample.
+_EWMA = 0.3
+
+#: Samples a world's throughput needs before the marginal test trusts it.
+_MIN_SAMPLES = 3
+
+
+class BrainPolicy:
+    #: dtlint DT009: decision state (target/parked/pending), the
+    #: throughput ledger and the hysteresis streaks move as one unit
+    #: under the policy lock; counters are exporter bookkeeping folded
+    #: into the same critical sections.
+    GUARDED_BY = {
+        "_target": "master.brain",
+        "_parked": "master.brain",
+        "_pending": "master.brain",
+        "_world_perf": "master.brain",
+        "_streaks": "master.brain",
+        "_actions": "master.brain",
+        "_deferrals": "master.brain",
+        "_model": "master.brain",
+        "_recommendation": "master.brain",
+        "_last_action_ts": "master.brain",
+        "_marginal": "master.brain",
+        "_last_world": "master.brain",
+        "_settle": "master.brain",
+    }
+
+    def __init__(
+        self,
+        job_name: str = "",
+        rdzv_managers: Optional[Dict[str, Any]] = None,
+        rescale_coordinator=None,
+        straggler_detector=None,
+        speed_monitor=None,
+        remediation=None,
+        task_manager=None,
+        shard_lease=None,
+        state_store=None,
+        mutation_locks=None,
+        metrics_store=None,
+    ):
+        self._lock = instrumented_lock("master.brain")
+        self._job = job_name
+        self._rdzv_managers = rdzv_managers or {}
+        self._rescale = rescale_coordinator
+        self._detector = straggler_detector
+        self._speed_monitor = speed_monitor
+        self._remediation = remediation
+        self._task_manager = task_manager
+        self._shard_lease = shard_lease
+        self._store = state_store
+        self._mutation_locks = mutation_locks
+        self._metrics_store = metrics_store
+        # -- guarded decision state --
+        self._target = 0                       # 0 = no opinion yet
+        self._parked: Dict[int, Dict[str, Any]] = {}
+        self._pending: Dict[str, int] = {"plan_id": -1, "node": -1}
+        self._world_perf: Dict[int, Dict[str, float]] = {}
+        self._streaks: Dict[str, int] = {}
+        self._actions: Dict[str, int] = {}
+        self._deferrals: Dict[str, int] = {}
+        self._model: Dict[str, Any] = {}
+        self._recommendation: Dict[str, Any] = {}
+        self._last_action_ts = 0.0
+        self._marginal = 1.0
+        self._last_world = 0
+        self._settle = 0
+
+    # ---------------- journal plumbing ----------------
+    @property
+    def _replaying(self) -> bool:
+        return self._store is not None and self._store.replaying
+
+    def _journal(self, payload: Dict[str, Any]):
+        if self._store is not None and not self._store.replaying:
+            self._store.append(("brain", payload, time.time()))
+
+    # ---------------- inputs ----------------
+    def set_model_config(self, profile: Dict[str, Any], hbm: float = 0.0,
+                         global_batch: int = 0, spec: Optional[Dict] = None):
+        """The trainer's ModelInfo extras (servicer feed, live-only —
+        the RPC is not journaled). Not durable on purpose: only the
+        *recommendation* derived from it is journaled; a failed-over
+        master keeps the journaled target and re-learns the profile
+        from the fleet's next report."""
+        with self._lock:
+            if profile:
+                self._model["profile"] = dict(profile)
+            if hbm > 0:
+                self._model["hbm"] = float(hbm)
+            if global_batch > 0:
+                self._model["global_batch"] = int(global_batch)
+            if spec:
+                self._model["spec"] = dict(spec)
+
+    # ---------------- queries ----------------
+    def gated_join(self, node_rank: int,
+                   current_world: Dict[int, int]) -> bool:
+        """True while a join must park: the node was brain-shrunk out,
+        or the world already sits at the target and this join would
+        grow past it. The servicer's join-gate hook — target changes
+        are how the brain 'issues' grow decisions."""
+        if not env_utils.BRAIN.get():
+            return False
+        with self._lock:
+            if int(node_rank) in self._parked:
+                return True
+            target = self._target
+        if target <= 0:
+            return False
+        return (
+            int(node_rank) not in current_world
+            and len(current_world) >= target
+        )
+
+    def target_world(self) -> int:
+        with self._lock:
+            return self._target
+
+    def parked(self) -> Dict[int, Dict[str, Any]]:
+        with self._lock:
+            return {wid: dict(rec) for wid, rec in self._parked.items()}
+
+    def recommendation(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._recommendation)
+
+    def status(self) -> Dict[str, Any]:
+        """One JSON-able view for drills/tests/the status RPC."""
+        with self._lock:
+            return {
+                "target": self._target,
+                "parked": {
+                    str(w): dict(r) for w, r in self._parked.items()
+                },
+                "pending": dict(self._pending),
+                "actions": dict(self._actions),
+                "deferrals": dict(self._deferrals),
+                "marginal": round(self._marginal, 4),
+                "recommendation": {
+                    k: v for k, v in self._recommendation.items()
+                    if k != "candidates"
+                },
+                "world_perf": {
+                    str(w): round(p["samples_per_s"], 3)
+                    for w, p in self._world_perf.items()
+                },
+            }
+
+    # ---------------- lifecycle hooks ----------------
+    def on_grow_admitted(self, node_rank: int, new_world_size: int):
+        """The servicer admitted a join that grew an actively-training
+        world while the brain holds the gate: the grow *is* the brain's
+        decision (the target made it admissible), so journal it and arm
+        the shared cooldown. Live-only caller (joins are not journaled;
+        on replay the rescale coordinator declines the plan)."""
+        now = time.time()
+        with self._lock:
+            self._last_action_ts = now
+            self._actions["grow"] = self._actions.get("grow", 0) + 1
+        if self._remediation is not None:
+            self._remediation.note_fleet_action(now)
+        self._journal({
+            "rec": "grow", "node": int(node_rank),
+            "world": int(new_world_size), "act_ts": now,
+        })
+        logger.info(
+            "brain: grow admitted — node %s joins, world -> %d "
+            "(target %d)", node_rank, new_world_size, self.target_world(),
+        )
+        emit(
+            EventKind.BRAIN_GROW, _node_id=int(node_rank), _role="master",
+            world=int(new_world_size), target=self.target_world(),
+        )
+
+    def on_node_evicted(self, node_rank: int):
+        """An eviction landed through any path: a parked node that got
+        evicted is gone for real — drop its record so the gate does not
+        outlive the node. Replay-pure (reached from the journaled
+        ``("evict", ...)`` record)."""
+        with self._lock:
+            self._parked.pop(int(node_rank), None)
+            if self._pending.get("node") == int(node_rank):
+                self._pending = {"plan_id": -1, "node": -1}
+
+    # ---------------- the tick ----------------
+    def tick(self, now: Optional[float] = None):
+        """One policy pass (master monitor loop, after remediation).
+        Collect under the lock, act outside it; at most one world
+        action per tick."""
+        if self._replaying or not env_utils.BRAIN.get():
+            return
+        now = now if now is not None else time.time()
+        training = self._rdzv_managers.get(RendezvousName.TRAINING)
+        if training is None:
+            return
+        world = training.current_world()
+        n = len(world)
+        waiting = 0
+        num_waiting = getattr(training, "num_nodes_waiting", None)
+        if num_waiting is not None:
+            try:
+                waiting = int(num_waiting())
+            except Exception:  # dtlint: disable=DT001 -- advisory input: a racing rendezvous restart must not kill the policy tick
+                waiting = 0
+        self._observe(n, now)
+        pending_plan, pending_node = self._pending_snapshot()
+        if pending_plan >= 0:
+            self._settle_shrink(pending_node, pending_plan, now)
+        if n == 0:
+            return
+        self._maybe_recommend(n, waiting, now)
+        # -- deference: never fight remediation, honor the cooldown --
+        if self._remediation is not None and self._remediation.acting():
+            self._defer("remediation")
+            return
+        last_fleet = self._last_action_snapshot()
+        if self._remediation is not None:
+            last_fleet = max(last_fleet, self._remediation.last_action_ts())
+        if now - last_fleet < env_utils.BRAIN_COOLDOWN_S.get():
+            self._defer("cooldown")
+            return
+        if self._pending_snapshot()[0] >= 0:
+            self._defer("plan-in-flight")
+            return
+        action = self._decide(n, waiting, now)
+        if action is None:
+            return
+        kind = action[0]
+        if kind == "shrink":
+            _, wid, drag, reason = action
+            self._do_shrink(wid, drag, reason, now)
+        elif kind == "target":
+            _, new_target, reason = action
+            self._retarget(new_target, reason, now)
+        elif kind == "release":
+            _, wid = action
+            self._release(wid, now)
+
+    # -- observation --
+    def _observe(self, n: int, now: float):
+        """Fold one throughput sample into the per-world ledger, with a
+        settle window after any world-size change."""
+        speed = 0.0
+        if self._speed_monitor is not None:
+            speed = float(self._speed_monitor.running_speed() or 0.0)
+        sample = None
+        with self._lock:
+            if n != self._last_world:
+                self._last_world = n
+                self._settle = _SETTLE_TICKS
+            elif self._settle > 0:
+                self._settle -= 1
+            elif speed > 0 and n > 0:
+                perf = self._world_perf.setdefault(
+                    n, {"samples_per_s": speed, "n": 0.0}
+                )
+                perf["samples_per_s"] = (
+                    (1 - _EWMA) * perf["samples_per_s"] + _EWMA * speed
+                )
+                perf["n"] += 1
+                if int(perf["n"]) % 4 == 1:
+                    sample = (n, perf["samples_per_s"])
+        if sample is not None and self._metrics_store is not None:
+            self._metrics_store.append(self._job, {
+                "kind": WORLD_PERF_KIND, "ts": now,
+                "world_size": sample[0],
+                "samples_per_s": round(sample[1], 3),
+            })
+
+    def _pending_snapshot(self):
+        with self._lock:
+            return self._pending["plan_id"], self._pending["node"]
+
+    def _last_action_snapshot(self) -> float:
+        with self._lock:
+            return self._last_action_ts
+
+    def _defer(self, reason: str):
+        with self._lock:
+            self._deferrals[reason] = self._deferrals.get(reason, 0) + 1
+
+    # -- start recommendation --
+    def _maybe_recommend(self, n: int, waiting: int, now: float):
+        """First model report -> run the auto-configuration half once
+        and seed the target from it (journaled)."""
+        with self._lock:
+            if self._recommendation or "profile" not in self._model:
+                return
+            model = dict(self._model.get("profile", {}))
+            model["global_batch"] = self._model.get("global_batch", 0)
+            hbm = float(self._model.get("hbm", 0.0)) or 16e9
+            spec = self._model.get("spec", {})
+            n_parked = len(self._parked)
+        devices = 1
+        for axis in ("data", "fsdp", "tensor", "seq", "expert", "pipe"):
+            devices *= max(1, int(spec.get(axis, 1)))
+        dpn = max(1, devices // max(1, n)) if spec else 1
+        ceiling = max(1, n + waiting + n_parked)
+        records = (
+            self._metrics_store.records(self._job)
+            if self._metrics_store is not None else []
+        )
+        rec = recommend_start_config(
+            records, ceiling, devices_per_node=dpn, hbm=hbm,
+            global_batch=int(model.get("global_batch", 0)), model=model,
+        )
+        if not rec:
+            return
+        public = {k: v for k, v in rec.items() if k != "candidates"}
+        with self._lock:
+            self._recommendation = public
+        self._journal({"rec": "recommend", "config": public})
+        self._count("recommend")
+        emit(
+            EventKind.BRAIN_RECOMMEND, _role="master",
+            feasible=bool(rec.get("feasible")),
+            world_size=int(rec.get("world_size", 0)),
+            source=rec.get("source", ""),
+            est_step_s=rec.get("est_step_s", 0.0),
+        )
+        if rec.get("feasible"):
+            logger.info(
+                "brain: start recommendation — world %d (%s, est %.1f "
+                "ms/step, calibration %.2f)", rec["world_size"],
+                rec["source"], rec["est_step_s"] * 1e3,
+                rec.get("calibration", 1.0),
+            )
+            self._retarget(
+                int(rec["world_size"]), "recommendation", now,
+            )
+
+    # -- decision --
+    def _decide(self, n: int, waiting: int, now: float):
+        """The signal table, hysteresis included. Lock held only to
+        read/advance streaks; returns the action to run outside."""
+        drags = {}
+        if self._detector is not None:
+            drag_fn = getattr(self._detector, "step_drag", None)
+            if drag_fn is not None:
+                drags = drag_fn() or {}
+        training = self._rdzv_managers.get(RendezvousName.TRAINING)
+        world = training.current_world() if training is not None else {}
+        drags = {w: d for w, d in drags.items() if w in world}
+        sustain = env_utils.BRAIN_SUSTAIN_TICKS.get()
+        floor = env_utils.BRAIN_MIN_WORLD.get()
+        eff = env_utils.BRAIN_GROW_EFFICIENCY.get()
+        thresh = max(
+            env_utils.BRAIN_SHRINK_DRAG_PCT.get(), 100.0 / max(n, 1)
+        ) / 100.0
+        worst_wid, worst_drag = -1, 0.0
+        if drags:
+            worst_wid = max(drags, key=lambda w: drags[w])
+            worst_drag = drags[worst_wid]
+        with self._lock:
+            target = self._target
+            marginal = self._marginal_locked(n)
+            if marginal is not None:
+                self._marginal = marginal
+            # Signal 1: a chip whose drag costs more than it contributes.
+            if worst_drag > thresh and n - 1 >= floor:
+                streak = self._bump("shrink_drag")
+                if streak >= sustain:
+                    return ("shrink", worst_wid, worst_drag,
+                            f"drag {worst_drag:.0%} > {thresh:.0%}")
+            else:
+                self._streaks.pop("shrink_drag", None)
+            # Signal 2: the world overshot the target (recommendation or
+            # a failed marginal test said fewer chips do the same work).
+            if target > 0 and n > target and n - 1 >= floor:
+                streak = self._bump("shrink_oversize")
+                if streak >= sustain:
+                    wid = worst_wid if worst_wid >= 0 else max(world)
+                    return ("shrink", wid, worst_drag,
+                            f"world {n} > target {target}")
+            else:
+                self._streaks.pop("shrink_oversize", None)
+            # Signal 3: the last grow did not pay -> pull the target in.
+            if (
+                marginal is not None and target >= n
+                and marginal < eff and n - 1 >= floor
+            ):
+                streak = self._bump("detarget")
+                if streak >= sustain:
+                    return ("target", n - 1,
+                            f"marginal {marginal:.2f} < {eff:.2f}")
+            else:
+                self._streaks.pop("detarget", None)
+            # Signal 4: at target, spare capacity waiting, scaling still
+            # paying -> probe one node higher.
+            if (
+                target > 0 and n >= target and waiting > 0
+                and (marginal is None or marginal >= eff)
+            ):
+                streak = self._bump("uptarget")
+                if streak >= sustain:
+                    return ("target", n + 1, "tokens/s still scaling")
+            else:
+                self._streaks.pop("uptarget", None)
+            # Signal 5: fleet short of target with nobody waiting ->
+            # release the longest-parked node back into the pool.
+            if target > 0 and n < target and waiting == 0 and self._parked:
+                candidates = {
+                    w: r for w, r in self._parked.items()
+                    if w != self._pending.get("node")
+                }
+                if candidates:
+                    streak = self._bump("release")
+                    if streak >= sustain:
+                        wid = min(
+                            candidates, key=lambda w: candidates[w]["ts"]
+                        )
+                        return ("release", wid)
+            else:
+                self._streaks.pop("release", None)
+        return None
+
+    def _bump(self, name: str) -> int:  # dtlint: holds(master.brain)
+        self._streaks[name] = self._streaks.get(name, 0) + 1
+        return self._streaks[name]
+
+    def _marginal_locked(self, n: int) -> Optional[float]:  # dtlint: holds(master.brain)
+        """Observed marginal scaling of the current world vs the largest
+        smaller world with trusted samples: 1.0 = perfectly linear,
+        0 = the added chips bought nothing, negative = they cost
+        throughput. None until both worlds have settled samples."""
+        cur = self._world_perf.get(n)
+        if cur is None or cur["n"] < _MIN_SAMPLES:
+            return None
+        smaller = [
+            w for w, p in self._world_perf.items()
+            if w < n and p["n"] >= _MIN_SAMPLES
+        ]
+        if not smaller:
+            return None
+        m = max(smaller)
+        prev = self._world_perf[m]
+        linear_gain = prev["samples_per_s"] * (n - m) / m
+        if linear_gain <= 0:
+            return None
+        return (cur["samples_per_s"] - prev["samples_per_s"]) / linear_gain
+
+    # ---------------- actions ----------------
+    def _retarget(self, new_target: int, reason: str, now: float):
+        with self._lock:
+            old = self._target
+            if new_target == old:
+                return
+            self._target = int(new_target)
+            self._streaks.clear()
+            self._last_action_ts = now
+        self._journal({
+            "rec": "target", "target": int(new_target), "reason": reason,
+            "act_ts": now,
+        })
+        logger.info(
+            "brain: target world %d -> %d (%s)", old, new_target, reason,
+        )
+        emit(
+            EventKind.BRAIN_TARGET, _role="master", target=int(new_target),
+            old_target=old, reason=reason,
+        )
+        self._count("target")
+
+    def _do_shrink(self, wid: int, drag: float, reason: str, now: float):
+        """Park one node out of the world through the rescale plane —
+        pre-flighted, chaos-gated, journaled. Mirrors the remediation
+        quarantine action deliberately: same lock span, same decline
+        semantics (a post-pre-flight decline leaves the restart
+        fallback in charge and the policy just counts it)."""
+        training = self._rdzv_managers.get(RendezvousName.TRAINING)
+        old_world = training.current_world() if training is not None else {}
+        if wid not in old_world:
+            return
+        if len(old_world) - 1 < env_utils.BRAIN_MIN_WORLD.get():
+            return
+        if self._rescale is not None:
+            ok, why = self._rescale.can_plan_shrink(wid, old_world)
+            if not ok:
+                logger.info(
+                    "brain: shrink of node %s not plannable (%s); "
+                    "holding", wid, why,
+                )
+                self._count("shrink_declined")
+                return
+        chaos = fault_hit(ChaosSite.BRAIN_ACT, detail=f"node{wid}")
+        if chaos is not None:
+            if chaos.kind == "delay":
+                time.sleep(chaos.delay_s)
+            elif chaos.kind in ("deny", "drop"):
+                logger.warning(
+                    "brain: chaos denied the shrink of node %s this "
+                    "tick", wid,
+                )
+                return
+        locks = self._mutation_locks
+        if locks is not None:
+            # Same span as the eviction path: the apply mutates tasks,
+            # leases, rendezvous and the rescale plane, so it serializes
+            # against concurrent RPC mutations in journal order.
+            with locks.all():
+                plan = self._apply_shrink(wid, old_world)
+        else:
+            plan = self._apply_shrink(wid, old_world)
+        if plan is None:
+            # Declined after the pre-flight (raced config change): the
+            # world already shrank and the stale-round restart fallback
+            # is in charge; nothing to park, nothing to journal.
+            self._count("shrink_declined")
+            return
+        with self._lock:
+            self._parked[wid] = {
+                "ts": now, "reason": reason, "drag": round(drag, 4),
+            }
+            self._pending = {"plan_id": plan.plan_id, "node": wid}
+            self._last_action_ts = now
+            self._streaks.clear()
+        if self._remediation is not None:
+            self._remediation.note_fleet_action(now)
+        self._journal({
+            "rec": "shrink", "node": wid, "plan_id": plan.plan_id,
+            "reason": reason, "drag": round(drag, 4), "act_ts": now,
+        })
+        logger.warning(
+            "brain: shrinking node %s out (%s; plan %s, world %s -> %s); "
+            "parked as spare capacity", wid, reason, plan.plan_id,
+            sorted(old_world), sorted(plan.new_world),
+        )
+        emit(
+            EventKind.BRAIN_SHRINK, _node_id=wid, _role="master",
+            reason=reason, drag=round(drag, 4), plan_id=plan.plan_id,
+            old_world=sorted(old_world), new_world=sorted(plan.new_world),
+        )
+        self._count("shrink")
+
+    def _apply_shrink(self, wid: int, old_world: Dict[int, int]):
+        """Drop the node everywhere the eviction path does — except the
+        node registry and the detector profiles: the agent stays alive
+        (parked capacity keeps heartbeating) and the profile keeps the
+        drag evidence visible."""
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(wid)
+        if self._task_manager is not None:
+            self._task_manager.recover_worker_tasks(wid)
+        if self._shard_lease is not None:
+            self._shard_lease.drop_agent(wid)
+        if self._speed_monitor is not None:
+            self._speed_monitor.remove_worker(wid)
+        if self._rescale is None:
+            return None
+        return self._rescale.on_node_removed(wid, old_world)
+
+    def _settle_shrink(self, wid: int, plan_id: int, now: float):
+        """Poll the in-flight shrink plan: complete confirms the park;
+        aborted unparks the node (journaled revert) so the fleet can
+        reform with it — never a stuck state."""
+        if self._rescale is None:
+            return
+        status = self._rescale.plan_status(plan_id)
+        if status == "complete":
+            with self._lock:
+                if self._pending["plan_id"] == plan_id:
+                    self._pending = {"plan_id": -1, "node": -1}
+        elif status == "aborted" or status is None:
+            with self._lock:
+                if self._pending["plan_id"] != plan_id:
+                    return
+                self._pending = {"plan_id": -1, "node": -1}
+                self._parked.pop(wid, None)
+            self._journal({
+                "rec": "revert", "node": wid,
+                "reason": f"plan-{plan_id}-aborted",
+            })
+            logger.warning(
+                "brain: shrink plan %s for node %s aborted; released "
+                "back to the fleet", plan_id, wid,
+            )
+            emit(
+                EventKind.BRAIN_REVERT, _node_id=wid, _role="master",
+                plan_id=plan_id, reason="plan-aborted",
+            )
+            self._count("revert")
+
+    def _release(self, wid: int, now: float):
+        """Parked spare capacity is needed again: lift the node's gate
+        (its next join poll regrows the world through the ordinary
+        path, which journals the grow)."""
+        with self._lock:
+            if self._parked.pop(wid, None) is None:
+                return
+            self._last_action_ts = now
+            self._streaks.clear()
+        self._journal({"rec": "release", "node": wid, "act_ts": now})
+        logger.info(
+            "brain: releasing parked node %s (fleet short of target %d)",
+            wid, self.target_world(),
+        )
+        emit(
+            EventKind.BRAIN_RELEASE, _node_id=wid, _role="master",
+            target=self.target_world(),
+        )
+        self._count("release")
+
+    def _count(self, action: str):
+        with self._lock:
+            self._actions[action] = self._actions.get(action, 0) + 1
+
+    # ---------------- durability ----------------
+    def checkpoint(self) -> dict:
+        with self._lock:
+            return {
+                "target": self._target,
+                "parked": {
+                    str(w): dict(r) for w, r in self._parked.items()
+                },
+                "pending": dict(self._pending),
+                "last_action_ts": self._last_action_ts,
+                "actions": dict(self._actions),
+                "recommendation": dict(self._recommendation),
+            }
+
+    def restore(self, state: dict):
+        if not state:
+            return
+        with self._lock:
+            self._target = int(state.get("target", self._target))
+            for wid, rec in state.get("parked", {}).items():
+                self._parked[int(wid)] = dict(rec)
+            pending = state.get("pending")
+            if pending:
+                self._pending = {
+                    "plan_id": int(pending.get("plan_id", -1)),
+                    "node": int(pending.get("node", -1)),
+                }
+            self._last_action_ts = max(
+                self._last_action_ts,
+                float(state.get("last_action_ts", 0.0)),
+            )
+            for action, count in state.get("actions", {}).items():
+                self._actions[action] = max(
+                    self._actions.get(action, 0), int(count)
+                )
+            if state.get("recommendation"):
+                self._recommendation = dict(state["recommendation"])
+
+    def replay(self, payload: Dict[str, Any]):
+        """Re-apply one journaled ``("brain", payload, ts)`` record.
+        Pure bookkeeping — no emits, no rendezvous/rescale side effects
+        (those replay from their own records): only the decision state
+        moves, so a failed-over master holds exactly the target, parked
+        set and pending plan it held before."""
+        rec = payload.get("rec")
+        with self._lock:
+            if rec == "recommend":
+                self._recommendation = dict(payload.get("config", {}))
+            elif rec == "target":
+                self._target = int(payload.get("target", self._target))
+                self._last_action_ts = max(
+                    self._last_action_ts,
+                    float(payload.get("act_ts", 0.0)),
+                )
+            elif rec == "shrink":
+                wid = int(payload.get("node", -1))
+                self._parked[wid] = {
+                    "ts": float(payload.get("act_ts", 0.0)),
+                    "reason": payload.get("reason", ""),
+                    "drag": float(payload.get("drag", 0.0)),
+                }
+                self._pending = {
+                    "plan_id": int(payload.get("plan_id", -1)),
+                    "node": wid,
+                }
+                self._last_action_ts = max(
+                    self._last_action_ts,
+                    float(payload.get("act_ts", 0.0)),
+                )
+                self._actions["shrink"] = self._actions.get(
+                    "shrink", 0
+                ) + 1
+            elif rec == "grow":
+                self._last_action_ts = max(
+                    self._last_action_ts,
+                    float(payload.get("act_ts", 0.0)),
+                )
+                self._actions["grow"] = self._actions.get("grow", 0) + 1
+            elif rec == "revert":
+                wid = int(payload.get("node", -1))
+                self._parked.pop(wid, None)
+                if self._pending.get("node") == wid:
+                    self._pending = {"plan_id": -1, "node": -1}
+            elif rec == "release":
+                wid = int(payload.get("node", -1))
+                self._parked.pop(wid, None)
+                self._last_action_ts = max(
+                    self._last_action_ts,
+                    float(payload.get("act_ts", 0.0)),
+                )
+            else:
+                logger.warning("skipping unknown brain record %r", rec)
+
+    # ---------------- outputs ----------------
+    def metrics(self) -> List:
+        """Exporter gauges (appended by the ObservabilityPlane)."""
+        with self._lock:
+            target = float(self._target)
+            marginal = float(self._marginal)
+            parked = float(len(self._parked))
+            actions = dict(self._actions)
+            deferrals = dict(self._deferrals)
+        return [
+            (
+                "dlrover_tpu_brain_target_world", "gauge",
+                "World size the brain policy is steering toward "
+                "(0 = no recommendation yet).",
+                [(None, target)],
+            ),
+            (
+                "dlrover_tpu_brain_marginal_ratio", "gauge",
+                "Observed marginal scaling of the current world vs the "
+                "last smaller one (1 = linear, <0 = added chips cost "
+                "throughput).",
+                [(None, marginal)],
+            ),
+            (
+                "dlrover_tpu_brain_parked_nodes", "gauge",
+                "Nodes the brain shrank out and holds as parked spare "
+                "capacity.",
+                [(None, parked)],
+            ),
+            (
+                "dlrover_tpu_brain_actions_total", "counter",
+                "Brain decisions acted on since master start.",
+                [({"action": a}, float(v))
+                 for a, v in sorted(actions.items())] or [(None, 0.0)],
+            ),
+            (
+                "dlrover_tpu_brain_deferrals_total", "counter",
+                "Ticks the brain deferred instead of deciding, by "
+                "reason (remediation in flight, shared cooldown, plan "
+                "in flight).",
+                [({"reason": r}, float(v))
+                 for r, v in sorted(deferrals.items())] or [(None, 0.0)],
+            ),
+        ]

@@ -5,23 +5,29 @@ service + ``dlrover/python/brain/client``): jobs persist their runtime
 metrics to a store; an optimize endpoint turns a job's history into
 resource plans that outlive any single master (new jobs of the same name
 start from the last job's observed needs — the cross-job learning the
-Brain exists for).
+Brain exists for); a config endpoint turns a model profile plus that
+history into a *start* configuration (ParallelSpec, world size, batch) —
+the ``--auto-tunning`` analogue, answered before the job's first
+rendezvous.
 
-Condensed TPU-first cut: same RPC transport as the control plane, an
-in-process/on-disk store instead of MySQL, and the optimizer strategy is
-percentile-over-history sizing (the reference's simplest strategy) —
-pluggable for anything smarter.
+Condensed TPU-first cut: same RPC transport as the control plane, a
+crc-framed append-only store (:class:`~dlrover_tpu.brain.store.
+BrainMetricsStore`) instead of MySQL — fsynced on a periodic cadence by
+a saver thread, not only on ``stop()`` — and the optimizer strategies
+live in the pluggable ``brain/algorithms.py`` library.
 """
 
-import json
-import os
 import threading
 import time
 from collections import defaultdict, deque
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, List
 
+from dlrover_tpu.brain.autoconf import recommend_start_config
+from dlrover_tpu.brain.store import BrainMetricsStore
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.lockdep import instrumented_lock
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RpcServer
 
@@ -38,21 +44,79 @@ class BrainOptimizeRequest(m.BaseRequest):
     job_name: str = ""
 
 
-class BrainService:
-    """Metrics store + optimize endpoint over the shared RPC transport."""
+@dataclass
+class BrainConfigRequest(m.BaseRequest):
+    """Job-start auto-configuration ask: 'this model, this fleet —
+    what world/spec/batch should I start with?' Answered by
+    :func:`~dlrover_tpu.brain.autoconf.recommend_start_config` against
+    the job's persisted history."""
 
-    HISTORY = 2048
+    job_name: str = ""
+    n_nodes: int = 1
+    devices_per_node: int = 1
+    hbm: float = 16e9
+    global_batch: int = 0
+    model: Dict = field(default_factory=dict)
+
+
+class _MemoryStore:
+    """Store-path-less fallback (ephemeral jobs, tests): the same
+    read/write surface as :class:`BrainMetricsStore`, no disk."""
+
+    #: dtlint DT009: the per-job deques serve concurrent RPC handlers.
+    GUARDED_BY = {"_mem": "brain.service"}
+
+    def __init__(self, history: int):
+        self._lock = instrumented_lock("brain.service")
+        self._mem: Dict[str, Deque[Dict[str, Any]]] = defaultdict(
+            lambda: deque(maxlen=history)
+        )
+
+    def append(self, job: str, record: Dict[str, Any]):
+        with self._lock:
+            self._mem[job].append(record)
+
+    def records(self, job: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._mem.get(job, ()))
+
+    def jobs(self) -> List[str]:
+        with self._lock:
+            return sorted(self._mem)
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {job: len(q) for job, q in self._mem.items()}
+
+    def maybe_sync(self, now=None):
+        pass
+
+    def sync(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class BrainService:
+    """Metrics store + optimize/config endpoints over the shared RPC
+    transport. With a ``store_path`` the history is durable across
+    service restarts (crash-safe framed log; a torn tail loses at most
+    ``BRAIN_SAVE_INTERVAL_S`` worth of advisory records, never the
+    file)."""
+
+    #: Saver-thread cadence; the store applies its own sync interval.
+    SAVER_TICK_S = 1.0
 
     def __init__(self, port: int = 0, store_path: str = ""):
-        self._lock = threading.Lock()
-        self._store: Dict[str, Deque[Dict]] = defaultdict(
-            lambda: deque(maxlen=self.HISTORY)
-        )
-        self._store_path = store_path
-        if store_path and os.path.exists(store_path):
-            self._load()
+        if store_path:
+            self.store = BrainMetricsStore(store_path)
+        else:
+            self.store = _MemoryStore(env_utils.BRAIN_HISTORY.get())
         self._server = RpcServer(port, self._handle)
         self.port = self._server.port
+        self._stop_event = threading.Event()
+        self._saver = None
 
     @property
     def addr(self) -> str:
@@ -60,54 +124,63 @@ class BrainService:
 
     def start(self):
         self._server.start()
+        self._stop_event.clear()
+        self._saver = threading.Thread(
+            target=self._saver_loop, name="brain-saver", daemon=True
+        )
+        self._saver.start()
         logger.info("brain service on port %s", self.port)
 
     def stop(self):
-        if self._store_path:
-            self._save()
+        self._stop_event.set()
+        if self._saver is not None:
+            self._saver.join(timeout=5.0)
+            self._saver = None
+        self.store.close()   # final sync — durability no longer *only* here
         self._server.stop()
 
-    # ------------- persistence -------------
-    def _save(self):
-        with self._lock:
-            doc = {job: list(q) for job, q in self._store.items()}
-        tmp = f"{self._store_path}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-        os.replace(tmp, self._store_path)
-
-    def _load(self):
-        try:
-            with open(self._store_path) as f:
-                doc = json.load(f)
-        except (OSError, ValueError):
-            return
-        with self._lock:
-            for job, records in doc.items():
-                self._store[job].extend(records)
+    def _saver_loop(self):
+        """Periodic durability: fsync/compact on the store's cadence, so
+        a SIGKILLed brain keeps everything but the last window (the
+        round-3 design only persisted on a clean ``stop()``)."""
+        while not self._stop_event.wait(self.SAVER_TICK_S):
+            self.store.maybe_sync()
 
     # ------------- rpc -------------
     def _handle(self, req):
         if isinstance(req, BrainPersist):
-            with self._lock:
-                self._store[req.job_name].append(
-                    {"kind": req.kind, "ts": time.time(), **req.payload}
-                )
+            self.store.append(
+                req.job_name,
+                {"kind": req.kind, "ts": time.time(), **req.payload},
+            )
             return True
         if isinstance(req, BrainOptimizeRequest):
             return self.optimize(req.job_name)
+        if isinstance(req, BrainConfigRequest):
+            return self.recommend_config(req)
         raise ValueError(f"brain: unknown request {type(req).__name__}")
 
-    # ------------- strategy -------------
+    # ------------- strategies -------------
     def optimize(self, job_name: str) -> Dict:
         """Resource plan from the job's history: every registered
-        algorithm runs and their partial plans merge (baseline p95
-        sizing + hot-node differentiation; see ``brain/algorithms.py``,
-        parity with the reference's optalgorithm library)."""
+        algorithm runs and their partial plans merge deterministically
+        (baseline p95 sizing + hot-node differentiation; see
+        ``brain/algorithms.py``, parity with the reference's
+        optalgorithm library)."""
         from dlrover_tpu.brain.algorithms import run_all
 
-        with self._lock:
-            records = list(self._store.get(job_name, ()))
+        records = self.store.records(job_name)
         if not records:
             return {}
         return run_all(records)
+
+    def recommend_config(self, req: BrainConfigRequest) -> Dict:
+        """Start configuration for a job about to launch."""
+        return recommend_start_config(
+            self.store.records(req.job_name),
+            req.n_nodes,
+            devices_per_node=req.devices_per_node,
+            hbm=req.hbm,
+            global_batch=req.global_batch,
+            model=req.model,
+        )

@@ -1,0 +1,231 @@
+"""Brain metrics store: append-only, crc-framed, compacting.
+
+The Brain's value is cross-job memory — "jobs of this name needed this
+much, stepped this fast, on worlds of that size" — which makes its
+store a *durable* artifact, not a cache. Round 3's JSON blob failed
+that bar twice: ``_save`` was tmp+``os.replace`` with no fsync (a crash
+after the rename could still lose the whole file's contents — the
+DT005 bug class), and it only ran on ``stop()``, so a SIGKILLed brain
+lost every record since boot.
+
+This store rides the PR-3 state-store record format instead: one file,
+a ``DLRB1`` header stamping the checksum algorithm, then
+``u32 length | u32 checksum | payload`` frames — each payload a
+JSON-encoded ``{"job": ..., "rec": {...}}``. Appends go straight to an
+append-mode handle (append is the crash-safe write protocol: a torn
+tail is detected by the checksum and dropped on load, exactly like the
+master WAL), fsynced on a periodic cadence (``BRAIN_SAVE_INTERVAL_S``)
+rather than per record — brain history is advisory telemetry, so the
+durability window is a tunable, not a hard zero. When the log outgrows
+its retention window it compacts: the in-memory tail (the newest
+``BRAIN_HISTORY`` records per job) is rewritten through
+``fsutil.atomic_write_bytes`` — the same tmp + fsync + ``os.replace``
+commit every durable artifact here uses (``Tracer.export``, state
+snapshots) — so readers only ever see a complete old or new file.
+"""
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Any, Deque, Dict, List, Optional
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.checksum import DEFAULT_ALGO
+from dlrover_tpu.common.fsutil import atomic_write_bytes
+from dlrover_tpu.common.lockdep import instrumented_lock
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.state_store import _frame, _iter_frames, _read_header
+
+_BRAIN_MAGIC = b"DLRB1"
+
+#: Disk frames may exceed the per-job retention by this factor before a
+#: compaction rewrites the file down to the in-memory tail.
+COMPACT_FACTOR = 4
+
+
+def _header_bytes(algo: str) -> bytes:
+    raw = algo.encode()
+    return _BRAIN_MAGIC + bytes([len(raw)]) + raw
+
+
+class BrainMetricsStore:
+    """Crash-safe per-job metrics history for the Brain.
+
+    Thread-safe; every record is a plain JSON-able dict. The in-memory
+    view (``records``/``jobs``) is the source of truth for reads — the
+    file exists so the next brain of the same store path starts with
+    this one's history.
+    """
+
+    #: dtlint DT009: the per-job deques, the frame counters and the
+    #: append handle move together under the store lock; ``sync``/
+    #: ``append``/``compact`` interleave from the RPC handler and the
+    #: periodic saver thread.
+    GUARDED_BY = {
+        "_mem": "brain.store",
+        "_n_disk_frames": "brain.store",
+        "_last_sync_ts": "brain.store",
+        "_dirty": "brain.store",
+    }
+
+    def __init__(self, path: str, history: int = 0,
+                 sync_interval_s: float = -1.0):
+        self._lock = instrumented_lock("brain.store")
+        self._path = path
+        self._history = int(history or env_utils.BRAIN_HISTORY.get())
+        self._sync_interval_s = (
+            sync_interval_s if sync_interval_s >= 0.0
+            else env_utils.BRAIN_SAVE_INTERVAL_S.get()
+        )
+        self._algo = DEFAULT_ALGO
+        self._mem: Dict[str, Deque[Dict[str, Any]]] = defaultdict(
+            lambda: deque(maxlen=self._history)
+        )
+        self._n_disk_frames = 0
+        self._last_sync_ts = time.time()
+        self._dirty = False
+        self.torn_tail_dropped = False     # immutable-after-load flags
+        self.frames_loaded = 0
+        self._load()
+        # Append-mode handle: the crash-safe protocol for a framed log
+        # (DT005 exempts append; torn tails drop on the next load).
+        self._f = open(self._path, "ab")
+
+    # ---------------- load / recovery ----------------
+    def _load(self):  # dtlint: holds(brain.store)
+        # __init__-only (pre-publication: construction happens-before
+        # any sharing, same exemption __init__ itself gets).
+        try:
+            with open(self._path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            atomic_write_bytes(self._path, _header_bytes(self._algo))
+            return
+        except OSError as e:
+            logger.warning("brain store %s unreadable (%s); starting "
+                           "fresh", self._path, e)
+            atomic_write_bytes(self._path, _header_bytes(self._algo))
+            return
+        header = _read_header(data, _BRAIN_MAGIC)
+        if header is None:
+            if data:
+                # Pre-framing JSON blob or corrupt header: quarantine for
+                # postmortem (state-store convention) and start fresh —
+                # history is advisory, a restart with less of it is fine.
+                quarantine = f"{self._path}.corrupt"
+                try:
+                    os.replace(self._path, quarantine)
+                    logger.warning(
+                        "brain store %s has no valid DLRB1 header; "
+                        "quarantined to %s", self._path, quarantine,
+                    )
+                except OSError:
+                    pass
+            atomic_write_bytes(self._path, _header_bytes(self._algo))
+            return
+        algo, header_len = header
+        self._algo = algo
+        payloads, torn = _iter_frames(data[header_len:], algo)
+        if torn:
+            # Crash mid-append: keep the intact prefix, drop the tail,
+            # and rewrite the file to the parseable boundary so the
+            # reopened append handle starts on a frame edge.
+            self.torn_tail_dropped = True
+            logger.warning(
+                "brain store %s has a torn tail; %d intact record(s) "
+                "kept", self._path, len(payloads),
+            )
+        for raw in payloads:
+            try:
+                doc = json.loads(raw.decode())
+                self._mem[doc["job"]].append(doc["rec"])
+            except (ValueError, KeyError, UnicodeDecodeError):
+                continue
+            self.frames_loaded += 1
+        self._n_disk_frames = len(payloads)
+        if torn:
+            body = b"".join(_frame(p, algo) for p in payloads)
+            atomic_write_bytes(self._path, _header_bytes(algo) + body)
+
+    # ---------------- writes ----------------
+    def append(self, job: str, record: Dict[str, Any]):
+        """Frame one record onto the log and the in-memory tail."""
+        payload = json.dumps(
+            {"job": job, "rec": record}, sort_keys=True
+        ).encode()
+        framed = _frame(payload, self._algo)
+        with self._lock:
+            self._f.write(framed)
+            self._mem[job].append(record)
+            self._n_disk_frames += 1
+            self._dirty = True
+
+    def sync(self):
+        """Flush + fsync the append handle (the durability point)."""
+        with self._lock:
+            if not self._dirty:
+                return
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._dirty = False
+            self._last_sync_ts = time.time()
+
+    def maybe_sync(self, now: Optional[float] = None):
+        """Periodic saver entry point: fsync on the configured cadence
+        and compact once the log outgrows its retention window."""
+        now = now if now is not None else time.time()
+        with self._lock:
+            jobs = max(1, len(self._mem))
+            want_compact = (
+                self._n_disk_frames > COMPACT_FACTOR * self._history * jobs
+            )
+            want_sync = (
+                self._dirty
+                and now - self._last_sync_ts >= self._sync_interval_s
+            )
+        if want_compact:
+            self.compact()
+        elif want_sync:
+            self.sync()
+
+    def compact(self):
+        """Rewrite the file down to the in-memory tail, atomically."""
+        with self._lock:
+            body = b"".join(
+                _frame(
+                    json.dumps({"job": job, "rec": rec},
+                               sort_keys=True).encode(),
+                    self._algo,
+                )
+                for job in sorted(self._mem)
+                for rec in self._mem[job]
+            )
+            n = sum(len(q) for q in self._mem.values())
+            self._f.close()
+            atomic_write_bytes(
+                self._path, _header_bytes(self._algo) + body
+            )
+            self._f = open(self._path, "ab")  # dtlint: disable=DT002 -- reopening the append handle IS the compaction commit step; appends must not interleave between replace and reopen
+            self._n_disk_frames = n
+            self._dirty = False
+            self._last_sync_ts = time.time()
+
+    # ---------------- reads ----------------
+    def records(self, job: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._mem.get(job, ()))
+
+    def jobs(self) -> List[str]:
+        with self._lock:
+            return sorted(self._mem)
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {job: len(q) for job, q in self._mem.items()}
+
+    def close(self):
+        self.sync()
+        with self._lock:
+            self._f.close()

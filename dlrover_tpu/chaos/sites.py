@@ -79,6 +79,11 @@ class ChaosSite:
     #: re-request from its last durable cursor; delay: sleep
     #: args["delay_s"]). Detail = "seq{n}+{offset}".
     WAL_STREAM = "wal.stream.drop"
+    #: BrainPolicy shrink action, after the can_plan_shrink pre-flight
+    #: and before the world is touched (deny: skip the action this
+    #: tick, exercising the hysteresis/hold path; delay: sleep
+    #: ``delay_s``), detail = "node{rank}".
+    BRAIN_ACT = "brain.act"
     #: Reserved for unit drills of the injector mechanics themselves
     #: (schedules, journaling): never instrumented in product code.
     TEST_PROBE = "test.probe"

@@ -535,6 +535,53 @@ REMEDIATION_PROBATION_FAILS = ENV.int(
     "Probation failures (verdict returning after a regrow) before the "
     "node is permanently evicted through the node-manager path.")
 
+# ---------------- brain decision layer ----------------
+BRAIN = ENV.bool(
+    "DLROVER_TPU_BRAIN", False,
+    "Run the brain decision layer (brain/policy.py) off the master "
+    "monitor loop: history-driven start configuration plus a goodput "
+    "policy that grows the world while tokens/s still scales and "
+    "shrinks chips whose marginal contribution goes negative. Off "
+    "(default, the --auto-tunning analogue is opt-in): the planes stay "
+    "purely reactive and joins grow the world unconditionally.")
+BRAIN_SUSTAIN_TICKS = ENV.int(
+    "DLROVER_TPU_BRAIN_SUSTAIN_TICKS", 3,
+    "Policy ticks a grow/shrink signal must persist before the brain "
+    "acts — hysteresis so a noisy throughput sample never moves the "
+    "world.")
+BRAIN_COOLDOWN_S = ENV.float(
+    "DLROVER_TPU_BRAIN_COOLDOWN_S", 60.0,
+    "Minimum seconds between brain actions. The cooldown is FLEET-wide "
+    "and shared with the remediation policy: a remediation quarantine "
+    "arms it for the brain and a brain action arms it for remediation, "
+    "so the two policies never fight over the same world.")
+BRAIN_MIN_WORLD = ENV.int(
+    "DLROVER_TPU_BRAIN_MIN_WORLD", 2,
+    "The brain never shrinks the world below this many nodes, on top "
+    "of the rescale plane's survivor-quorum pre-flight.")
+BRAIN_GROW_EFFICIENCY = ENV.float(
+    "DLROVER_TPU_BRAIN_GROW_EFFICIENCY", 0.5,
+    "Keep growing while each added node delivered at least this "
+    "fraction of linear throughput scaling; below it the last grow is "
+    "judged not worth its chips and the target stops rising.")
+BRAIN_SHRINK_DRAG_PCT = ENV.float(
+    "DLROVER_TPU_BRAIN_SHRINK_DRAG_PCT", 12.5,
+    "Shrink a node out when its drag on the collective exceeds this "
+    "percent of the median step time — the point where one straggling "
+    "chip costs more wall clock than its 1/N compute contributes "
+    "(marginal goodput per chip goes negative at 100/world_size).")
+BRAIN_SAVE_INTERVAL_S = ENV.float(
+    "DLROVER_TPU_BRAIN_SAVE_INTERVAL_S", 30.0,
+    "Seconds between fsyncs of the brain metrics store's append-only "
+    "log (and between periodic compactions when the log outgrows its "
+    "retention window). Durability window for brain history, not "
+    "correctness: records are crc-framed and a torn tail drops clean.")
+BRAIN_HISTORY = ENV.int(
+    "DLROVER_TPU_BRAIN_HISTORY", 2048,
+    "Metrics records retained per job in the brain store; the "
+    "append-only log compacts down to this many when it grows past "
+    "four times the cap.")
+
 # ---------------- master high availability ----------------
 MASTER_HA_DIR = ENV.path(
     "DLROVER_TPU_MASTER_HA_DIR", "",

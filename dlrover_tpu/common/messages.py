@@ -94,6 +94,14 @@ class StragglersRequest(BaseRequest):
 
 
 @dataclass
+class BrainStatusRequest(BaseRequest):
+    """Read-only view of the brain decision layer (target world,
+    parked nodes, recommendation, action counters)."""
+
+    pass
+
+
+@dataclass
 class DiagnosisResult:
     nodes: List[int] = field(default_factory=list)
     done: bool = False

@@ -11,6 +11,8 @@ import threading
 import time
 from typing import Optional
 
+from dlrover_tpu.brain.policy import BrainPolicy
+from dlrover_tpu.brain.store import BrainMetricsStore
 from dlrover_tpu.common import env_utils, lockdep
 from dlrover_tpu.common.constants import JobStage, RendezvousName
 from dlrover_tpu.common.global_context import get_context
@@ -191,6 +193,29 @@ class JobMaster:
             evict_cb=self._evict_node,
         )
         self.observability.attach(remediation=self.remediation)
+        # Brain decision layer: history-driven start recommendation +
+        # goodput-driven grow/shrink (opt-in via DLROVER_TPU_BRAIN).
+        # The cross-job metrics store rides the state dir so the next
+        # job of this name starts from this one's observed throughput.
+        self.brain_store: Optional[BrainMetricsStore] = None
+        if state_dir and env_utils.BRAIN.get():
+            self.brain_store = BrainMetricsStore(
+                os.path.join(state_dir, "brain_metrics.log")
+            )
+        self.brain = BrainPolicy(
+            job_name=job_name,
+            rdzv_managers=self.rdzv_managers,
+            rescale_coordinator=self.rescale,
+            straggler_detector=self.straggler_detector,
+            speed_monitor=self.speed_monitor,
+            remediation=self.remediation,
+            task_manager=self.task_manager,
+            shard_lease=self.shard_lease,
+            state_store=self.state_store,
+            mutation_locks=self.mutation_locks,
+            metrics_store=self.brain_store,
+        )
+        self.observability.attach(brain=self.brain)
         # Role/fencing gauge source (the standby attaches its own).
         self.observability.attach(master_ha=self)
         self.servicer = MasterServicer(
@@ -208,6 +233,7 @@ class JobMaster:
             mutation_locks=self.mutation_locks,
             shard_lease=self.shard_lease,
             remediation_policy=self.remediation,
+            brain_policy=self.brain,
         )
         self._server = create_master_service(port, self.servicer)
         self.port = self._server.port
@@ -279,6 +305,7 @@ class JobMaster:
             "preempt": self.preempt.checkpoint(),
             "shard_lease": self.shard_lease.checkpoint(),
             "remediation": self.remediation.checkpoint(),
+            "brain": self.brain.checkpoint(),
         }
 
     def _recover_state(self):
@@ -314,6 +341,7 @@ class JobMaster:
                 self.preempt.restore(state.get("preempt", {}))
                 self.shard_lease.restore(state.get("shard_lease", {}))
                 self.remediation.restore(state.get("remediation", {}))
+                self.brain.restore(state.get("brain", {}))
             for rec in records:
                 try:
                     kind = rec[0]
@@ -358,6 +386,9 @@ class JobMaster:
                     elif kind == "remediate":
                         _, payload, ts = rec
                         self.remediation.replay(payload)
+                    elif kind == "brain":
+                        _, payload, ts = rec
+                        self.brain.replay(payload)
                     elif kind == "lease":
                         _, req_id, payload, ts = rec
                         resp = self.shard_lease.replay(payload)
@@ -512,6 +543,9 @@ class JobMaster:
                 self.shard_lease.tick()
                 self.straggler_detector.tick()
                 self.remediation.tick()
+                self.brain.tick()
+                if self.brain_store is not None:
+                    self.brain_store.maybe_sync()
                 if self.state_store is not None:
                     self.state_store.maybe_snapshot(self._collect_state)
                 if not self.job_manager.all_nodes():
@@ -562,6 +596,9 @@ class JobMaster:
         # remediation record so an unrelated eviction never leaves a
         # stale join gate behind.
         self.remediation.on_node_evicted(node_id)
+        # Same contract for the brain's parked set: an evicted node is
+        # gone for real, not spare capacity.
+        self.brain.on_node_evicted(node_id)
         if node_id in old_world:
             # Survivors of the shrunken world may transition in place
             # instead of restarting (no-op during journal replay and
@@ -612,6 +649,11 @@ class JobMaster:
                 logger.exception("lockdep graph export failed")
         uninstall_sink(self._event_sink_fn)
         self.observability.stop()
+        if self.brain_store is not None:
+            try:
+                self.brain_store.close()
+            except OSError:
+                logger.exception("brain metrics store close failed")
         if self.state_store is not None:
             # Sockets are severed, so no mutation can race the final
             # snapshot; best-effort — a failure here is exactly the

@@ -434,6 +434,29 @@ class StragglerDetector:
                 if p.flagged is not None
             }
 
+    def step_drag(self, n: int = 16) -> Dict[int, float]:
+        """Per-worker step-time drag vs the fleet: the recent mean of a
+        worker's phase sum over the cross-worker median, minus one
+        (0.0 = at the median, 0.3 = 30% slower). The BrainPolicy's
+        marginal-goodput input: in a synchronous collective the whole
+        world steps at the slowest member's pace, so a worker whose drag
+        exceeds ``1/world_size`` costs more wall clock than its chip
+        contributes — *below* the straggler detector's verdict ratio,
+        which is why the brain reads the raw profiles, not verdicts."""
+        totals: Dict[int, float] = {}
+        with self._lock:
+            for wid, prof in self._profiles.items():
+                parts = [prof.recent(k, n) for k in PHASE_KEYS]
+                vals = [v for v in parts if v is not None]
+                if vals:
+                    totals[wid] = sum(vals)
+        if len(totals) < 2:
+            return {}
+        med = statistics.median(totals.values())
+        if med <= 0:
+            return {}
+        return {wid: t / med - 1.0 for wid, t in totals.items()}
+
     def metrics(self) -> List:
         """Exporter gauges (appended by the ObservabilityPlane)."""
         with self._lock:

@@ -164,6 +164,31 @@ class RemediationPolicy:
         with self._lock:
             return {n: rec["state"] for n, rec in self._nodes.items()}
 
+    def last_action_ts(self) -> float:
+        """When this policy (or any peer via :meth:`note_fleet_action`)
+        last moved the world — the fleet-wide cooldown stamp the brain
+        policy shares so the two never act inside each other's window."""
+        with self._lock:
+            return self._last_action_ts
+
+    def note_fleet_action(self, ts: float):
+        """A peer policy (the brain) moved the world: arm this policy's
+        cooldown too, so remediation holds for its own
+        ``REMEDIATION_COOLDOWN_S`` after a brain grow/shrink exactly as
+        it would after its own quarantine."""
+        with self._lock:
+            self._last_action_ts = max(self._last_action_ts, float(ts))
+
+    def acting(self) -> bool:
+        """True while a remediation is in flight (a node quarantined or
+        on probation): the brain defers wholesale rather than judging
+        marginal goodput of a world mid-remediation."""
+        with self._lock:
+            return any(
+                rec["state"] in (STATE_QUARANTINED, STATE_PROBATION)
+                for rec in self._nodes.values()
+            )
+
     # ---------------- lifecycle hooks ----------------
     def on_node_evicted(self, node_rank: int):
         """An eviction landed through any path (heartbeat timeout, agent

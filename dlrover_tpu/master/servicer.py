@@ -95,6 +95,7 @@ class MasterServicer:
         mutation_locks=None,
         shard_lease=None,
         remediation_policy=None,
+        brain_policy=None,
     ):
         self._rdzv_managers = rdzv_managers
         self._kv_store = kv_store
@@ -108,6 +109,7 @@ class MasterServicer:
         self._rescale = rescale_coordinator
         self._preempt = preempt_coordinator
         self._remediation = remediation_policy
+        self._brain = brain_policy
         if shard_lease is None:
             from dlrover_tpu.master.shard.lease_service import (
                 ShardLeaseService,
@@ -235,6 +237,20 @@ class MasterServicer:
                 self._job_manager.report_heartbeat(req.node_id, time.time())
             return mgr.current_round()
         active = mgr.current_world()
+        if (
+            req.rdzv_name == RendezvousName.TRAINING
+            and self._brain is not None
+            and self._brain.gated_join(req.node_rank, active)
+        ):
+            # Brain join gate: the node was shrunk out on purpose
+            # (parked spare capacity), or the world already sits at the
+            # policy's target and this join would overshoot it. Same
+            # park-with-heartbeat contract as the remediation gate —
+            # the agent keeps polling, so a target raise or a release
+            # regrows through this very path with no new machinery.
+            if self._job_manager:
+                self._job_manager.report_heartbeat(req.node_id, time.time())
+            return mgr.current_round()
         round_ = mgr.join_rendezvous(req.node_rank, req.local_world_size)
         if req.rdzv_name == RendezvousName.TRAINING and self._job_manager:
             self._job_manager.report_heartbeat(req.node_id, time.time())
@@ -242,9 +258,21 @@ class MasterServicer:
             # A node joining an actively-training world: grow in place
             # instead of making survivors restart (no-op fallback when
             # the coordinator declines).
-            self._rescale.on_node_joined(
+            plan = self._rescale.on_node_joined(
                 req.node_rank, req.local_world_size, req.rdzv_name
             )
+            if (
+                plan is not None
+                and req.rdzv_name == RendezvousName.TRAINING
+                and self._brain is not None
+            ):
+                # With the brain holding the join gate, an admitted
+                # grow IS a brain decision: journal it and arm the
+                # shared fleet cooldown. Live-only — joins are not
+                # journaled RPCs, so this never runs on replay.
+                self._brain.on_grow_admitted(
+                    req.node_rank, len(active) + 1
+                )
         return round_
 
     def _get_comm_world(self, req: m.CommWorldRequest):
@@ -303,6 +331,11 @@ class MasterServicer:
         return m.DiagnosisResult(
             nodes=nodes, done=done, completed_rounds=mgr.completed_rounds()
         )
+
+    def _get_brain_status(self, req: m.BrainStatusRequest):
+        if self._brain is None:
+            return {}
+        return self._brain.status()
 
     # ---------------- kv store ----------------
     def _kv_set(self, req: m.KVStoreSet):
@@ -454,6 +487,23 @@ class MasterServicer:
                 req.extra.get("model_profile", {}),
                 float(req.extra.get("hbm", 0.0)),
             )
+        if self._brain is not None:
+            # The brain's auto-configuration inputs ride the same
+            # report (live-only feed; only the recommendation derived
+            # from it is journaled, by the policy itself).
+            profile = dict(req.extra.get("model_profile", {}) or {})
+            if not profile.get("param_count") and req.params_count:
+                profile["param_count"] = req.params_count
+            if profile:
+                self._brain.set_model_config(
+                    profile,
+                    hbm=float(req.extra.get("hbm", 0.0) or 0.0),
+                    global_batch=int(
+                        req.extra.get("global_batch", 0)
+                        or req.batch_size or 0
+                    ),
+                    spec=req.extra.get("parallel_spec") or None,
+                )
         return m.Response()
 
     def _report_failure(self, req: m.NodeFailure):
@@ -652,6 +702,7 @@ MasterServicer._HANDLERS = {
     m.DeviceCheckResult: MasterServicer._report_check_result,
     m.FaultNodesRequest: MasterServicer._get_fault_nodes,
     m.StragglersRequest: MasterServicer._get_stragglers,
+    m.BrainStatusRequest: MasterServicer._get_brain_status,
     m.KVStoreSet: MasterServicer._kv_set,
     m.KVStoreGet: MasterServicer._kv_get,
     m.KVStoreAdd: MasterServicer._kv_add,

@@ -75,4 +75,11 @@ WAL_RECORDS: Dict[str, Tuple[str, ...]] = {
     # — it re-derives live from telemetry — so replay reproduces exactly
     # the pending quarantines/probations, never a re-shrink.
     "remediate": ("RemediationPolicy.replay",),
+    # ("brain", payload, ts) — brain decision-layer journal: every
+    # decision (recommend/target/grow/shrink/revert/release),
+    # apply-then-log. Throughput samples and hysteresis streaks are
+    # deliberately NOT journaled — they re-derive live from telemetry —
+    # so replay reproduces exactly the target, the parked set and the
+    # pending plan, never a re-shrink.
+    "brain": ("BrainPolicy.replay",),
 }

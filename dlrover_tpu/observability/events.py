@@ -106,6 +106,21 @@ class EventKind:
     # its log dies with it; the failover incident lives on the winner).
     MASTER_FAILOVER = "master.failover"
     MASTER_FENCED = "master.fenced"
+    # Brain decision layer (brain/policy.py): the start recommendation
+    # was computed (context — carries feasible/world_size/source); the
+    # target world size changed (context); a join was admitted as a
+    # brain-sanctioned grow; a chip whose marginal goodput went
+    # negative was shrunk out and parked (opens the brain:shrink
+    # incident — the chip left the fleet on purpose); a shrink plan
+    # aborted and the node was released back (closes it, context); a
+    # parked node was released to cover a capacity shortfall (closes
+    # it — the spare rejoined).
+    BRAIN_RECOMMEND = "brain.recommend"
+    BRAIN_TARGET = "brain.target"
+    BRAIN_GROW = "brain.grow"
+    BRAIN_SHRINK = "brain.shrink"
+    BRAIN_REVERT = "brain.revert"
+    BRAIN_RELEASE = "brain.release"
 
 
 @dataclass

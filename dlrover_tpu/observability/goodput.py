@@ -172,6 +172,8 @@ class GoodputLedger:
             self._on_failover(ev)
         elif ev.kind.startswith("remediation."):
             self._on_remediation(ev)
+        elif ev.kind.startswith("brain."):
+            self._on_brain(ev)
         elif ev.kind in _CONTEXT:
             with self._lock:
                 inc = self._open_incident_for(ev.node_id)
@@ -345,6 +347,45 @@ class GoodputLedger:
                     )
             elif inc is not None:
                 # REVERT / CLEAR context on the open incident's trail.
+                inc.trail.append(ev.kind)
+
+    def _on_brain(self, ev: JobEvent):
+        """Book the brain policy's shrinks as persistent ``brain:shrink``
+        incidents: the chip left the fleet *on purpose* (its marginal
+        goodput went negative), so the span must show in the per-cause
+        table without charging the downtime union — survivors keep
+        stepping the whole time. act = the shrink, recover = the node's
+        release back to the fleet (or the abort revert); a chronically
+        degraded node that stays parked keeps its incident open, which
+        is the honest reading. Target/recommend/grow events are
+        fleet-level context, folded into an open incident's trail when
+        one carries the node's story."""
+        with self._lock:
+            inc = self._open_straggler_for(ev.node_id, prefix="brain:")
+            if ev.kind == EventKind.BRAIN_SHRINK:
+                self._t0 = min(self._t0, ev.ts)
+                if inc is None:
+                    inc = Incident(
+                        cause="brain:shrink", node_id=ev.node_id,
+                        start_ts=ev.ts, detect_ts=ev.ts, persistent=True,
+                    )
+                    self._incidents.append(inc)
+                inc.act_ts = ev.ts
+                inc.trail.append(ev.kind)
+                inc.evidence = (
+                    f"{ev.args.get('reason', 'marginal goodput negative')}"
+                    f"; plan {ev.args.get('plan_id')}: world "
+                    f"{ev.args.get('old_world')} -> "
+                    f"{ev.args.get('new_world')}"
+                )
+            elif ev.kind in (
+                EventKind.BRAIN_RELEASE, EventKind.BRAIN_REVERT
+            ):
+                if inc is not None:
+                    inc.recover_ts = ev.ts
+                    inc.trail.append(ev.kind)
+            elif inc is not None:
+                # RECOMMEND / TARGET / GROW context on the open trail.
                 inc.trail.append(ev.kind)
 
     def _on_failover(self, ev: JobEvent):

@@ -51,6 +51,7 @@ class ObservabilityPlane:
         self._straggler_detector = None
         self._shard_lease = None
         self._remediation = None
+        self._brain = None
         self._master_ha = None
         # Native histograms: master RPC handle latency per message type
         # (servicer.handle) and state-store WAL write/fsync durations
@@ -64,7 +65,8 @@ class ObservabilityPlane:
 
     def attach(self, speed_monitor=None, job_manager=None,
                task_manager=None, straggler_detector=None,
-               shard_lease=None, remediation=None, master_ha=None):
+               shard_lease=None, remediation=None, brain=None,
+               master_ha=None):
         """Late-bind the metric sources the exporter reads from."""
         if speed_monitor is not None:
             self._speed_monitor = speed_monitor
@@ -78,6 +80,8 @@ class ObservabilityPlane:
             self._shard_lease = shard_lease
         if remediation is not None:
             self._remediation = remediation
+        if brain is not None:
+            self._brain = brain
         if master_ha is not None:
             self._master_ha = master_ha
 
@@ -294,6 +298,8 @@ class ObservabilityPlane:
             metrics.extend(self._straggler_detector.metrics())
         if self._remediation is not None:
             metrics.extend(self._remediation.metrics())
+        if self._brain is not None:
+            metrics.extend(self._brain.metrics())
         if self._master_ha is not None:
             ha = self._master_ha.ha_status()
             metrics.append((

@@ -1,9 +1,18 @@
-"""Brain service tests (SURVEY §2.7 / Lx offline optimizer)."""
+"""Brain service tests (SURVEY §2.7 / Lx offline optimizer): the RPC
+service + algorithms, the crc-framed cross-job metrics store (ISSUE 19
+satellite: the fsync-less JSON blob's DT005 hole), and the job-start
+auto-configuration (history-blended strategy search)."""
 
 import pytest
 
 from dlrover_tpu.brain import BrainClient, BrainResourceOptimizer, BrainService
+from dlrover_tpu.brain.autoconf import (
+    WORLD_PERF_KIND,
+    observed_world_perf,
+    recommend_start_config,
+)
 from dlrover_tpu.brain.client import BrainReporter
+from dlrover_tpu.brain.store import BrainMetricsStore
 from dlrover_tpu.common.messages import NodeResourceStats
 from dlrover_tpu.master.stats import JobMetricCollector
 
@@ -233,13 +242,18 @@ class TestProvenance:
         plan = client.get_optimization_plan(job)
         client.close()
         prov = plan["provenance"]
-        assert prov["worker_memory_mb"] == "hot_node_resource"
-        assert prov["hot_nodes"] == "hot_node_resource"
-        assert prov["speed_samples_per_s"] == "completion_time"
-        assert prov["predicted_remaining_s"] == "completion_time"
-        assert prov["straggler_scores"] == "straggler_history"
+        # Provenance lists EVERY contributor per key, merge order; the
+        # last entry holds the final value (hot_node_resource is the
+        # later stage, so it wins the contested sizing rows).
+        assert prov["worker_memory_mb"] == [
+            "percentile_sizing", "hot_node_resource",
+        ]
+        assert prov["hot_nodes"] == ["hot_node_resource"]
+        assert prov["speed_samples_per_s"] == ["completion_time"]
+        assert prov["predicted_remaining_s"] == ["completion_time"]
+        assert prov["straggler_scores"] == ["straggler_history"]
         assert plan["exclude_nodes"] == [3]
-        authors = set(prov.values())
+        authors = {name for names in prov.values() for name in names}
         assert authors >= {"percentile_sizing", "hot_node_resource",
                            "completion_time", "straggler_history"}
 
@@ -266,9 +280,9 @@ class TestTrainingSpeedPipeline:
         # 2 steps/s * batch 32 = 64 samples/s; 500 steps left -> 250 s
         assert plan["speed_samples_per_s"] == pytest.approx(64.0)
         assert plan["predicted_remaining_s"] == pytest.approx(250.0)
-        assert plan["provenance"]["predicted_remaining_s"] == (
+        assert plan["provenance"]["predicted_remaining_s"] == [
             "completion_time"
-        )
+        ]
 
     def test_fleet_wide_event_capped(self):
         from dlrover_tpu.brain.algorithms import straggler_history
@@ -281,3 +295,153 @@ class TestTrainingSpeedPipeline:
                 )
         out = straggler_history(records)
         assert len(out["exclude_nodes"]) <= 2  # 6 seen nodes -> cap 2
+
+
+class TestMetricsStore:
+    """The DLRB1-framed store that replaced the fsync-less JSON blob:
+    append is the write protocol, torn tails drop on load, corrupt
+    files quarantine, oversized logs compact atomically."""
+
+    def test_roundtrip_across_restart(self, tmp_path):
+        path = str(tmp_path / "brain_metrics.log")
+        store = BrainMetricsStore(path, history=64)
+        for i in range(5):
+            store.append("job-a", {"kind": "world_perf", "ts": float(i),
+                                   "world_size": 2, "samples_per_s": 10.0 + i})
+        store.append("job-b", {"kind": "model_info", "param_count": 7})
+        store.close()
+
+        revived = BrainMetricsStore(path, history=64)
+        assert revived.frames_loaded == 6
+        assert not revived.torn_tail_dropped
+        assert revived.jobs() == ["job-a", "job-b"]
+        recs = revived.records("job-a")
+        assert len(recs) == 5 and recs[-1]["samples_per_s"] == 14.0
+        assert revived.records("job-b") == [
+            {"kind": "model_info", "param_count": 7}
+        ]
+        revived.close()
+
+    def test_torn_tail_dropped_and_rewritten(self, tmp_path):
+        path = str(tmp_path / "brain_metrics.log")
+        store = BrainMetricsStore(path, history=64)
+        for i in range(4):
+            store.append("job", {"i": i})
+        store.close()
+        size = len(open(path, "rb").read())
+        with open(path, "r+b") as f:  # crash mid-append: half a frame
+            f.truncate(size - 7)
+
+        revived = BrainMetricsStore(path, history=64)
+        assert revived.torn_tail_dropped
+        assert [r["i"] for r in revived.records("job")] == [0, 1, 2]
+        # the file was rewritten to the frame boundary, so appends from
+        # the reopened handle land on a parseable edge
+        revived.append("job", {"i": 99})
+        revived.close()
+        again = BrainMetricsStore(path, history=64)
+        assert not again.torn_tail_dropped
+        assert [r["i"] for r in again.records("job")] == [0, 1, 2, 99]
+        again.close()
+
+    def test_pre_framing_blob_quarantined(self, tmp_path):
+        path = str(tmp_path / "brain_metrics.log")
+        with open(path, "wb") as f:  # round-3 vintage: a JSON blob
+            f.write(b'{"job": {"node_resource": []}}')
+        store = BrainMetricsStore(path, history=64)
+        assert store.jobs() == []
+        assert (tmp_path / "brain_metrics.log.corrupt").exists()
+        store.append("job", {"i": 1})   # fresh store is writable
+        store.close()
+        revived = BrainMetricsStore(path, history=64)
+        assert revived.records("job") == [{"i": 1}]
+        revived.close()
+
+    def test_compaction_bounds_the_log(self, tmp_path):
+        path = str(tmp_path / "brain_metrics.log")
+        store = BrainMetricsStore(path, history=4, sync_interval_s=0.0)
+        for i in range(40):
+            store.append("job", {"i": i})
+            store.maybe_sync()
+        # retention window: memory holds the newest `history` records
+        assert [r["i"] for r in store.records("job")] == [36, 37, 38, 39]
+        # the compaction rewrote the file down to the tail
+        assert store._n_disk_frames <= 4 * 4
+        store.close()
+        revived = BrainMetricsStore(path, history=4)
+        assert [r["i"] for r in revived.records("job")] == [36, 37, 38, 39]
+        revived.close()
+
+    def test_maybe_sync_cadence(self, tmp_path):
+        store = BrainMetricsStore(
+            str(tmp_path / "m.log"), history=8, sync_interval_s=3600.0
+        )
+        store.append("job", {"i": 0})
+        store.maybe_sync()              # inside the window: stays dirty
+        assert store._dirty
+        store.maybe_sync(now=store._last_sync_ts + 3601.0)
+        assert not store._dirty
+        store.close()
+
+
+class TestAutoconf:
+    """Job-start recommendation: strategy search at every candidate
+    world, blended with observed prior-run throughput at the
+    marginal-goodput knee."""
+
+    MODEL = {"param_count": 100_000_000}
+
+    @staticmethod
+    def history(perf, n=3):
+        return [
+            {"kind": WORLD_PERF_KIND, "world_size": w, "samples_per_s": s}
+            for w, s in perf.items() for _ in range(n)
+        ]
+
+    def test_observed_world_perf_medians(self):
+        records = self.history({2: 100.0}) + [
+            {"kind": "training_speed", "world_size": 3,
+             "samples_per_s": 120.0},
+            {"kind": "node_resource", "world_size": 9},  # ignored
+        ]
+        assert observed_world_perf(records) == {2: 100.0, 3: 120.0}
+
+    def test_history_knee_beats_fleet_ceiling(self):
+        """The acceptance shape: history shows scaling knees at 3, so
+        the recommendation comes in UNDER the 4-node fleet ceiling."""
+        rec = recommend_start_config(
+            self.history({1: 55.0, 2: 100.0, 3: 145.0, 4: 148.0}),
+            4, devices_per_node=1, hbm=16e9, global_batch=32,
+            model=self.MODEL,
+        )
+        assert rec["feasible"] and rec["world_size"] == 3
+        assert rec["source"] == "history-blended"
+        assert rec["samples_per_s"] == 145.0
+        assert rec["micro_batch"] == 32  # data=1 spec -> full batch
+
+    def test_no_history_is_purely_analytic(self):
+        rec = recommend_start_config(
+            [], 2, devices_per_node=1, hbm=16e9, global_batch=32,
+            model=self.MODEL,
+        )
+        assert rec["feasible"] and rec["source"] == "searched"
+        assert rec["calibration"] == 1.0
+        assert 1 <= rec["world_size"] <= 2
+
+    def test_infeasible_hbm_is_reported_not_oversubscribed(self):
+        rec = recommend_start_config(
+            [], 2, devices_per_node=1, hbm=1e6, global_batch=32,
+            model=self.MODEL,
+        )
+        assert rec["feasible"] is False
+        assert rec["reason"] == "no candidate world fits HBM"
+        assert rec["closest"]["hbm_bytes_needed"] > 1e6
+
+    def test_no_model_no_recommendation(self):
+        assert recommend_start_config([], 4) == {}
+        # ...but a model_info record in the history is enough
+        rec = recommend_start_config(
+            [{"kind": "model_info", "param_count": 50_000_000}], 2,
+            hbm=16e9,
+        )
+        assert rec["feasible"] and rec["world_size"] >= 1

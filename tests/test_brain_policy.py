@@ -1,0 +1,564 @@
+"""Brain decision layer (ISSUE 19): goodput-driven auto-scaling on the
+master.
+
+Covers the :class:`BrainPolicy` signal table (drag/oversize shrink
+hysteresis, detarget on a failed marginal test, uptarget while scaling
+pays, release of parked capacity), the safety rails (min-world floor,
+shared fleet cooldown, wholesale deference to remediation, plan-abort
+revert), the servicer's brain join gate, WAL replay reproducing every
+decision exactly once across a master crash (through the real
+:class:`JobMaster`), chaos denial of the shrink action, the goodput
+ledger's ``brain:shrink`` incidents, the exporter gauges — and the
+end-to-end fleet drill: a wrong-sized fleet with a chronically
+degraded node converges to the searched-best world with the degraded
+node parked, and a relaunched master replays to the same decision
+state.
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu.brain.policy import BrainPolicy
+from dlrover_tpu.chaos.injector import (
+    CHAOS_ENV,
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+)
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.rescale import PLAN_ISSUED
+from dlrover_tpu.master.state_store import MasterStateStore
+from dlrover_tpu.observability import events as events_mod
+from dlrover_tpu.observability.events import EventKind, JobEvent
+from dlrover_tpu.observability.goodput import GoodputLedger
+
+from tests.test_rescale import TRAIN, formed_world, make_coordinator
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_and_events(monkeypatch):
+    monkeypatch.delenv(CHAOS_ENV, raising=False)
+    FaultInjector.reset()
+    events_mod.reset()
+    yield
+    events_mod.reset()
+    FaultInjector.reset()
+
+
+@pytest.fixture(autouse=True)
+def brain_knobs(monkeypatch):
+    """Deterministic policy timing: brain on, no cooldown, tight
+    hysteresis. Each test overrides what it exercises."""
+    monkeypatch.setenv("DLROVER_TPU_BRAIN", "1")
+    monkeypatch.setenv("DLROVER_TPU_BRAIN_SUSTAIN_TICKS", "2")
+    monkeypatch.setenv("DLROVER_TPU_BRAIN_COOLDOWN_S", "0")
+    monkeypatch.setenv("DLROVER_TPU_BRAIN_MIN_WORLD", "2")
+
+
+class FakeDrag:
+    """Settable step-drag table, the shrink signal's input surface."""
+
+    def __init__(self):
+        self.drags = {}
+
+    def step_drag(self, n=16):
+        return dict(self.drags)
+
+
+class FakeSpeed:
+    def __init__(self):
+        self.speed = 0.0
+
+    def running_speed(self):
+        return self.speed
+
+    def remove_worker(self, worker_id):
+        pass
+
+
+class FakeRemediation:
+    def __init__(self):
+        self._acting = False
+        self._last = 0.0
+        self.noted = []
+
+    def acting(self):
+        return self._acting
+
+    def last_action_ts(self):
+        return self._last
+
+    def note_fleet_action(self, ts):
+        self.noted.append(ts)
+        self._last = max(self._last, ts)
+
+
+def make_policy(n=4, store=None, **coord_kw):
+    mgr, _, _ = formed_world(n)
+    coord = make_coordinator(mgr, **coord_kw)
+    det, sm, rem = FakeDrag(), FakeSpeed(), FakeRemediation()
+    policy = BrainPolicy(
+        job_name="t",
+        rdzv_managers={TRAIN: mgr},
+        rescale_coordinator=coord,
+        straggler_detector=det,
+        speed_monitor=sm,
+        remediation=rem,
+        state_store=store,
+    )
+    return policy, det, sm, rem, coord, mgr
+
+
+def shrink(policy, det, wid=3, drag=0.5, t0=100.0):
+    """Drive wid through the drag-shrink hysteresis (sustain=2)."""
+    det.drags = {wid: drag}
+    policy.tick(now=t0)
+    policy.tick(now=t0 + 1)
+    assert wid in policy.parked()
+    return t0 + 1
+
+
+class TestShrinkHysteresis:
+    def test_sustained_drag_shrinks_after_hysteresis(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        det.drags = {3: 0.5}    # 50% drag > max(12.5%, 1/4) threshold
+        policy.tick(now=100.0)
+        # one tick: streak armed, world untouched
+        assert policy.parked() == {} and len(mgr.current_world()) == 4
+        policy.tick(now=101.0)
+        # second sustained tick: shrunk in place, parked, plan pending
+        world = mgr.current_world()
+        assert 3 not in world and len(world) == 3
+        rec = policy.parked()[3]
+        assert rec["drag"] == 0.5 and "drag" in rec["reason"]
+        plan_id = policy.status()["pending"]["plan_id"]
+        assert coord.plan_status(plan_id) == PLAN_ISSUED
+        # the shared fleet cooldown was armed on remediation's side too
+        assert rem.noted == [101.0]
+
+    def test_flapping_drag_clears_the_streak(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        det.drags = {3: 0.5}
+        policy.tick(now=100.0)
+        det.drags = {}
+        policy.tick(now=101.0)
+        det.drags = {3: 0.5}
+        policy.tick(now=102.0)  # streak restarted: still only 1 tick
+        assert policy.parked() == {}
+        assert len(mgr.current_world()) == 4
+
+    def test_drag_below_threshold_never_acts(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        det.drags = {3: 0.2}    # below the 1/world = 25% contribution bar
+        for i in range(5):
+            policy.tick(now=100.0 + i)
+        assert policy.parked() == {}
+        assert len(mgr.current_world()) == 4
+
+    def test_min_world_floor_holds(self):
+        policy, det, sm, rem, coord, mgr = make_policy(n=2)
+        det.drags = {1: 0.9}
+        for i in range(5):
+            policy.tick(now=100.0 + i)
+        assert policy.parked() == {}
+        assert len(mgr.current_world()) == 2
+
+    def test_oversize_shrink_picks_worst_drag_victim(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        policy.restore({"target": 3})
+        det.drags = {2: 0.1}    # below the shrink-drag bar on its own
+        policy.tick(now=100.0)
+        policy.tick(now=101.0)
+        assert 2 in policy.parked()
+        assert len(mgr.current_world()) == 3
+
+    def test_oversize_shrink_defaults_to_max_rank(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        policy.restore({"target": 3})
+        policy.tick(now=100.0)
+        policy.tick(now=101.0)
+        assert 3 in policy.parked()
+
+
+class TestDeference:
+    def test_remediation_in_flight_defers_wholesale(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        rem._acting = True
+        det.drags = {3: 0.9}
+        for i in range(5):
+            policy.tick(now=100.0 + i)
+        assert policy.parked() == {}
+        assert policy.status()["deferrals"]["remediation"] == 5
+
+    def test_shared_cooldown_rate_limits(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_BRAIN_COOLDOWN_S", "10")
+        policy, det, sm, rem, coord, mgr = make_policy()
+        rem._last = 95.0        # remediation moved the world at t=95
+        det.drags = {3: 0.9}
+        policy.tick(now=100.0)
+        policy.tick(now=101.0)
+        assert policy.parked() == {}
+        assert policy.status()["deferrals"]["cooldown"] == 2
+        # cooldown expired: the sustained signal acts
+        policy.tick(now=106.0)
+        policy.tick(now=107.0)
+        assert 3 in policy.parked()
+
+    def test_pending_plan_blocks_second_action(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        shrink(policy, det, wid=3)
+        det.drags = {2: 0.9}    # a second victim while plan 1 in flight
+        policy.tick(now=102.0)
+        policy.tick(now=103.0)
+        assert 2 not in policy.parked()
+        assert len(mgr.current_world()) == 3
+        assert policy.status()["deferrals"]["plan-in-flight"] >= 1
+
+    def test_disabled_brain_is_inert(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_BRAIN", "0")
+        policy, det, sm, rem, coord, mgr = make_policy()
+        det.drags = {3: 0.9}
+        for i in range(5):
+            policy.tick(now=100.0 + i)
+        assert policy.parked() == {}
+        assert not policy.gated_join(9, mgr.current_world())
+
+
+class TestTargetSignals:
+    def test_failed_marginal_test_pulls_target_in(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        policy.restore({"target": 4})
+        # white-box: settled throughput ledger says the 4th chip bought
+        # ~2% of linear — far under the 50% efficiency bar
+        policy._world_perf = {
+            3: {"samples_per_s": 145.0, "n": 5.0},
+            4: {"samples_per_s": 146.0, "n": 5.0},
+        }
+        policy._last_world = 4
+        policy.tick(now=100.0)
+        policy.tick(now=101.0)
+        assert policy.target_world() == 3
+        assert policy.status()["marginal"] < 0.5
+
+    def test_uptarget_probes_while_scaling_pays(self):
+        policy, det, sm, rem, coord, mgr = make_policy(n=3)
+        policy.restore({"target": 3})
+        mgr.join_rendezvous(3, 1)   # spare capacity waiting to join
+        policy.tick(now=100.0)
+        policy.tick(now=101.0)
+        assert policy.target_world() == 4
+
+    def test_release_longest_parked_when_short(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        t = shrink(policy, det, wid=3)
+        for r in sorted(mgr.current_world()):
+            coord.apply_ack(policy.status()["pending"]["plan_id"], r,
+                            ok=True)
+        det.drags = {}
+        policy.restore({"target": 4})   # fleet now short of target
+        policy.tick(now=t + 1)          # settles the plan
+        policy.tick(now=t + 2)
+        policy.tick(now=t + 3)
+        assert policy.parked() == {}    # gate lifted: next join regrows
+        assert policy.status()["actions"]["release"] == 1
+
+
+class TestJoinGate:
+    def test_parked_node_is_gated_until_release(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        shrink(policy, det, wid=3)
+        world = mgr.current_world()
+        assert policy.gated_join(3, world)          # parked: held out
+        assert not policy.gated_join(0, world)      # member: never gated
+        policy.on_node_evicted(3)                   # eviction landed
+        assert not policy.gated_join(3, world)
+
+    def test_overshooting_join_parks_at_target(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        policy.restore({"target": 4})
+        world = mgr.current_world()
+        assert policy.gated_join(9, world)          # 4 >= target 4
+        policy.restore({"target": 6})
+        assert not policy.gated_join(9, world)      # below target: grow
+
+
+class TestPlanAbort:
+    def test_nacked_plan_reverts_the_park(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        t = shrink(policy, det, wid=3)
+        plan_id = policy.status()["pending"]["plan_id"]
+        coord.apply_ack(plan_id, 1, ok=False, error="oom")
+        policy.tick(now=t + 1)
+        # unparked: the node may reform with the survivors
+        assert policy.parked() == {}
+        assert policy.status()["pending"]["plan_id"] == -1
+        assert policy.status()["actions"]["revert"] == 1
+        world = mgr.current_world()
+        assert not policy.gated_join(3, world)
+
+    def test_plan_timeout_reverts(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_RESCALE_APPLY_TIMEOUT_S", "0.05")
+        policy, det, sm, rem, coord, mgr = make_policy()
+        t = shrink(policy, det, wid=3)
+        time.sleep(0.1)
+        coord.tick()                    # deadline sweep aborts the plan
+        policy.tick(now=t + 1)
+        assert policy.parked() == {}
+        assert policy.status()["actions"]["revert"] == 1
+
+    def test_undeliverable_shrink_is_declined_not_applied(self):
+        # only ranks 0..1 are rescale-capable: the pre-flight declines
+        # and the world must NOT shrink (no half-applied park)
+        policy, det, sm, rem, coord, mgr = make_policy(capable=range(2))
+        det.drags = {3: 0.9}
+        for i in range(4):
+            policy.tick(now=100.0 + i)
+        assert policy.parked() == {}
+        assert len(mgr.current_world()) == 4
+        assert policy.status()["actions"]["shrink_declined"] >= 1
+
+
+class TestChaos:
+    def test_chaos_deny_skips_the_shrink_tick(self, monkeypatch):
+        monkeypatch.setenv(CHAOS_ENV, FaultPlan(seed=7, events=[
+            FaultEvent(site="brain.act", kind="deny", every=1,
+                       max_fires=1),
+        ]).to_json())
+        FaultInjector.reset()
+        policy, det, sm, rem, coord, mgr = make_policy()
+        det.drags = {3: 0.9}
+        policy.tick(now=100.0)
+        policy.tick(now=101.0)  # sustained, but chaos denies the act
+        assert policy.parked() == {}
+        assert len(mgr.current_world()) == 4
+        policy.tick(now=102.0)  # chaos exhausted: the action lands
+        assert 3 in policy.parked()
+
+
+class TestWalReplay:
+    def _journaled_policy(self, tmp_path, **kw):
+        store = MasterStateStore(str(tmp_path))
+        store.snapshot(lambda: {})      # open the generation's journal
+        policy, det, sm, rem, coord, mgr = make_policy(store=store, **kw)
+        return store, policy, det, sm, rem, coord, mgr
+
+    def test_mid_shrink_failover_replays_exactly_once(self, tmp_path):
+        store, policy, det, sm, rem, coord, mgr = self._journaled_policy(
+            tmp_path
+        )
+        shrink(policy, det, wid=3)
+        plan_id = policy.status()["pending"]["plan_id"]
+        store.close()                   # crash: no graceful checkpoint
+
+        # ---- failed-over master: fresh world, fresh coordinator ----
+        mgr2, _, _ = formed_world(4)
+        calls = []
+        policy2, det2, _, _, coord2, _ = make_policy()
+        coord2.on_node_removed = lambda *a, **k: calls.append(a)
+        store2 = MasterStateStore(str(tmp_path))
+        _, records = store2.recover()
+        brain = [r for r in records if r[0] == "brain"]
+        assert len(brain) == 1          # exactly one shrink decision
+        store2.replaying = True
+        try:
+            for rec in brain:
+                policy2.replay(rec[1])
+        finally:
+            store2.replaying = False
+        # the pending shrink is reproduced...
+        assert 3 in policy2.parked()
+        assert policy2.status()["pending"]["plan_id"] == plan_id
+        assert policy2.gated_join(3, mgr2.current_world())
+        # ...exactly once: replay is pure bookkeeping, no re-shrink —
+        # and the still-flagged drag cannot re-act while the replayed
+        # plan is pending
+        det2.drags = {3: 0.9}
+        policy2.tick(now=500.0)
+        assert calls == []
+        store2.close()
+
+    def test_tick_is_inert_while_replaying(self, tmp_path):
+        store, policy, det, sm, rem, coord, mgr = self._journaled_policy(
+            tmp_path
+        )
+        det.drags = {3: 0.9}
+        store.replaying = True
+        try:
+            for i in range(5):
+                policy.tick(now=100.0 + i)
+        finally:
+            store.replaying = False
+        assert policy.parked() == {}
+        assert len(mgr.current_world()) == 4
+        store.close()
+
+    def test_target_and_release_records_replay(self, tmp_path):
+        store, policy, det, sm, rem, coord, mgr = self._journaled_policy(
+            tmp_path
+        )
+        t = shrink(policy, det, wid=3)
+        for r in sorted(mgr.current_world()):
+            coord.apply_ack(policy.status()["pending"]["plan_id"], r,
+                            ok=True)
+        det.drags = {}
+        policy.restore({"target": 4})
+        policy.tick(now=t + 1)
+        policy.tick(now=t + 2)
+        policy.tick(now=t + 3)          # release record
+        assert policy.parked() == {}
+        store.close()
+
+        policy2 = BrainPolicy()
+        store2 = MasterStateStore(str(tmp_path))
+        _, records = store2.recover()
+        for rec in records:
+            if rec[0] == "brain":
+                policy2.replay(rec[1])
+        # shrink then release: the parked set nets out empty
+        assert policy2.parked() == {}
+        assert policy2.status()["actions"]["shrink"] == 1
+        store2.close()
+
+    def test_master_crash_roundtrip(self, tmp_path, monkeypatch):
+        """Through the real JobMaster: the brain table rides the
+        snapshot and the ("brain", ...) journal records ride the
+        dispatcher, so a SIGKILLed master's successor holds the same
+        target and parked set."""
+        master = JobMaster(port=0, node_num=4, state_dir=str(tmp_path))
+        for r in range(4):
+            master.rdzv_managers[TRAIN].join_rendezvous(r, 1)
+        master.rdzv_managers[TRAIN].get_comm_world(0)
+        master.rescale.set_batch_config(16, 4)
+        for r in range(4):
+            master.rescale.set_capable(r)
+        det = FakeDrag()
+        det.drags = {3: 0.5}
+        master.brain._detector = det
+        master.brain._retarget(3, "test", now=99.0)  # journaled path
+        master.brain.tick(now=100.0)
+        master.brain.tick(now=101.0)
+        assert 3 in master.brain.parked()
+        assert len(master.rdzv_managers[TRAIN].current_world()) == 3
+        pre = master.brain.checkpoint()
+        # crash: sever the server and the WAL, never the final snapshot
+        master._stopped.set()
+        master._server.stop()
+        events_mod.uninstall_sink(master._event_sink_fn)
+        master.state_store.close()
+
+        master2 = JobMaster(port=0, node_num=4, state_dir=str(tmp_path))
+        post = master2.brain.checkpoint()
+        assert post["target"] == pre["target"] == 3
+        assert post["parked"] == pre["parked"]
+        assert master2.brain.gated_join(
+            3, master2.rdzv_managers[TRAIN].current_world()
+        )
+        master2._stopped.set()
+        master2._server.stop()
+        events_mod.uninstall_sink(master2._event_sink_fn)
+        master2.state_store.close()
+
+
+class TestLedger:
+    def test_brain_shrink_incident_books_act_and_release(self):
+        led = GoodputLedger(now=0.0)
+        led.ingest(JobEvent(
+            kind=EventKind.BRAIN_SHRINK, ts=110.0, node_id=3,
+            role="master", pid=1,
+            args={"reason": "drag 50% > 25%", "plan_id": 7,
+                  "old_world": [0, 1, 2, 3], "new_world": [0, 1, 2]},
+        ))
+        led.note_step(5, ts=112.0)
+        s = led.summary(now=120.0)
+        [inc] = s["incidents"]
+        assert inc["cause"] == "brain:shrink"
+        assert inc["persistent"] and inc["open"]
+        assert "plan 7" in inc["evidence"]
+        # degradation accounting, not downtime: survivors kept stepping
+        assert s["downtime_s"] == 0.0 and s["goodput"] == 1.0
+        led.ingest(JobEvent(
+            kind=EventKind.BRAIN_RELEASE, ts=130.0, node_id=3,
+            role="master", pid=1, args={"target": 4},
+        ))
+        [inc] = led.summary(now=140.0)["incidents"]
+        assert not inc["open"]
+        assert inc["recover_s"] == pytest.approx(20.0)
+
+    def test_revert_closes_and_target_rides_the_trail(self):
+        led = GoodputLedger(now=0.0)
+        led.ingest(JobEvent(
+            kind=EventKind.BRAIN_SHRINK, ts=10.0, node_id=1,
+            role="master", pid=1, args={"plan_id": 3},
+        ))
+        led.ingest(JobEvent(
+            kind=EventKind.BRAIN_TARGET, ts=11.0, node_id=1,
+            role="master", pid=1, args={"target": 3},
+        ))
+        led.ingest(JobEvent(
+            kind=EventKind.BRAIN_REVERT, ts=12.0, node_id=1,
+            role="master", pid=1, args={"plan_id": 3},
+        ))
+        [inc] = led.incidents()
+        assert EventKind.BRAIN_TARGET in inc.trail
+        assert not inc.open and inc.recover_ts == 12.0
+
+
+class TestMetrics:
+    def test_gauges_and_action_counters(self):
+        policy, det, sm, rem, coord, mgr = make_policy()
+        policy.restore({"target": 3})
+        shrink(policy, det, wid=3)
+        metrics = {name: samples for name, _, _, samples
+                   in policy.metrics()}
+        assert metrics["dlrover_tpu_brain_target_world"] == [(None, 3.0)]
+        assert metrics["dlrover_tpu_brain_parked_nodes"] == [(None, 1.0)]
+        assert ({"action": "shrink"}, 1.0) in (
+            metrics["dlrover_tpu_brain_actions_total"]
+        )
+        rem._acting = True
+        policy.tick(now=200.0)
+        metrics = {name: samples for name, _, _, samples
+                   in policy.metrics()}
+        assert ({"reason": "remediation"}, 1.0) in (
+            metrics["dlrover_tpu_brain_deferrals_total"]
+        )
+
+
+class TestFleetDrill:
+    """ISSUE 19 acceptance, end to end through tools.fleet_sim: wrong
+    start world converges to the searched-best size, the chronically
+    degraded node is autonomously cycled out, every decision journaled
+    and WAL-replay-reproducible — and the brain arm beats both the
+    static-wrong-world arm and the oracle-start-never-adapts arm."""
+
+    def test_brain_drill_converges_and_replays(self):
+        from tools.fleet_sim import run_brain_drill
+
+        out = run_brain_drill(arm="brain", ticks=16)
+        assert out["recommendation"] == {
+            "world_size": 3, "source": "history-blended", "feasible": True,
+        }
+        assert out["target"] == 3 and out["world_end"] == 3
+        assert out["degraded_parked"] and not out["degraded_in_world"]
+        assert out["converged_at_tick"] >= 0
+        assert out["actions"]["shrink"] == 1    # one decision, no flaps
+        assert out["replay_match"]
+        assert out["replay_pending_cleared"]
+
+    def test_brain_arm_beats_static_and_oracle(self):
+        from tools.fleet_sim import run_brain_drill
+
+        brain = run_brain_drill(arm="brain", ticks=16)
+        static_wrong = run_brain_drill(arm="static_wrong", ticks=16)
+        oracle = run_brain_drill(arm="oracle_start", ticks=16)
+        assert (
+            brain["samples_per_s_avg"]
+            > static_wrong["samples_per_s_avg"]
+        )
+        assert brain["samples_per_s_avg"] > oracle["samples_per_s_avg"]
+        # the off arms never act and never park
+        assert static_wrong["actions"] == {} and oracle["actions"] == {}
+        assert static_wrong["degraded_in_world"]
+        assert oracle["degraded_in_world"]

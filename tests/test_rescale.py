@@ -630,6 +630,7 @@ class TestRescaleEngineLiveModel:
     rebuilt per world, the live state moves bitwise, and the in-place
     path lands on the exact same math as the restart path."""
 
+    @pytest.mark.slow  # ~16 s of real compiles; tier-1 budget headroom
     def test_shrink_regrow_live_state(self):
         from dlrover_tpu.accel import ParallelSpec
 

@@ -40,7 +40,7 @@ _INTERESTING = re.compile(
     r"|agents_sustained|beats_per_s|fsyncs_per_mutation|rpc_p99"
     r"|completions_per_s|leases_per_s|master_rpcs_per_shard"
     r"|fetch_p99|remediation|action_latency|flaps"
-    r"|failover|replicat)", re.I,
+    r"|failover|replicat|brain|converged)", re.I,
 )
 
 #: Lower-is-better keys: latencies, wall clocks, overheads — and memory
@@ -80,11 +80,18 @@ _INTERESTING = re.compile(
 #: was missing at the kill) wants to shrink, while
 #: ``records_replicated`` and ``failover_speedup_x`` stay
 #: higher-is-better (the latter via ``speedup``).
+#: Brain: ``converged_at_tick`` (policy ticks from start to the
+#: searched-best world with the degraded node parked) wants to shrink;
+#: the three ``samples_per_s_*`` arms and the two
+#: ``brain_vs_*_uplift_pct`` figures stay higher-is-better (the arms
+#: end in the arm name, so the ``_s$`` wall-clock match never sees
+#: them); ``replay_match``/``degraded_parked`` are 0/1 contract bits
+#: where a drop to 0 shows up as a -100% regression row.
 _LOWER_BETTER = re.compile(
     r"(_ms$|(?<!per)_s$|_s_per_gb$|wall|overhead|step_time|compile"
     r"|_gb$|_bytes(?!_per_s|_cut)|detect_latency|fsyncs_per_mutation"
     r"|_loss_steps|master_rpcs_per_shard|fetch_p99_ratio"
-    r"|action_latency|flaps|replication_lag)",
+    r"|action_latency|flaps|replication_lag|converged_at_tick)",
     re.I,
 )
 

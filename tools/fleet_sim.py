@@ -545,6 +545,269 @@ def run_lease_fleet(workers: int = 200, duration_s: float = 5.0,
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+#: Healthy lockstep throughput (steps/s) by world size — a deliberate
+#: scaling knee at 3: the 4th chip buys ~2% (collective cost eats the
+#: gain), which is exactly the shape the brain's marginal test and the
+#: autoconf knee walk exist to find.
+_BRAIN_PERF = {1: 55.0, 2: 100.0, 3: 145.0, 4: 148.0}
+#: Step-time multiplier while the chronically degraded node is in the
+#: world: a synchronous collective steps at the slowest member's pace.
+_BRAIN_DRAG = 1.5
+#: Per-step phase profiles fed to the straggler detector; the degraded
+#: node's compute drag (~46% over the fleet median) sits ABOVE the
+#: brain's shrink threshold but BELOW the remediation verdict ratio —
+#: the regime the brain exists for.
+_PHASES_OK = {"input_s": 0.01, "compute_s": 0.10,
+              "collective_s": 0.01, "readback_s": 0.01}
+_PHASES_DEGRADED = {"input_s": 0.01, "compute_s": 0.16,
+                    "collective_s": 0.01, "readback_s": 0.01}
+
+
+def _seed_brain_history(path: str, job_name: str):
+    """Pre-seed the cross-job metrics store with prior-run throughput:
+    the observed curve replaces the analytic guess at every world the
+    history has seen, so the start recommendation lands on the knee."""
+    from dlrover_tpu.brain.autoconf import WORLD_PERF_KIND
+    from dlrover_tpu.brain.store import BrainMetricsStore
+
+    store = BrainMetricsStore(path)
+    for world, speed in _BRAIN_PERF.items():
+        for i in range(3):
+            store.append(job_name, {
+                "kind": WORLD_PERF_KIND, "ts": float(i),
+                "world_size": world, "samples_per_s": speed,
+            })
+    store.close()
+
+
+def run_brain_drill(ticks: int = 40, nodes: int = 4,
+                    degraded_node: int = 3, arm: str = "brain",
+                    state_dir: str = "", tick_s: float = 2.0) -> Dict:
+    """The ISSUE-19 acceptance drill: a job starts at the WRONG world
+    size (all ``nodes`` chips, one chronically degraded) and the brain
+    must converge it — recommendation from seeded cross-job history,
+    oversize/drag shrink parking the degraded node, every decision a
+    journaled ``("brain", ...)`` record reproduced exactly once by a
+    relaunched master.
+
+    Three arms share one throughput model (``_BRAIN_PERF`` paced by the
+    slowest member) so ``bench.py section_brain`` can compare them:
+
+    - ``brain``      — starts at ``nodes``, policy on. Must end at the
+      searched-best world (3) with the degraded node parked, and the
+      relaunched master must replay to the same decision state.
+    - ``static_wrong`` — starts at ``nodes``, policy off: the degraded
+      node paces the oversized world forever.
+    - ``oracle_start`` — starts at the searched-best size but with the
+      degraded node aboard, and never adapts: right size, wrong member.
+    """
+    from dlrover_tpu.common.constants import RendezvousName
+    from dlrover_tpu.master.master import JobMaster
+
+    job_name = "brain-drill"
+    tmp = ""
+    if not state_dir:
+        tmp = state_dir = tempfile.mkdtemp(prefix="brain_drill_")
+    brain_on = arm == "brain"
+    if arm == "oracle_start":
+        start_ranks = sorted(
+            [degraded_node]
+            + [r for r in range(nodes) if r != degraded_node][:2]
+        )
+    else:
+        start_ranks = list(range(nodes))
+    overrides = {
+        env_utils.BRAIN.name: "1" if brain_on else "0",
+        env_utils.BRAIN_SUSTAIN_TICKS.name: "2",
+        env_utils.BRAIN_COOLDOWN_S.name: "0",
+        env_utils.BRAIN_MIN_WORLD.name: "2",
+        env_utils.RESCALE.name: "1",
+        # The drill isolates the brain: remediation stays quiet (the
+        # injected drag is below its verdict ratio anyway).
+        env_utils.REMEDIATION.name: "0",
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    master = master2 = None
+    try:
+        if brain_on:
+            _seed_brain_history(
+                os.path.join(state_dir, "brain_metrics.log"), job_name
+            )
+        master = JobMaster(
+            port=0, node_num=len(start_ranks), job_name=job_name,
+            state_dir=state_dir,
+        )
+        TRAIN = RendezvousName.TRAINING
+        mgr = master.rdzv_managers[TRAIN]
+        for r in start_ranks:
+            master.servicer.handle(m.JoinRendezvous(
+                node_id=r, node_rank=r, local_world_size=1,
+                rdzv_name=TRAIN,
+            ))
+        mgr.get_comm_world(start_ranks[0])
+        spec = {"data": len(start_ranks), "fsdp": 1, "tensor": 1,
+                "seq": 1, "expert": 1, "pipe": 1, "zero": False}
+        for r in start_ranks:
+            extra = {"rescale_capable": True}
+            if r == start_ranks[0]:
+                extra.update({
+                    "global_batch": 32, "micro_batch": 8,
+                    "model_profile": {"param_count": 100_000_000},
+                    "hbm": 16e9, "parallel_spec": spec,
+                })
+            master.servicer.handle(m.ModelInfo(
+                node_id=r, params_count=100_000_000, batch_size=32,
+                extra=extra,
+            ))
+
+        sim_now = time.time()
+        step = 0
+        last_n = 0
+        sim_steps = sim_time = 0.0
+        rate = 0.0
+        converged_at = -1
+        timeline = []
+        for tick in range(ticks):
+            world = mgr.current_world()
+            n = len(world)
+            if n != last_n:
+                # A trainer restarts its step clock across a world
+                # change; stale-window samples would smear two worlds'
+                # speeds into one reading.
+                master.speed_monitor.reset_running_speed_monitor()
+                last_n = n
+            degraded_in = degraded_node in world
+            rate = _BRAIN_PERF.get(n, 0.0) / (
+                _BRAIN_DRAG if degraded_in else 1.0
+            )
+            sim_now += tick_s
+            sim_steps += rate * tick_s
+            sim_time += tick_s
+            step += max(1, int(rate * tick_s))
+            if world:
+                master.speed_monitor.collect_global_step(
+                    step, sim_now, worker_id=min(world)
+                )
+            for w in world:
+                master.straggler_detector.note_phases(
+                    w,
+                    dict(_PHASES_DEGRADED if w == degraded_node
+                         else _PHASES_OK),
+                    step=step,
+                )
+            master.straggler_detector.tick()
+            master.brain.tick(now=sim_now)
+            pending = master.brain.status()["pending"]
+            if pending["plan_id"] >= 0:
+                # Stand in for the survivors' agents: ack the issued
+                # shrink plan through the journaled RescaleAck RPC so
+                # plan outcomes replay on the relaunched master.
+                for r in sorted(mgr.current_world()):
+                    master.servicer.handle(m.RescaleAck(
+                        node_id=r, plan_id=pending["plan_id"],
+                        node_rank=r, ok=True,
+                    ))
+            if brain_on:
+                # Shrunk-out (and never-admitted) nodes keep polling
+                # the join path — the brain's park gate is what holds
+                # them out, and a release lifts it with no new RPC.
+                for r in range(nodes):
+                    if r not in mgr.current_world():
+                        master.servicer.handle(m.JoinRendezvous(
+                            node_id=r, node_rank=r, local_world_size=1,
+                            rdzv_name=TRAIN,
+                        ))
+            world = mgr.current_world()
+            if not timeline or timeline[-1][1:] != (
+                len(world), degraded_node in world
+            ):
+                timeline.append(
+                    (tick, len(world), degraded_node in world)
+                )
+            if (
+                converged_at < 0 and len(world) == 3
+                and degraded_node not in world
+            ):
+                converged_at = tick
+
+        end_world = mgr.current_world()
+        status = master.brain.status()
+        out = {
+            "arm": arm,
+            "ticks": ticks,
+            "world_start": len(start_ranks),
+            "world_end": len(end_world),
+            "degraded_node": degraded_node,
+            "degraded_in_world": degraded_node in end_world,
+            "degraded_parked": str(degraded_node) in status["parked"],
+            "target": status["target"],
+            "recommendation": {
+                k: status["recommendation"].get(k)
+                for k in ("world_size", "source", "feasible")
+            } if status["recommendation"] else {},
+            "actions": status["actions"],
+            "deferrals": status["deferrals"],
+            "samples_per_s_avg": round(sim_steps / max(sim_time, 1e-9), 1),
+            "samples_per_s_final": round(rate, 1),
+            "converged_at_tick": converged_at,
+            "timeline": timeline,
+        }
+
+        if brain_on:
+            # ---- failover half: crash (no graceful snapshot) and
+            # relaunch on the same state dir; the ("brain", ...) WAL
+            # records must reproduce the decision state exactly once.
+            pre = master.brain.checkpoint()
+            from dlrover_tpu.observability.events import uninstall_sink
+
+            master._stopped.set()
+            master._server.stop()
+            uninstall_sink(master._event_sink_fn)
+            if master.brain_store is not None:
+                master.brain_store.close()
+            master.state_store.close()
+            master2 = JobMaster(
+                port=0, node_num=len(start_ranks), job_name=job_name,
+                state_dir=state_dir,
+            )
+            post = master2.brain.checkpoint()
+            replay_match = (
+                post["target"] == pre["target"]
+                and post["parked"] == pre["parked"]
+                and post["recommendation"] == pre["recommendation"]
+                and post["actions"].get("shrink", 0)
+                == pre["actions"].get("shrink", 0)
+            )
+            # The replayed shrink re-marks its plan pending; the acks
+            # replayed through their rpc records settle it on the first
+            # tick (exactly once — never a re-shrink).
+            world2 = master2.rdzv_managers[TRAIN].current_world()
+            master2.brain.tick(now=sim_now + tick_s)
+            post_tick = master2.brain.status()
+            out.update({
+                "replay_match": replay_match,
+                "replay_world": len(world2),
+                "replay_degraded_in_world": degraded_node in world2,
+                "replay_pending_cleared":
+                    post_tick["pending"]["plan_id"] < 0,
+                "replay_target": post["target"],
+            })
+        return out
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if master2 is not None:
+            master2.stop()
+        elif master is not None:
+            master.stop()
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--agents", type=int, default=1000)
@@ -563,7 +826,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     choices=("lease", "per_call"))
     ap.add_argument("--shards-per-lease", type=int, default=512)
     ap.add_argument("--completion-batch", type=int, default=512)
+    ap.add_argument("--brain-drill", default="",
+                    choices=("", "brain", "static_wrong", "oracle_start"),
+                    help="run the brain auto-scaling drill arm instead "
+                         "of the load mix")
+    ap.add_argument("--ticks", type=int, default=40)
     args = ap.parse_args(argv)
+    if args.brain_drill:
+        out = run_brain_drill(ticks=args.ticks, arm=args.brain_drill)
+        print(json.dumps(out, sort_keys=True))
+        return 0
     if args.procs > 0:
         out = run_lease_fleet(
             workers=args.workers, duration_s=args.duration,
