@@ -38,11 +38,33 @@ class ParallelSpec:
     expert: int = 1
     pipe: int = 1
     zero: bool = False
+    #: Per-axis collective algorithm, e.g. ``(("data", "lat"),)``: an
+    #: absent axis defaults to ``"bw"`` (flat ring reduce-scatter +
+    #: all-gather — full wire volume, overlappable behind backward);
+    #: ``"lat"`` is the hierarchical/fused all-reduce (slow-link volume
+    #: divided by the host width, fewer launches, critical-path). Chosen
+    #: per axis by the measured-bandwidth search (``accel/search.py``);
+    #: stored as a sorted tuple of pairs so the frozen spec stays
+    #: hashable (a dict or pair-list normalizes in ``__post_init__``).
+    collectives: tuple = ()
 
     def __post_init__(self):
         for name in ("data", "fsdp", "tensor", "seq", "expert", "pipe"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} degree must be >= 1")
+        coll = self.collectives
+        if isinstance(coll, dict):
+            coll = coll.items()
+        norm = tuple(sorted(
+            (str(axis), str(strategy)) for axis, strategy in (coll or ())
+        ))
+        for axis, strategy in norm:
+            if strategy not in ("bw", "lat"):
+                raise ValueError(
+                    f"unknown collective strategy {strategy!r} for axis "
+                    f"{axis!r} (want 'bw' or 'lat')"
+                )
+        object.__setattr__(self, "collectives", norm)
 
     @property
     def total(self) -> int:
@@ -57,9 +79,10 @@ class ParallelSpec:
         ]
 
     def rules(self, vocab_size: int = 0):
-        return logical_rules(
-            **dataclasses.asdict(self), vocab_size=vocab_size
-        )
+        d = dataclasses.asdict(self)
+        # Algorithm choice, not a mesh degree — no logical-axis rule.
+        d.pop("collectives", None)
+        return logical_rules(**d, vocab_size=vocab_size)
 
 
 @dataclass
@@ -139,16 +162,43 @@ def _check_spec_axes_used(spec, abstract_state):
 
 def make_train_step(module, optimizer, loss, mesh, rules,
                     shardings, batch_sharding, donate: bool = True,
-                    grad_accum: int = 1):
+                    grad_accum: int = 1, collectives=()):
     """Assemble the jitted SPMD train step for a given strategy.
 
     ``grad_accum > 1`` splits the leading batch dim into that many
     microbatches and accumulates gradients over a ``lax.scan`` before the
     optimizer update — one compiled computation, activation memory of a
     single microbatch (the ElasticTrainer's world-size-change lever).
+
+    ``collectives`` is the spec's per-axis algorithm map. With the data
+    axis on the ``"bw"`` (ring) strategy and ``DLROVER_TPU_COMMS_OVERLAP``
+    on, the accumulated gradient tree's *replicated* leaves are pinned
+    to their final placement per leaf after the scan: GSPMD lowers one
+    bucketed cross-replica reduction per leaf instead of a single fused
+    all-reduce over the whole tree, so early buckets' reductions
+    overlap the remaining buckets' and the per-leaf optimizer update's
+    compute — only the last bucket stays exposed. Crucially the hint
+    sits *after* the microbatch accumulation, where the baseline's
+    reduction also runs: every gradient element still sums the same
+    addends in the same order (a bucket split of an elementwise
+    all-reduce touches disjoint elements), so the loss trajectory is
+    bitwise that of the serialized step — ``tests/test_comms.py`` and
+    the bench's comms arm assert exact equality. (Constraining the
+    running sum *inside* the scan would start reductions a microbatch
+    earlier but turns sum-then-reduce into reduce-then-sum, and pinning
+    fsdp-sharded leaves repartitions the backward — both are real FP
+    reassociations, observed non-identical at data=4/fsdp=2.)
     """
     import jax
     import flax.linen as nn
+
+    from dlrover_tpu.common import env_utils
+
+    overlap = (
+        grad_accum > 1
+        and dict(collectives or ()).get("data", "bw") == "bw"
+        and env_utils.COMMS_OVERLAP.get()
+    )
 
     def grads_of(params, batch):
         def scalar_loss(p):
@@ -178,12 +228,10 @@ def make_train_step(module, optimizer, loss, mesh, rules,
                 def body(carry, mb):
                     loss_sum, g_sum = carry
                     lv, g = grads_of(state["params"], mb)
-                    return (
-                        loss_sum + lv,
-                        jax.tree_util.tree_map(
-                            lambda a, c: a + c, g_sum, g
-                        ),
-                    ), None
+                    g_sum = jax.tree_util.tree_map(
+                        lambda a, c: a + c, g_sum, g
+                    )
+                    return (loss_sum + lv, g_sum), None
 
                 zero = jax.tree_util.tree_map(
                     jnp.zeros_like, state["params"]
@@ -195,6 +243,30 @@ def make_train_step(module, optimizer, loss, mesh, rules,
                 grads = jax.tree_util.tree_map(
                     lambda g: g / grad_accum, g_sum
                 )
+                if overlap:
+                    # Bucketed DP reduction: pin each *replicated* leaf
+                    # to its final placement individually so GSPMD
+                    # emits one cross-replica reduction per leaf
+                    # (interleavable with the next leaves' reduce + the
+                    # update sweep) instead of one fused tree-wide
+                    # sync. Same graph position as the baseline's
+                    # reduction → bit-identical values. Sharded (fsdp/
+                    # tensor) leaves are left alone: they already
+                    # reduce-scatter per leaf, and forcing a layout
+                    # there repartitions the backward (observed FP
+                    # reassociation at data=4/fsdp=2).
+                    def _pin(g, s):
+                        spec = getattr(s, "spec", None)
+                        replicated = spec is not None and not any(
+                            p is not None for p in spec
+                        )
+                        if not replicated:
+                            return g
+                        return jax.lax.with_sharding_constraint(g, s)
+
+                    grads = jax.tree_util.tree_map(
+                        _pin, grads, shardings["params"]
+                    )
             else:
                 lv, grads = grads_of(state["params"], batch)
             fused = getattr(optimizer, "update_and_apply", None)
@@ -420,6 +492,7 @@ def auto_accelerate(
         train_step = make_train_step(
             mod, opt, loss, mesh, rules, shardings,
             batch_sharding, grad_accum=grad_accum,
+            collectives=sp.collectives,
         )
         return AccelerateResult(
             spec=sp, mesh=mesh, rules=rules, state=state,

@@ -93,6 +93,7 @@ class _PipeTick(nn.Module):
     make_stage: Callable[[], nn.Module]
     num_microbatches: int
     carry_axes: Tuple
+    overlap_collectives: bool = True
 
     @nn.compact
     def __call__(self, carry, t):
@@ -142,6 +143,16 @@ class _PipeTick(nn.Module):
             ),
             aux_outs,
         )
+        if self.overlap_collectives:
+            # Pin the collected outputs to their final placement every
+            # tick: the last stage's finished microbatch moves to the
+            # output shard *during* the next tick's compute (one small
+            # per-tick transfer), instead of one bulk relayout after the
+            # scan. Placement-only — values are bit-identical with the
+            # constraint off.
+            outs = nn.with_logical_constraint(
+                outs, (None,) + self.carry_axes
+            )
         # Shift every activation one stage forward (collective-permute
         # when the stage dim is sharded over `pipe`).
         state = jnp.roll(processed, 1, axis=0)
@@ -167,6 +178,11 @@ class Pipeline(nn.Module):
     num_microbatches: int = 0
     carry_axes: Tuple = ("batch", None, None)
     has_aux: bool = False   # stage returns (y, aux) — e.g. MoE stages
+    # Constrain finished-microbatch outputs to their final placement per
+    # tick so the stage-boundary transfers interleave with compute (see
+    # _PipeTick). Bit-identical either way; off = the serialized
+    # baseline bench.py's comms section measures against.
+    overlap_collectives: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -192,7 +208,8 @@ class Pipeline(nn.Module):
             in_axes=0,
             length=m + p - 1,
         )(
-            self.make_stage, m, self.carry_axes, name="ticks"
+            self.make_stage, m, self.carry_axes,
+            self.overlap_collectives, name="ticks",
         )
         (state, _, outs, aux_outs, _), _ = ticks(
             (state, aux_state, outs, aux_outs, xs),
@@ -270,6 +287,10 @@ class CircularPipeline(nn.Module):
     # the entire resident bank every tick (C x the weight traffic; see
     # docs/pipeline_schedules.md for the on-chip numbers).
     chunk_select: str = "slice"
+    # Same per-tick output-placement constraint as Pipeline: finished
+    # microbatches migrate to the output shard tick by tick instead of
+    # in one post-scan relayout. Bit-identical either way.
+    overlap_collectives: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -398,6 +419,10 @@ class CircularPipeline(nn.Module):
                 ),
                 aux_outs,
             )
+            if self.overlap_collectives:
+                outs = nn.with_logical_constraint(
+                    outs, (None,) + self.carry_axes
+                )
             buf = jnp.where(
                 is_wrap,
                 lax.dynamic_update_index_in_dim(buf, y[-1], slot, 0),

@@ -325,6 +325,7 @@ def estimate(
     devices_per_host: int = 0,
     dcn_bw: float = _DCN_BW,
     hbm_bw: float = _HBM_BW,
+    link_profile: Optional[dict] = None,
 ) -> CostEstimate:
     """Analytic memory + roofline cost for one candidate spec.
 
@@ -332,7 +333,22 @@ def estimate(
     mesh axis whose collective block spans hosts (canonical layout,
     outer axes first) is priced at ``dcn_bw`` with DCN latency — the
     model that makes hierarchical placements (fsdp inside a host, dp or
-    pp across) beat host-crossing gathers."""
+    pp across) beat host-crossing gathers.
+
+    ``link_profile`` swaps the analytic link constants for *measured*
+    figures (the master LinkProfileAggregator's per-axis fold,
+    ``axis -> {bw_bytes_s, lat_s, saturated}``); axes the profile has no
+    measurement for (``bw_bytes_s`` null — host-local links the agent
+    probe cannot see) keep the analytic constants. The spec's
+    ``collectives`` map then selects per-axis *algorithm* pricing:
+    ``"bw"`` (default) is the flat ring reduce-scatter+all-gather —
+    maximal wire volume, overlappable behind backward; ``"lat"`` is the
+    hierarchical/fused all-reduce — reduces within a host first, so the
+    slow-link wire volume divides by the host width and the launch count
+    halves, but the fused collective sits on the critical path. The
+    ranking therefore picks ``"bw"`` exactly where measured bandwidth
+    justifies paying full volume for overlap (fast/host-local axes) and
+    ``"lat"`` where a thin measured link makes volume the enemy."""
     p = profile
     dp = spec.data * spec.fsdp                      # batch shards
     tokens_dev = batch_size * max(p.seq_len, 1) / (dp * spec.seq)
@@ -400,29 +416,67 @@ def estimate(
 
     # --- communication (per-axis bandwidth + per-collective α) ---
     # Each term is priced at its own axis's link: ICI within a host,
-    # DCN when the axis's collective block spans hosts.
+    # DCN when the axis's collective block spans hosts; a measured
+    # link_profile entry overrides either constant.
     crossing = _axis_links(spec, devices_per_host)
 
     def bw(axis):
+        measured = ((link_profile or {}).get(axis) or {}).get("bw_bytes_s")
+        if measured:
+            return float(measured)
         return dcn_bw if crossing.get(axis) else ici_bw
 
     def lat(axis):
+        measured = ((link_profile or {}).get(axis) or {}).get("lat_s")
+        if measured:
+            return float(measured)
         return _DCN_LAT if crossing.get(axis) else _COLL_LAT
 
+    def hier(axis):
+        # Host width the "lat" algorithm's intra-host reduce collapses
+        # over before touching the axis's slow link; a host-local axis
+        # has no second tier, so its fused all-reduce still ships full
+        # volume (and "lat" can only win there on pure launch count).
+        return max(2, devices_per_host) if crossing.get(axis) else 1
+
+    def lat_volume_s(axis, vol):
+        # The hierarchical algorithm's wire time: reduce+broadcast the
+        # full volume inside each host at ICI speed, then move vol/h
+        # over the axis's (measured or analytic) link. Both legs are
+        # fused into the step boundary — critical path. Charging the
+        # intra-host leg is what keeps the trade bandwidth-sensitive:
+        # on a fast axis the ring's overlap discount beats the volume
+        # division, on a thin measured link it cannot.
+        h = hier(axis)
+        t = vol / h / bw(axis)
+        if h > 1:
+            t += vol / ici_bw
+        return t
+
+    strat = dict(getattr(spec, "collectives", ()) or ())
     comm_ov_s = 0.0  # prefetchable: FSDP gathers, DP grad sync
     comm_cp_s = 0.0  # critical path: TP/ring/EP/stage transfers
     pbytes_tp = 2.0 * p.param_count / (spec.tensor * spec.expert * spec.pipe)
     if spec.fsdp > 1:
         # all-gather params fwd + bwd, reduce-scatter grads (bf16 wire);
         # one collective per layer per direction.
-        comm_ov_s += (3.0 * pbytes_tp * (spec.fsdp - 1) / spec.fsdp
-                      / bw("fsdp"))
-        comm_cp_s += 3.0 * layers_dev * lat("fsdp")
+        vol = 3.0 * pbytes_tp * (spec.fsdp - 1) / spec.fsdp
+        if strat.get("fsdp") == "lat":
+            comm_cp_s += lat_volume_s("fsdp", vol)
+            comm_cp_s += 1.5 * layers_dev * lat("fsdp")
+        else:
+            comm_ov_s += vol / bw("fsdp")
+            comm_cp_s += 3.0 * layers_dev * lat("fsdp")
     if spec.data > 1:
         # grad all-reduce over the pure-DP axis (on the fsdp-sharded rest).
-        comm_ov_s += (2.0 * (pbytes_tp / spec.fsdp)
-                      * (spec.data - 1) / spec.data / bw("data"))
-        comm_cp_s += lat("data")
+        vol = (2.0 * (pbytes_tp / spec.fsdp)
+               * (spec.data - 1) / spec.data)
+        if strat.get("data") == "lat":
+            comm_cp_s += lat_volume_s("data", vol)
+            comm_cp_s += 0.5 * lat("data")
+        else:
+            comm_ov_s += vol / bw("data")
+            comm_cp_s += lat("data")
     if zero_shard > 1:
         # ZeRO-1 swaps the grad all-reduce for reduce-scatter + an
         # all-gather of the updated params — the same wire volume (the
@@ -516,10 +570,27 @@ def _factorizations(n: int, k: int):
                 yield (d,) + rest
 
 
+#: Axes whose collective algorithm is a searched dimension. Only the
+#: param-sync axes: TP/ring/EP traffic is activation-shaped and its
+#: algorithm is fixed by the layer semantics, but the fsdp gathers and
+#: the dp grad sync genuinely admit both the flat ring (full volume,
+#: overlappable) and the hierarchical fused form (reduced slow-link
+#: volume, critical-path).
+_STRATEGY_AXES = ("data", "fsdp")
+
+
 def enumerate_specs(
-    profile: ModelProfile, n_devices: int, batch_size: int
+    profile: ModelProfile, n_devices: int, batch_size: int,
+    strategies: bool = False,
 ) -> List[Any]:
-    """Every ParallelSpec the model can legally run on n_devices."""
+    """Every ParallelSpec the model can legally run on n_devices.
+
+    ``strategies=True`` widens the space with per-axis collective
+    algorithm choices on :data:`_STRATEGY_AXES` (``"lat"`` variants —
+    the absent entry is the default ``"bw"`` ring), at most 3 extra
+    variants per spec. Off by default: without a measured link profile
+    the analytic constants price every variant identically enough that
+    the extra candidates are pure search cost."""
     from dlrover_tpu.accel.accelerate import ParallelSpec
 
     p = profile
@@ -568,6 +639,17 @@ def enumerate_specs(
     out += [
         dataclasses.replace(s, zero=True) for s in out if s.data > 1
     ]
+    if strategies:
+        variants = []
+        for s in out:
+            live = [a for a in _STRATEGY_AXES if getattr(s, a) > 1]
+            for mask in range(1, 1 << len(live)):
+                combo = tuple(
+                    (axis, "lat") for i, axis in enumerate(live)
+                    if mask & (1 << i)
+                )
+                variants.append(dataclasses.replace(s, collectives=combo))
+        out += variants
     return out
 
 
@@ -584,6 +666,8 @@ def search_spec(
     ici_bw: float = _ICI_BW,
     devices_per_host: int = 0,
     dcn_bw: float = _DCN_BW,
+    link_profile: Optional[dict] = None,
+    strategies: bool = False,
 ) -> List[Tuple[Any, CostEstimate]]:
     """Rank the feasible strategy space; return the top-K (spec, cost).
 
@@ -595,7 +679,9 @@ def search_spec(
     the model says won't). ``prefer`` breaks near-ties toward named
     degrees (used by tests and the MoE default).
     """
-    cands = enumerate_specs(profile, n_devices, batch_size)
+    cands = enumerate_specs(
+        profile, n_devices, batch_size, strategies=strategies
+    )
     if not cands:
         from dlrover_tpu.accel.accelerate import ParallelSpec
 
@@ -604,13 +690,13 @@ def search_spec(
         return [(fallback, estimate(
             profile, fallback, batch_size, hbm, ab, peak_flops,
             ici_bw=ici_bw, devices_per_host=devices_per_host,
-            dcn_bw=dcn_bw))]
+            dcn_bw=dcn_bw, link_profile=link_profile))]
     scored = []
     for spec in cands:
         ab = abstract_fn(spec) if abstract_fn else abstract_state
         est = estimate(profile, spec, batch_size, hbm, ab, peak_flops,
                        ici_bw=ici_bw, devices_per_host=devices_per_host,
-                       dcn_bw=dcn_bw)
+                       dcn_bw=dcn_bw, link_profile=link_profile)
         scored.append((spec, est))
     fitting = [s for s in scored if s[1].fits(hbm)]
     if fitting:
@@ -714,6 +800,13 @@ def spec_diff(old, new) -> str:
             parts.append(f"{name} {a}->{b}")
     if old.zero != new.zero:
         parts.append(f"zero {'on->off' if old.zero else 'off->on'}")
+    oc = dict(getattr(old, "collectives", ()) or ())
+    nc = dict(getattr(new, "collectives", ()) or ())
+    if oc != nc:
+        for axis in sorted(set(oc) | set(nc)):
+            a, b = oc.get(axis, "bw"), nc.get(axis, "bw")
+            if a != b:
+                parts.append(f"{axis}-coll {a}->{b}")
     return ", ".join(parts) if parts else "unchanged"
 
 
@@ -742,6 +835,9 @@ def search_reshape_spec(
     peak_flops: float = _PEAK_FLOPS_DEFAULT,
     stickiness: float = 0.05,
     ici_bw: float = _ICI_BW,
+    devices_per_host: int = 0,
+    dcn_bw: float = _DCN_BW,
+    link_profile: Optional[dict] = None,
 ) -> Optional[Tuple[Any, CostEstimate]]:
     """Constrained-world search: the best spec for ≤ ``n_devices``.
 
@@ -759,16 +855,23 @@ def search_reshape_spec(
     fall back to the DP-only plan path)."""
     if n_devices < 1:
         return None
+    # A measured profile unlocks the collective-strategy dimension: only
+    # with live per-axis bandwidth can the ranking tell where the "lat"
+    # variant's reduced wire volume beats the ring's overlap.
+    strategies = bool(link_profile)
     cands = []
     for m in range(n_devices, 0, -1):
-        cands.extend(enumerate_specs(profile, m, batch_size))
+        cands.extend(enumerate_specs(
+            profile, m, batch_size, strategies=strategies
+        ))
     if not cands:
         return None
     scored = []
     for spec in cands:
         est = estimate(
             profile, spec, batch_size, hbm, abstract_state, peak_flops,
-            ici_bw=ici_bw,
+            ici_bw=ici_bw, devices_per_host=devices_per_host,
+            dcn_bw=dcn_bw, link_profile=link_profile,
         )
         scored.append((spec, est))
     fitting = [s for s in scored if s[1].fits(hbm)]

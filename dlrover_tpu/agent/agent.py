@@ -652,6 +652,17 @@ class ElasticTrainingAgent:
             plan.plan_id, sorted(plan.old_world), sorted(plan.new_world),
             plan.old_round, plan.new_round,
         )
+        from dlrover_tpu.agent.device_check import LinkProbe
+
+        # The workers' d2d resharding transfers run inside this settle
+        # window; bracket it so concurrent link-probe samples carry the
+        # transfer flag (the master's link aggregator keeps them out of
+        # its saturation baseline — transition traffic is not link
+        # degradation).
+        with LinkProbe.transfer_window():
+            return self._settle_rescale_plan(outcome, plan, deadline, interval)
+
+    def _settle_rescale_plan(self, outcome, plan, deadline, interval) -> bool:
         while not self._stopped.is_set() and time.monotonic() < deadline:
             if any(
                 p.poll() not in (None, 0) for p in self._workers

@@ -20,6 +20,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
@@ -239,6 +240,39 @@ class LinkProbe:
         self.skipped = 0
         self._task: Optional[PeriodicTask] = None
 
+    # Process-wide count of rescale/reshape d2d transfers in flight
+    # (brackets around the agent's in-place transition window). The ckpt
+    # saver raises its own busy signal; transition traffic moves through
+    # the very same host links without one, so without this bracket a
+    # sample taken mid-transfer would read as a degraded link and could
+    # trip the fleet saturation flag on every reshape.
+    _transfers = 0
+    _transfers_lock = threading.Lock()
+
+    @classmethod
+    def transfer_window(cls):
+        """Context manager marking a rescale/reshape d2d transfer in
+        flight; probe samples taken inside are flagged ``transfer``
+        (the master-side aggregator drops them from the baseline fold)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _window():
+            with cls._transfers_lock:
+                cls._transfers += 1
+            try:
+                yield
+            finally:
+                with cls._transfers_lock:
+                    cls._transfers -= 1
+
+        return _window()
+
+    @classmethod
+    def transfer_active(cls) -> bool:
+        with cls._transfers_lock:
+            return cls._transfers > 0
+
     @staticmethod
     def _saver_busy() -> bool:
         from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
@@ -269,10 +303,17 @@ class LinkProbe:
                 return None
         except Exception:  # dtlint: disable=DT001 -- a broken busy probe must not stop link telemetry
             pass
+        transfer = self.transfer_active()
         sample = (
             self._sample_fn() if self._sample_fn is not None
             else self._measure()
         )
+        if transfer:
+            # Taken while a rescale/reshape d2d transfer held the link:
+            # real traffic, not link health. Flag it so the aggregator
+            # keeps it out of the saturation baseline; the straggler
+            # detector still sees a sample (gap-free rings).
+            sample["transfer"] = True
         chaos = fault_hit(ChaosSite.PROBE_LINK, detail=str(self._seq))
         if chaos is not None and chaos.kind == "degrade":
             factor = float(chaos.args.get("factor", 0.1)) or 0.1

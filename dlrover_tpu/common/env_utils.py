@@ -495,6 +495,55 @@ STRAGGLER_EVICT_AFTER = ENV.float(
     "Seconds a classified straggler may persist before the eviction "
     "recommendation (or eviction, if enabled) fires.")
 
+# ---------------- communication plane (link-aware comms) ----------------
+COMMS_PROFILE = ENV.bool(
+    "DLROVER_TPU_COMMS_PROFILE", True,
+    "Run the master-side LinkProfileAggregator: fold probe.link samples "
+    "into the per-axis fleet link profile, publish it through the kv "
+    "store, and export it as gauges. Off: probes still feed the "
+    "straggler detector but nothing consumes them for comms decisions.")
+COMMS_WINDOW = ENV.int(
+    "DLROVER_TPU_COMMS_WINDOW", 16,
+    "Rolling per-node sample window the link-profile aggregator folds "
+    "bandwidth/rtt over (independent of the straggler window).")
+COMMS_SATURATION_RATIO = ENV.float(
+    "DLROVER_TPU_COMMS_SATURATION_RATIO", 0.5,
+    "Saturation threshold: the fleet's recent host-link bandwidth "
+    "falling below this fraction of its rolling baseline makes the "
+    "link a saturation candidate.")
+COMMS_SATURATION_SUSTAIN = ENV.int(
+    "DLROVER_TPU_COMMS_SATURATION_SUSTAIN", 2,
+    "Consecutive aggregator folds a saturation candidate must persist "
+    "before the flag raises — and folds back under the (frozen) "
+    "baseline before it clears. Hysteresis against flapping the "
+    "governor on one slow probe.")
+COMMS_PUBLISH_EVERY_S = ENV.float(
+    "DLROVER_TPU_COMMS_PUBLISH_EVERY_S", 5.0,
+    "Minimum seconds between kv-store publishes of the fleet link "
+    "profile (the monitor loop ticks faster; publishing every tick "
+    "would churn the WAL via the kv export).")
+COMMS_GOVERNOR = ENV.bool(
+    "DLROVER_TPU_COMMS_GOVERNOR", True,
+    "Let workers consult the CommsGovernor: while the published profile "
+    "marks the host link saturated, checkpoint D2H staging and deferred "
+    "metric readback are pushed off the hot path (bounded by "
+    "DLROVER_TPU_COMMS_DEFER_MAX_STEPS).")
+COMMS_GOVERNOR_REFRESH_S = ENV.float(
+    "DLROVER_TPU_COMMS_GOVERNOR_REFRESH_S", 5.0,
+    "Seconds between worker-side refreshes of the kv-published link "
+    "profile (one small kv get; never on the step critical path).")
+COMMS_DEFER_MAX_STEPS = ENV.int(
+    "DLROVER_TPU_COMMS_DEFER_MAX_STEPS", 8,
+    "Maximum consecutive steps the governor may defer a memory-snapshot "
+    "staging (or metric readback) while the link stays saturated; after "
+    "the cap the work runs anyway so crash-recovery lag stays bounded.")
+COMMS_OVERLAP = ENV.bool(
+    "DLROVER_TPU_COMMS_OVERLAP", True,
+    "Backward-overlap kill switch: bucket gradient reduction into the "
+    "accumulation scan (reduce-scatter per microbatch, last-bucket-only "
+    "sync) when the spec's collective strategy asks for it. Off: the "
+    "serialized accumulate-then-sync step, the A/B baseline.")
+
 # ---------------- automatic straggler remediation ----------------
 REMEDIATION = ENV.bool(
     "DLROVER_TPU_REMEDIATION", True,

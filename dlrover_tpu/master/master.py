@@ -29,6 +29,7 @@ from dlrover_tpu.observability.plane import (
     METRICS_PORT_ENV,
     ObservabilityPlane,
 )
+from dlrover_tpu.master.monitor.link_profile import LinkProfileAggregator
 from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.monitor.straggler import StragglerDetector
 from dlrover_tpu.master.mutation_locks import MutationLocks
@@ -126,11 +127,25 @@ class JobMaster:
         self.observability.event_log.add_listener(
             self.straggler_detector.observe
         )
+        # Link-aware comms plane: the same probe.link telemetry also
+        # feeds the fleet link-profile aggregator, whose folded per-axis
+        # profile is published through the kv store (riding master
+        # snapshots, so it survives failover) and steers the reshape
+        # search + worker-side comms governor.
+        self.link_aggregator = None
+        if env_utils.COMMS_PROFILE.get():
+            self.link_aggregator = LinkProfileAggregator(
+                kv_store=self.kv_store
+            )
+            self.observability.event_log.add_listener(
+                self.link_aggregator.observe
+            )
         self.observability.attach(
             speed_monitor=self.speed_monitor,
             job_manager=self.job_manager,
             task_manager=self.task_manager,
             straggler_detector=self.straggler_detector,
+            link_aggregator=self.link_aggregator,
         )
         self.metric_collector.add_sink(self.observability.metric_sink)
         self._metrics_port_cfg = metrics_port
@@ -154,6 +169,12 @@ class JobMaster:
             rdzv_managers=self.rdzv_managers,
             state_store=self.state_store,
         )
+        if self.link_aggregator is not None:
+            # Reshape searches price candidates at the measured link
+            # profile (and gain the collective-strategy dimension).
+            self.rescale.set_link_profile_fn(
+                self.link_aggregator.search_profile
+            )
         # Preemption plane: a known-ahead termination notice becomes a
         # planned transition — writer-lease handoff on arrival, shrink
         # at the next step boundary, clean cancel on false alarm.
@@ -542,6 +563,14 @@ class JobMaster:
                 self.preempt.tick()
                 self.shard_lease.tick()
                 self.straggler_detector.tick()
+                if self.link_aggregator is not None:
+                    # The aggregator needs to know which mesh axes cross
+                    # hosts to map fleet link figures onto axes; the
+                    # rescale plane derives it from the reported spec.
+                    self.link_aggregator.set_axis_links(
+                        self.rescale.axis_crossing()
+                    )
+                    self.link_aggregator.tick()
                 self.remediation.tick()
                 self.brain.tick()
                 if self.brain_store is not None:
@@ -589,6 +618,8 @@ class JobMaster:
         self.shard_lease.drop_agent(node_id)
         self.speed_monitor.remove_worker(node_id)
         self.straggler_detector.remove_worker(node_id)
+        if self.link_aggregator is not None:
+            self.link_aggregator.remove_worker(node_id)
         self.metric_collector.remove_node(node_id)
         # An announced departure must not later read as a false alarm.
         self.preempt.on_node_removed(node_id)

@@ -1,0 +1,389 @@
+"""Master-side link-profile aggregation — the probe→decision half-loop.
+
+Every agent's background :class:`~dlrover_tpu.agent.device_check.
+LinkProbe` has been measuring per-node H2D/D2H bandwidth and master RTT
+since PR 10, and the straggler detector consumes those samples for
+*attribution* — but nothing consumed them for *decisions*: the strategy
+search priced collectives from analytic constants and checkpoint I/O
+freely contended with step traffic. This module closes the loop
+(FlexLink's premise — choose collective behavior from measured link
+bandwidth, arxiv 2510.15882):
+
+- :meth:`LinkProfileAggregator.observe` folds ``probe.link`` events
+  into rolling per-node rings (same listener chain as the straggler
+  detector);
+- :meth:`~LinkProfileAggregator.tick` (master node-monitor loop)
+  collapses them into the **fleet profile**: median/min bandwidth and
+  median RTT across nodes, plus a hysteresis-guarded host-link
+  **saturation flag** — and derives the **per-axis profile** consumed
+  by ``accel/search.py``: host-crossing mesh axes are priced at the
+  measured inter-host figures, host-local axes keep their analytic ICI
+  constants (the agent cannot measure ICI) but still inherit the
+  saturation flag;
+- the profile is published as JSON through the master kv store
+  (:data:`LINK_PROFILE_KV_KEY`) — which rides master snapshots/WAL, so
+  a promoted standby serves the same profile — and exported as gauges.
+
+Which axes cross hosts comes from the rescale plane's knowledge of the
+fleet's current spec (:meth:`set_axis_links`); without it every axis is
+host-local and only the saturation flag carries signal — exactly the
+part the worker-side :class:`~dlrover_tpu.train.comms.CommsGovernor`
+needs.
+
+Saturation semantics mirror the straggler detector's flap guard: the
+recent fleet D2H/H2D median must fall below
+``DLROVER_TPU_COMMS_SATURATION_RATIO`` × the rolling baseline for
+``DLROVER_TPU_COMMS_SATURATION_SUSTAIN`` consecutive folds; the
+baseline freezes while flagged (the window would otherwise absorb the
+degradation) and clearing needs the same sustained streak back above
+the frozen baseline's threshold.
+"""
+
+import json
+import statistics
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.lockdep import instrumented_lock
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.events import EventKind, JobEvent, emit
+
+#: kv-store key the fleet profile is published under. Workers read it
+#: through the ordinary kv_store_get RPC; it survives master failover
+#: because the kv store rides master snapshots.
+LINK_PROFILE_KV_KEY = "__comms_link_profile__"
+
+#: Probe sample keys folded per node (MB/s, higher=better).
+_BW_KEYS = ("h2d_mbps", "d2h_mbps")
+_RTT_KEY = "rtt_ms"
+
+#: Mesh axes the per-axis section covers (accel/mesh.AXIS_ORDER names).
+_AXES = ("data", "fsdp", "pipe", "seq", "expert", "tensor")
+
+
+class _NodeRing:
+    """Rolling probe samples for one node."""
+
+    def __init__(self, window: int):
+        self.rings: Dict[str, deque] = {}
+        self.window = window
+        self.samples_seen = 0
+
+    def add(self, key: str, value: float):
+        ring = self.rings.get(key)
+        if ring is None:
+            ring = self.rings[key] = deque(maxlen=self.window)
+        ring.append(float(value))
+
+    def recent(self, key: str, n: int) -> Optional[float]:
+        ring = self.rings.get(key)
+        if not ring:
+            return None
+        tail = list(ring)[-n:]
+        return sum(tail) / len(tail)
+
+
+class LinkProfileAggregator:
+    """Fold per-node probe samples into the published fleet link profile."""
+
+    #: dtlint DT009 — every fold/read path goes through the lock; the
+    #: published JSON and ``metrics()`` snapshots are built under it and
+    #: consumed outside it.
+    GUARDED_BY = {
+        "_nodes": "master.link_profile",
+        "_crossing": "master.link_profile",
+        "_baseline": "master.link_profile",
+        "_saturated": "master.link_profile",
+        "_sat_streak": "master.link_profile",
+        "_clear_streak": "master.link_profile",
+        "_last_fleet": "master.link_profile",
+        "_last_publish": "master.link_profile",
+        "_folds": "master.link_profile",
+    }
+
+    def __init__(
+        self,
+        kv_store=None,
+        window: Optional[int] = None,
+        saturation_ratio: Optional[float] = None,
+        sustain: Optional[int] = None,
+        publish_every_s: Optional[float] = None,
+    ):
+        self._kv = kv_store
+        self._window = window or env_utils.COMMS_WINDOW.get()
+        self._ratio = min(
+            0.95,
+            max(0.05, saturation_ratio
+                or env_utils.COMMS_SATURATION_RATIO.get()),
+        )
+        self._sustain = max(
+            1, sustain or env_utils.COMMS_SATURATION_SUSTAIN.get()
+        )
+        self._publish_every = (
+            publish_every_s if publish_every_s is not None
+            else env_utils.COMMS_PUBLISH_EVERY_S.get()
+        )
+        self._nodes: Dict[int, _NodeRing] = {}
+        self._crossing: Dict[str, bool] = {}
+        #: Frozen-while-saturated rolling bandwidth baseline per key.
+        self._baseline: Dict[str, float] = {}
+        self._saturated = False
+        self._sat_streak = 0
+        self._clear_streak = 0
+        self._last_fleet: Dict[str, Any] = {}
+        self._last_publish = 0.0
+        self._folds = 0
+        self._lock = instrumented_lock("master.link_profile")
+
+    # ------------- intake -------------
+    def observe(self, ev: JobEvent):
+        """EventLog listener: fold probe.link telemetry into node rings."""
+        if ev.kind != EventKind.PROBE_LINK or ev.node_id < 0:
+            return
+        if ev.args.get("transfer"):
+            # Sample taken while a rescale/reshape d2d transfer was in
+            # flight: real traffic, not link health — keep it out of the
+            # baseline the saturation test folds against.
+            return
+        with self._lock:
+            ring = self._nodes.get(ev.node_id)
+            if ring is None:
+                ring = self._nodes[ev.node_id] = _NodeRing(self._window)
+            for key in (*_BW_KEYS, _RTT_KEY):
+                if key in ev.args:
+                    ring.add(key, float(ev.args[key]))
+            ring.samples_seen += 1
+
+    def remove_worker(self, node_id: int):
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def set_axis_links(self, crossing: Dict[str, bool]):
+        """Which mesh axes cross hosts (from the fleet's reported spec +
+        devices-per-host; the rescale plane knows). Host-crossing axes
+        get the measured inter-host bandwidth/RTT in the per-axis
+        profile; host-local axes keep analytic ICI pricing."""
+        with self._lock:
+            self._crossing = {a: bool(crossing.get(a)) for a in _AXES}
+
+    # ------------- folding -------------
+    def _fleet_fold(self) -> Dict[str, Any]:  # dtlint: holds(master.link_profile)
+        """Collapse node rings into fleet medians/minima. Lock held."""
+        out: Dict[str, Any] = {"nodes": 0}
+        per_key: Dict[str, List[float]] = {}
+        for ring in self._nodes.values():
+            seen = False
+            for key in (*_BW_KEYS, _RTT_KEY):
+                r = ring.recent(key, self._sustain)
+                if r is not None:
+                    per_key.setdefault(key, []).append(r)
+                    seen = True
+            if seen:
+                out["nodes"] += 1
+        for key, vals in per_key.items():
+            out[f"{key}_median"] = round(statistics.median(vals), 3)
+            if key in _BW_KEYS:
+                out[f"{key}_min"] = round(min(vals), 3)
+        return out
+
+    def _update_saturation(self, fleet: Dict[str, Any]) -> Optional[str]:  # dtlint: holds(master.link_profile)
+        """Hysteresis state machine over the host-link bandwidth medians.
+        Returns "saturated"/"cleared" when the flag transitions (the
+        caller emits outside the lock). Lock held."""
+        recents = {
+            k: fleet.get(f"{k}_median") for k in _BW_KEYS
+            if fleet.get(f"{k}_median") is not None
+        }
+        if not recents:
+            return None
+        if not self._saturated:
+            # Live baseline: rolling max-of-medians seen so far, decayed
+            # slowly so a permanently slower link re-baselines instead
+            # of reading as saturated forever.
+            low = False
+            for key, recent in recents.items():
+                base = self._baseline.get(key)
+                if base is None:
+                    self._baseline[key] = recent
+                    continue
+                self._baseline[key] = max(0.98 * base, recent)
+                if recent < self._ratio * base:
+                    low = True
+            if low:
+                self._sat_streak += 1
+                if self._sat_streak >= self._sustain:
+                    # Freeze the baseline at its healthy value; recovery
+                    # is judged against it, not the degraded window.
+                    self._saturated = True
+                    self._clear_streak = 0
+                    return "saturated"
+            else:
+                self._sat_streak = 0
+            return None
+        # Flagged: clear only after a sustained streak back above the
+        # frozen baseline's threshold.
+        recovered = all(
+            recent >= self._ratio * self._baseline.get(key, recent)
+            for key, recent in recents.items()
+        )
+        if recovered:
+            self._clear_streak += 1
+            if self._clear_streak >= self._sustain:
+                self._saturated = False
+                self._sat_streak = 0
+                self._clear_streak = 0
+                return "cleared"
+        else:
+            self._clear_streak = 0
+        return None
+
+    def _axis_profile(self, fleet: Dict[str, Any]) -> Dict[str, Dict]:  # dtlint: holds(master.link_profile)
+        """Per-axis entries for the search's time model. Lock held.
+
+        A host-crossing axis is priced at the measured inter-host link:
+        the conservative fleet *minimum* D2H bandwidth (a synchronous
+        collective runs at its slowest member's pace) and the median
+        RTT. Host-local axes publish no bandwidth (``bw_bytes_s`` null →
+        the search keeps its analytic ICI constants) but carry the
+        fleet saturation flag so the governor and reshape search still
+        see a degraded world.
+        """
+        bw_min = fleet.get("d2h_mbps_min") or fleet.get("h2d_mbps_min")
+        rtt_ms = fleet.get("rtt_ms_median")
+        axes: Dict[str, Dict] = {}
+        for axis in _AXES:
+            crossing = self._crossing.get(axis, False)
+            entry: Dict[str, Any] = {
+                "kind": "dcn" if crossing else "ici",
+                "saturated": self._saturated,
+                "bw_bytes_s": None,
+                "lat_s": None,
+            }
+            if crossing and bw_min:
+                entry["bw_bytes_s"] = round(float(bw_min) * 1e6, 1)
+            if crossing and rtt_ms:
+                entry["lat_s"] = round(float(rtt_ms) * 1e-3, 6)
+            axes[axis] = entry
+        return axes
+
+    # ------------- tick / publish -------------
+    def tick(self, now: Optional[float] = None):
+        """One fold+publish pass (master node-monitor loop cadence)."""
+        now = now if now is not None else time.time()
+        transition = None
+        with self._lock:
+            fleet = self._fleet_fold()
+            if fleet["nodes"] == 0:
+                return
+            self._folds += 1
+            transition = self._update_saturation(fleet)
+            saturated = self._saturated
+            baseline = dict(self._baseline)
+            fleet["saturated"] = saturated
+            self._last_fleet = fleet
+            profile = {
+                "v": 1,
+                "ts": now,
+                "fleet": fleet,
+                "axes": self._axis_profile(fleet),
+            }
+            publish = (
+                transition is not None
+                or now - self._last_publish >= self._publish_every
+            )
+            if publish:
+                self._last_publish = now
+        if transition == "saturated":
+            logger.warning(
+                "host link saturated: fleet bandwidth %s below %.0f%% "
+                "of baseline %s",
+                {k: fleet.get(f"{k}_median") for k in _BW_KEYS},
+                100 * self._ratio,
+                {k: round(v, 1) for k, v in baseline.items()},
+            )
+            emit(EventKind.COMMS_SATURATED, _role="master", **{
+                f"{k}_median": fleet.get(f"{k}_median") for k in _BW_KEYS
+            })
+        elif transition == "cleared":
+            logger.info("host link saturation cleared")
+            emit(EventKind.COMMS_CLEARED, _role="master")
+        if publish:
+            if self._kv is not None:
+                try:
+                    self._kv.set(
+                        LINK_PROFILE_KV_KEY,
+                        json.dumps(profile).encode(),
+                    )
+                except Exception:
+                    logger.exception("link profile kv publish failed")
+            emit(
+                EventKind.COMMS_PROFILE, _role="master",
+                nodes=fleet["nodes"], saturated=saturated,
+                d2h_mbps_median=fleet.get("d2h_mbps_median"),
+                rtt_ms_median=fleet.get("rtt_ms_median"),
+            )
+
+    # ------------- outputs -------------
+    def profile(self) -> Dict[str, Any]:
+        """The latest folded profile (same shape as the kv JSON)."""
+        with self._lock:
+            if not self._last_fleet:
+                return {}
+            return {
+                "v": 1,
+                "fleet": dict(self._last_fleet),
+                "axes": self._axis_profile(self._last_fleet),
+            }
+
+    def search_profile(self) -> Optional[Dict[str, Dict]]:
+        """The ``axes`` section in the shape ``accel/search.py`` takes as
+        ``link_profile`` (axis → {bw_bytes_s, lat_s, saturated}), or
+        None before the first fold — callers fall back to analytic
+        constants."""
+        prof = self.profile()
+        return prof.get("axes") if prof else None
+
+    def saturated(self) -> bool:
+        with self._lock:
+            return self._saturated
+
+    def metrics(self) -> List:
+        """Exporter gauges (appended by the ObservabilityPlane)."""
+        with self._lock:
+            fleet = dict(self._last_fleet)
+            saturated = self._saturated
+            tracked = len(self._nodes)
+        rows = []
+        for key in _BW_KEYS:
+            med = fleet.get(f"{key}_median")
+            if med is not None:
+                rows.append(({"link": key, "stat": "median"}, float(med)))
+            low = fleet.get(f"{key}_min")
+            if low is not None:
+                rows.append(({"link": key, "stat": "min"}, float(low)))
+        return [
+            (
+                "dlrover_tpu_comms_link_mbps", "gauge",
+                "Fleet host-link bandwidth folded from probe.link "
+                "samples (MB/s, per link direction and statistic).",
+                rows or [(None, 0.0)],
+            ),
+            (
+                "dlrover_tpu_comms_link_rtt_ms", "gauge",
+                "Fleet median master RPC round-trip from probe.link.",
+                [(None, float(fleet.get("rtt_ms_median") or 0.0))],
+            ),
+            (
+                "dlrover_tpu_comms_link_saturated", "gauge",
+                "1 while the aggregator flags the host link saturated "
+                "(the CommsGovernor's defer trigger).",
+                [(None, 1.0 if saturated else 0.0)],
+            ),
+            (
+                "dlrover_tpu_comms_tracked_nodes", "gauge",
+                "Nodes with probe telemetry in the link aggregator.",
+                [(None, float(tracked))],
+            ),
+        ]

@@ -54,6 +54,8 @@ class RescaleCoordinator:
         "_profile": "master.rescale",
         "_hbm": "master.rescale",
         "_last_select": "master.rescale",
+        # Set once at master wiring, read-only afterwards.
+        "_link_profile_fn": None,
     }
 
     """Decides, journals and tracks in-place scale transitions.
@@ -99,6 +101,40 @@ class RescaleCoordinator:
         # The last searched-spec selection, for introspection and so an
         # abort's evidence can name the transition it fenced.
         self._last_select: Dict[str, Any] = {}
+        # Measured-link feed (LinkProfileAggregator.search_profile,
+        # wired by the master; not journaled — the profile is live
+        # telemetry, and a replayed plan carries the spec it chose).
+        self._link_profile_fn: Optional[Any] = None
+
+    def set_link_profile_fn(self, fn):
+        """Zero-arg callable returning the aggregator's per-axis link
+        profile (or None): when present, the reshape search prices
+        candidates at measured bandwidth and searches the per-axis
+        collective-strategy dimension."""
+        self._link_profile_fn = fn
+
+    def axis_crossing(self) -> Dict[str, bool]:
+        """Which mesh axes of the fleet's current spec cross hosts —
+        the aggregator's ``set_axis_links`` input. Empty until the fleet
+        reports its mesh (``set_parallel_config``)."""
+        with self._lock:
+            spec_d = dict(self._spec)
+        if not spec_d:
+            return {}
+        try:
+            from dlrover_tpu.accel.search import _axis_links, spec_from_dict
+
+            cur = spec_from_dict(spec_d)
+            mgr = self._rdzv_managers.get(RendezvousName.TRAINING)
+            hosts = len(mgr.current_world()) if mgr is not None else 0
+            dph = (
+                cur.total // hosts if hosts > 1 and cur.total % hosts == 0
+                else 0
+            )
+            return _axis_links(cur, dph)
+        except Exception:
+            logger.debug("axis crossing derivation failed", exc_info=True)
+            return {}
 
     # ---------------- journal plumbing ----------------
     @property
@@ -466,10 +502,27 @@ class RescaleCoordinator:
             profile = ModelProfile(**{
                 k: v for k, v in profile_d.items() if k in fields
             })
+            # Measured link profile (when the aggregator has one): the
+            # search prices candidates at live per-axis bandwidth and
+            # the collective-strategy dimension opens up.
+            link_profile = None
+            if self._link_profile_fn is not None:
+                try:
+                    link_profile = self._link_profile_fn()
+                except Exception:
+                    logger.debug(
+                        "link profile fetch failed", exc_info=True
+                    )
+            hosts = len(new_world)
+            dph = (
+                n_devices // hosts
+                if hosts > 1 and n_devices % hosts == 0 else 0
+            )
             found = search_reshape_spec(
                 profile, n_devices, global_batch,
                 hbm or 16e9, current_spec=cur,
                 stickiness=env_utils.RESCALE_RESHAPE_STICKINESS.get(),  # dtlint: disable=DT011 -- same guard: spec selection only runs live; the chosen spec is journaled in the plan record
+                devices_per_host=dph, link_profile=link_profile,
             )
             if found is None:
                 return {}, {}

@@ -27,7 +27,9 @@ from dlrover_tpu.observability.events import JobEvent
 #: ring-only like ``metric.*`` — the straggler detector consumes them
 #: live and their loss across a master restart costs one rolling window,
 #: not an incident.
-_SAMPLING_KINDS = frozenset({"step.phases", "probe.link"})
+_SAMPLING_KINDS = frozenset(
+    {"step.phases", "probe.link", "comms.profile", "comms.defer"}
+)
 
 
 def is_telemetry(kind: str) -> bool:

@@ -63,6 +63,17 @@ class EventKind:
     # Background agent link probe: D2H/H2D bandwidth proxy + master RPC
     # round-trip — also high-frequency/ring-only.
     PROBE_LINK = "probe.link"
+    # Communication plane. comms.profile is the aggregator's periodic
+    # per-axis fleet link profile (ring-only — the kv store carries the
+    # durable copy); comms.saturated / comms.cleared bracket a sustained
+    # host-link saturation episode (durable, low-frequency — the
+    # governor's trigger is auditable after the fact); comms.defer is a
+    # worker-side governor decision (what="staging"|"readback", step) —
+    # step-frequency under saturation, so ring-only.
+    COMMS_PROFILE = "comms.profile"
+    COMMS_SATURATED = "comms.saturated"
+    COMMS_CLEARED = "comms.cleared"
+    COMMS_DEFER = "comms.defer"
     # StragglerDetector verdicts: a sustained per-worker outlier was
     # classified (kind=link|compute|input, evidence=...), and later
     # cleared. Durable — these open/close goodput incidents.

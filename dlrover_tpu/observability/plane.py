@@ -53,6 +53,7 @@ class ObservabilityPlane:
         self._remediation = None
         self._brain = None
         self._master_ha = None
+        self._link_aggregator = None
         # Native histograms: master RPC handle latency per message type
         # (servicer.handle) and state-store WAL write/fsync durations
         # (ROADMAP item 4). Lock-cheap — safe to call on the hot path.
@@ -66,7 +67,7 @@ class ObservabilityPlane:
     def attach(self, speed_monitor=None, job_manager=None,
                task_manager=None, straggler_detector=None,
                shard_lease=None, remediation=None, brain=None,
-               master_ha=None):
+               master_ha=None, link_aggregator=None):
         """Late-bind the metric sources the exporter reads from."""
         if speed_monitor is not None:
             self._speed_monitor = speed_monitor
@@ -84,6 +85,8 @@ class ObservabilityPlane:
             self._brain = brain
         if master_ha is not None:
             self._master_ha = master_ha
+        if link_aggregator is not None:
+            self._link_aggregator = link_aggregator
 
     # ------------- intake -------------
     def ingest_report(self, events: List[JobEvent]):
@@ -296,6 +299,8 @@ class ObservabilityPlane:
             ))
         if self._straggler_detector is not None:
             metrics.extend(self._straggler_detector.metrics())
+        if self._link_aggregator is not None:
+            metrics.extend(self._link_aggregator.metrics())
         if self._remediation is not None:
             metrics.extend(self._remediation.metrics())
         if self._brain is not None:

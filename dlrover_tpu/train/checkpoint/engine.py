@@ -404,7 +404,8 @@ class CheckpointEngine:
     # fastcopy pool overlap transfers and bounds peak scratch-host memory.
     _STAGE_CHUNK_BYTES = 32 << 20
 
-    def _fetch(self, blocks: List[_Block]) -> List[np.ndarray]:
+    def _fetch(self, blocks: List[_Block],
+               step: int = -1) -> List[np.ndarray]:
         """Complete the device→host fetch for every block, release the
         engine-owned handles, and return host arrays aligned with `blocks`.
 
@@ -453,7 +454,8 @@ class CheckpointEngine:
         if staged_bytes:
             wall = time.perf_counter() - t0
             emit(
-                EventKind.CKPT_IO, op="staging", bytes=int(staged_bytes),
+                EventKind.CKPT_IO, op="staging", step=step,
+                bytes=int(staged_bytes),
                 mbps=round(staged_bytes / max(wall, 1e-9) / 1e6, 1),
                 duration_s=round(wall, 4), chunks=len(groups),
             )
@@ -509,7 +511,7 @@ class CheckpointEngine:
         persist is never lost to brief lock contention."""
         gen = self._take_gen()
         blocks, objects = self._snapshot(state, own=False)
-        host_arrays = self._fetch(blocks)
+        host_arrays = self._fetch(blocks, step)
         return self._write_snapshot(
             step, blocks, host_arrays, objects, block, gen
         )
@@ -525,9 +527,20 @@ class CheckpointEngine:
         dispatch (~ms) instead of D2H + memcpy.
 
         Returns False (snapshot skipped) while a previous staging is still
-        in flight — same semantics as a lock-contention skip.
+        in flight — same semantics as a lock-contention skip. The comms
+        governor can also skip a step's staging while the master flags
+        the host link saturated (the D2H fetch is exactly the traffic
+        contending with the step's collectives); the deferral is bounded
+        by DLROVER_TPU_COMMS_DEFER_MAX_STEPS and surfaced as a
+        ``ckpt.io`` event with ``op="staging-defer"``.
         """
         if self._staging is not None and not self._staging.done():
+            return False
+        from dlrover_tpu.train.comms import get_governor
+
+        governor = get_governor()
+        if governor is not None and not governor.allow_staging(step):
+            emit(EventKind.CKPT_IO, op="staging-defer", step=step, bytes=0)
             return False
         gen = self._take_gen()
         blocks, objects = self._snapshot(state, own=True)
@@ -538,7 +551,7 @@ class CheckpointEngine:
 
     def _stage_async(self, step, blocks, objects, gen):
         try:
-            host_arrays = self._fetch(blocks)
+            host_arrays = self._fetch(blocks, step)
             ok = self._write_snapshot(
                 step, blocks, host_arrays, objects, True, gen
             )

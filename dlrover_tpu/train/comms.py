@@ -1,0 +1,159 @@
+"""Worker-side comms governor — hot-path I/O yields to a saturated link.
+
+The master's :class:`~dlrover_tpu.master.monitor.link_profile.
+LinkProfileAggregator` publishes the fleet link profile through the kv
+store; this module is its *consumer* on the training side. While the
+profile flags the host link saturated, the two non-step uses of that
+link are pushed off the hot path:
+
+- **checkpoint D2H staging** (``train/checkpoint/engine.py``): the
+  per-step in-memory snapshot's device→host fetch is skipped for the
+  step — the engine's existing skip-if-staging-pending semantics make a
+  skipped step indistinguishable from a slow stage, and the shm
+  snapshot simply stays one step staler;
+- **deferred metric readback** (``train/trainer.py``): the lag-1 fence
+  on the previous step's loss is not forced, letting the device queue
+  run ahead instead of draining through a congested transfer.
+
+Deferral is bounded: after ``DLROVER_TPU_COMMS_DEFER_MAX_STEPS``
+consecutively deferred steps the work is forced through regardless —
+the snapshot a crash would recover from must not age without limit.
+Every decision is a ring-only ``comms.defer`` event, and the engine's
+``ckpt.io`` stream shows the staging bytes landing outside the
+saturated windows (the bench's governor arm asserts exactly that).
+
+The governor is a process-wide singleton (:func:`install_governor` /
+:func:`get_governor`): the trainer installs it once and the checkpoint
+engine — constructed long before the governor exists — looks it up
+lazily per call.
+"""
+
+import json
+import time
+from typing import Optional
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.lockdep import instrumented_lock
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.monitor.link_profile import LINK_PROFILE_KV_KEY
+from dlrover_tpu.observability.events import EventKind, emit
+
+
+class CommsGovernor:
+    """Throttle checkpoint staging / metric readback under saturation."""
+
+    #: dtlint DT009 — refresh results and defer counters are read on the
+    #: training hot path and written by whichever step triggers a kv
+    #: refresh; one lock covers both.
+    GUARDED_BY = {
+        "_saturated": "train.comms",
+        "_last_refresh": "train.comms",
+        "_deferred": "train.comms",
+        "_defer_total": "train.comms",
+    }
+
+    def __init__(self, client=None, refresh_s: Optional[float] = None,
+                 max_defer_steps: Optional[int] = None):
+        self._client = client
+        self._refresh_s = (
+            refresh_s if refresh_s is not None
+            else env_utils.COMMS_GOVERNOR_REFRESH_S.get()
+        )
+        self._max_defer = (
+            max_defer_steps if max_defer_steps is not None
+            else max(1, env_utils.COMMS_DEFER_MAX_STEPS.get())
+        )
+        self._saturated = False
+        self._last_refresh = 0.0
+        #: Consecutive deferrals per work kind ("staging"/"readback").
+        self._deferred = {"staging": 0, "readback": 0}
+        self._defer_total = 0
+        self._lock = instrumented_lock("train.comms")
+
+    # ------------- profile intake -------------
+    def _refresh(self, now: float):  # dtlint: holds(train.comms)
+        """Re-read the kv-published profile if stale. Lock held; the kv
+        RPC itself is cheap (one get) and latency here only delays this
+        step's verdict, never the step itself."""
+        if self._client is None:
+            return
+        if now - self._last_refresh < self._refresh_s:
+            return
+        self._last_refresh = now
+        try:
+            raw = self._client.kv_store_get(LINK_PROFILE_KV_KEY)
+        except Exception:
+            logger.debug("link profile fetch failed", exc_info=True)
+            return
+        if not raw:
+            return
+        try:
+            profile = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return
+        self._saturated = bool(profile.get("fleet", {}).get("saturated"))
+
+    def note_saturated(self, saturated: bool):
+        """Direct override (tests; and the agent can push the flag from
+        its beat without waiting out a kv refresh)."""
+        with self._lock:
+            self._saturated = bool(saturated)
+            self._last_refresh = time.time()
+
+    def saturated(self) -> bool:
+        with self._lock:
+            self._refresh(time.time())
+            return self._saturated
+
+    # ------------- verdicts -------------
+    def _allow(self, what: str, step: int) -> bool:
+        with self._lock:
+            self._refresh(time.time())
+            if not self._saturated:
+                self._deferred[what] = 0
+                return True
+            if self._deferred[what] >= self._max_defer:
+                # Cap reached: force the work through this step so the
+                # recovery snapshot / metric lag stays bounded even
+                # through a long saturation episode.
+                self._deferred[what] = 0
+                return True
+            self._deferred[what] += 1
+            self._defer_total += 1
+            streak = self._deferred[what]
+        emit(EventKind.COMMS_DEFER, what=what, step=step, streak=streak)
+        return False
+
+    def allow_staging(self, step: int) -> bool:
+        """May this step's checkpoint D2H staging run now?"""
+        return self._allow("staging", step)
+
+    def allow_readback(self, step: int) -> bool:
+        """May this step force the lag-1 metric fence/readback?"""
+        return self._allow("readback", step)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "saturated": self._saturated,
+                "defer_total": self._defer_total,
+                **{f"deferred_{k}": v for k, v in self._deferred.items()},
+            }
+
+
+# ---------------- process-wide singleton ----------------
+
+_governor: Optional[CommsGovernor] = None
+
+
+def install_governor(governor: Optional[CommsGovernor]):
+    """Install (or, with None, clear) the process's governor. The
+    trainer does this at fit() entry when DLROVER_TPU_COMMS_GOVERNOR is
+    on and a master client exists."""
+    global _governor
+    _governor = governor
+
+
+def get_governor() -> Optional[CommsGovernor]:
+    """The installed governor, or None (callers treat None as allow)."""
+    return _governor

@@ -312,6 +312,23 @@ class Trainer:
             batch_token_count,
         )
 
+        from dlrover_tpu.train.comms import (
+            CommsGovernor,
+            get_governor,
+            install_governor,
+        )
+
+        # Hot-path I/O governance: consult the master-published link
+        # profile and push checkpoint staging + metric readback off
+        # saturated-step windows. Installed process-wide so the
+        # checkpoint engine (constructed earlier) finds it lazily.
+        if (
+            env_utils.COMMS_GOVERNOR.get() and self._client is not None
+            and get_governor() is None
+        ):
+            install_governor(CommsGovernor(client=self._client))
+        governor = get_governor()
+
         start = self.restore() if start_step is None else start_step
         if pipeline:
             it = (
@@ -406,11 +423,21 @@ class Trainer:
                 report_training_metrics(done)
             last_loss = metrics["loss"]
             phases = None
+            governed = False
             if pipeline:
                 # Lag-1 fence: block on step N-1 (already finished or
                 # finishing while step N runs), never on step N. This
                 # paces the host to the device rate, which also makes
-                # the inter-fence wall time an honest step time.
+                # the inter-fence wall time an honest step time. Under a
+                # saturated link the governor skips the fence AND the
+                # readback for the step (bounded by its defer cap): the
+                # device queue runs ahead instead of draining its D2H
+                # through a congested transfer; the pending slot is
+                # picked up by the next un-governed step's push.
+                governed = (
+                    governor is not None
+                    and not governor.allow_readback(done)
+                )
                 if self._phases is not None:
                     # Split the lag-1 wait into the device fence (block
                     # until step N-1's metrics exist) and the host
@@ -418,13 +445,19 @@ class Trainer:
                     # readback is exactly what a degraded D2H link
                     # inflates. Still lag-1: never a sync on step N.
                     t_f0 = time.perf_counter()
-                    deferred.fence()
+                    if not governed:
+                        deferred.fence()
                     t_f1 = time.perf_counter()
-                    prev = deferred.push(done, {"loss": last_loss})
+                    prev = (
+                        None if governed
+                        else deferred.push(done, {"loss": last_loss})
+                    )
                     t_f2 = time.perf_counter()
                     phases = self._phases.split(
                         input_s, dispatch_s, t_f1 - t_f0, t_f2 - t_f1
                     )
+                elif governed:
+                    prev = None
                 else:
                     prev = deferred.push(done, {"loss": last_loss})
                 now = time.perf_counter()
@@ -456,7 +489,9 @@ class Trainer:
             ):
                 emit(
                     EventKind.STEP_PHASES, step=done,
-                    step_s=step_metrics["step_time_s"], **phases,
+                    step_s=step_metrics["step_time_s"],
+                    **({"governed": True} if governed else {}),
+                    **phases,
                 )
             tokens = batch_token_count(batch)
             if tokens:

@@ -230,6 +230,10 @@ class TestElasticRun:
                 master.terminate()
                 master.wait(timeout=10)
 
+    # Promoted to slow: ~122s of subprocess churn, the largest tier-1
+    # cost by 7x; two-node rendezvous coverage continues in the slow
+    # lane alongside the other multi-process drills in this file.
+    @pytest.mark.slow
     def test_two_node_world(self, tmp_path):
         """Two agents rendezvous through one master; workers form a
         2-process JAX world via jax.distributed."""

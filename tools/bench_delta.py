@@ -40,7 +40,8 @@ _INTERESTING = re.compile(
     r"|agents_sustained|beats_per_s|fsyncs_per_mutation|rpc_p99"
     r"|completions_per_s|leases_per_s|master_rpcs_per_shard"
     r"|fetch_p99|remediation|action_latency|flaps"
-    r"|failover|replicat|brain|converged)", re.I,
+    r"|failover|replicat|brain|converged"
+    r"|exposed_collective|comms_)", re.I,
 )
 
 #: Lower-is-better keys: latencies, wall clocks, overheads — and memory
@@ -80,6 +81,18 @@ _INTERESTING = re.compile(
 #: was missing at the kill) wants to shrink, while
 #: ``records_replicated`` and ``failover_speedup_x`` stay
 #: higher-is-better (the latter via ``speedup``).
+#: Comms: ``comms_overlap_speedup_x`` (tuned arm over serialized arm)
+#: stays higher-is-better via ``speedup``;
+#: ``exposed_collective_*_ms`` (collective time left on the critical
+#: path after overlap + strategy) and the two ``comms_step_*_ms``
+#: measured arms match ``_ms$`` — lower-is-better;
+#: ``staging_bytes_in_saturated_window`` matches ``_bytes`` and its
+#: contract value is 0 (any growth is the governor failing to move
+#: checkpoint D2H off congested steps);
+#: ``comms_staging_off_window_ops`` and
+#: ``comms_loss_bitwise_identical`` (0/1 contract bit: the overlapped
+#: step's loss trajectory is exactly the serialized one) stay
+#: higher-is-better by default.
 #: Brain: ``converged_at_tick`` (policy ticks from start to the
 #: searched-best world with the degraded node parked) wants to shrink;
 #: the three ``samples_per_s_*`` arms and the two
